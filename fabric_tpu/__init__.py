@@ -1,0 +1,24 @@
+"""fabric_tpu — a TPU-native permissioned execute-order-validate ledger framework.
+
+A from-scratch rebuild of the capabilities of Hyperledger Fabric
+(reference: PM-Master/fabric), designed TPU-first: the block-commit data
+plane (batched SHA-256, batched ECDSA-P256 endorsement-signature
+verification, endorsement-policy reduction, and MVCC read-set conflict
+checking) runs as JAX/XLA kernels on TPU, while the control plane
+(ordering, membership, lifecycle, gossip, storage) is an idiomatic host
+framework.
+
+Layer map (mirrors SURVEY.md §1 of the reference analysis):
+  crypto/   — BCCSP-style crypto SPI, MSP identities, policy compiler
+  ops/      — TPU kernels: sha256, p256 field/point, ecdsa, mvcc, policy eval
+  models/   — assembled jittable pipelines (the "flagship model" = block
+              validation pipeline)
+  parallel/ — mesh sharding of the data plane (signature fan-out, MVCC)
+  protos/   — wire format (the architecture contract between layers)
+  ledger/   — block store, state DB SPI, history, kvledger commit
+  ordering/ — blockcutter, ordering service (solo, raft)
+  peer/     — endorser, committer, chaincode runtime, peer assembly
+  utils/    — logging, metrics, config
+"""
+
+__version__ = "0.1.0"

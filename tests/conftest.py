@@ -1,0 +1,25 @@
+"""Test harness configuration.
+
+All unit tests run on a virtual 8-device CPU mesh so that sharding code
+paths (pjit/shard_map over a Mesh) are exercised without TPU hardware,
+mirroring how the driver dry-runs the multi-chip path.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    import numpy as np
+
+    return np.random.default_rng(20260729)
