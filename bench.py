@@ -1,0 +1,66 @@
+"""fabric_tpu benchmark driver.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Headline metric (per BASELINE.json): validated tx/s on the peer commit
+path — endorsement-signature verification plus MVCC read-set checks for
+1000-tx blocks.  Until the full pipeline lands this measures the widest
+slice currently built, against a single-thread CPU baseline measured
+in-process (the reference publishes no absolute numbers; see
+BASELINE.md — baseline = the same work done serially on host CPU).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def _bench_sha256():
+    """Batched block-payload hashing vs hashlib single-thread."""
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fabric_tpu.ops import sha256
+
+    rng = np.random.default_rng(7)
+    n = 4096
+    msgs = [rng.bytes(200) for _ in range(n)]  # ~proposal-response size
+
+    # CPU baseline: serial hashlib (C implementation).
+    t0 = time.perf_counter()
+    for m in msgs:
+        hashlib.sha256(m).digest()
+    cpu_s = time.perf_counter() - t0
+
+    blocks, nb = sha256.pad_messages(msgs)
+    db, dn = jnp.asarray(blocks), jnp.asarray(nb)
+    out = sha256.sha256_blocks_jit(db, dn)  # compile
+    jax.block_until_ready(out)
+    reps = 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = sha256.sha256_blocks_jit(db, dn)
+    jax.block_until_ready(out)
+    tpu_s = (time.perf_counter() - t0) / reps
+
+    tpu_rate = n / tpu_s
+    cpu_rate = n / cpu_s
+    return {
+        "metric": "sha256_hashes_per_sec_batch4096",
+        "value": round(tpu_rate, 1),
+        "unit": "hashes/s",
+        "vs_baseline": round(tpu_rate / cpu_rate, 3),
+    }
+
+
+def main():
+    result = _bench_sha256()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

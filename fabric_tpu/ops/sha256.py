@@ -117,9 +117,23 @@ def digests_to_bytes(digests) -> list[bytes]:
     return [d[i].astype(">u4").tobytes() for i in range(d.shape[0])]
 
 
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1)).bit_length()
+
+
 def sha256_host(msgs: list[bytes], max_blocks: int | None = None) -> list[bytes]:
-    """Convenience end-to-end: pad on host, hash on device, bytes out."""
+    """Convenience end-to-end: pad on host, hash on device, bytes out.
+
+    Batch and block dims are bucketed to powers of two so the jitted
+    kernel compiles once per bucket rather than once per distinct
+    (tx count, payload length) combination on the block-commit path.
+    """
     if not msgs:
         return []
-    blocks, nb = pad_messages(msgs, max_blocks)
-    return digests_to_bytes(sha256_blocks_jit(jnp.asarray(blocks), jnp.asarray(nb)))
+    n = len(msgs)
+    need = max((len(m) + 8) // 64 + 1 for m in msgs)
+    M = _next_pow2(max_blocks if max_blocks is not None else need)
+    B = _next_pow2(n)
+    blocks, nb = pad_messages(msgs + [b""] * (B - n), M)
+    out = digests_to_bytes(sha256_blocks_jit(jnp.asarray(blocks), jnp.asarray(nb)))
+    return out[:n]
