@@ -1,0 +1,139 @@
+"""Pure-Python NIST P-256 reference implementation (correctness oracle).
+
+This is the host-side oracle the TPU kernel (`fabric_tpu.ops.p256`) is
+tested bit-exactly against, and the arithmetic backing for key/cert
+generation where the `cryptography` package is not used.  Semantics
+mirror the reference's SW BCCSP verifier: ECDSA P-256 with SHA-256
+digests and the low-S rule (reference: bccsp/sw/ecdsa.go:41-58 —
+signatures with s > n/2 are rejected; signing normalizes s to low-S).
+
+Python ints only; NOT constant-time; verify-only paths don't need to be.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from dataclasses import dataclass
+
+# NIST P-256 (secp256r1) domain parameters.
+P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+A = P - 3
+B = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
+N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+GX = 0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296
+GY = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5
+HALF_N = N >> 1
+
+INF = None  # point at infinity
+
+
+def is_on_curve(pt) -> bool:
+    if pt is INF:
+        return True
+    x, y = pt
+    return (y * y - (x * x * x + A * x + B)) % P == 0
+
+
+def pt_add(p1, p2):
+    if p1 is INF:
+        return p2
+    if p2 is INF:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return INF
+        return pt_double(p1)
+    lam = ((y2 - y1) * pow(x2 - x1, -1, P)) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def pt_double(pt):
+    if pt is INF:
+        return INF
+    x, y = pt
+    if y == 0:
+        return INF
+    lam = ((3 * x * x + A) * pow(2 * y, -1, P)) % P
+    x3 = (lam * lam - 2 * x) % P
+    y3 = (lam * (x - x3) - y) % P
+    return (x3, y3)
+
+
+def pt_mul(k: int, pt):
+    k %= N
+    acc = INF
+    addend = pt
+    while k:
+        if k & 1:
+            acc = pt_add(acc, addend)
+        addend = pt_double(addend)
+        k >>= 1
+    return acc
+
+
+G = (GX, GY)
+
+
+@dataclass(frozen=True)
+class SigningKey:
+    d: int  # private scalar in [1, n-1]
+
+    @property
+    def public(self):
+        return pt_mul(self.d, G)
+
+    @classmethod
+    def generate(cls) -> "SigningKey":
+        return cls(d=secrets.randbelow(N - 1) + 1)
+
+    def sign_digest(self, e: int, k: int | None = None) -> tuple[int, int]:
+        """ECDSA sign; returns low-S normalized (r, s)."""
+        while True:
+            kk = k if k is not None else secrets.randbelow(N - 1) + 1
+            x1, _ = pt_mul(kk, G)
+            r = x1 % N
+            if r == 0:
+                if k is not None:
+                    raise ValueError("bad fixed k")
+                continue
+            s = (pow(kk, -1, N) * (e + r * self.d)) % N
+            if s == 0:
+                if k is not None:
+                    raise ValueError("bad fixed k")
+                continue
+            if s > HALF_N:
+                s = N - s  # low-S normalization (bccsp/sw/ecdsa.go ToLowS)
+            return r, s
+
+    def sign(self, msg: bytes) -> tuple[int, int]:
+        return self.sign_digest(digest_int(msg))
+
+
+def digest_int(msg: bytes) -> int:
+    return int.from_bytes(hashlib.sha256(msg).digest(), "big")
+
+
+def verify_digest(pub, e: int, r: int, s: int) -> bool:
+    """Reference verify incl. Fabric's low-S rule."""
+    if pub is INF or not (0 <= pub[0] < P and 0 <= pub[1] < P) or not is_on_curve(pub):
+        return False
+    if not (1 <= r < N and 1 <= s < N):
+        return False
+    if s > HALF_N:  # low-S enforcement per bccsp/sw/ecdsa.go:41-58
+        return False
+    w = pow(s, -1, N)
+    u1 = (e * w) % N
+    u2 = (r * w) % N
+    pt = pt_add(pt_mul(u1, G), pt_mul(u2, pub))
+    if pt is INF:
+        return False
+    return pt[0] % N == r % N
+
+
+def verify(pub, msg: bytes, r: int, s: int) -> bool:
+    return verify_digest(pub, digest_int(msg), r, s)

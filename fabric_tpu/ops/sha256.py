@@ -20,6 +20,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from fabric_tpu.utils.batching import next_pow2
+
 _K = np.array(
     [
         0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
@@ -117,10 +119,6 @@ def digests_to_bytes(digests) -> list[bytes]:
     return [d[i].astype(">u4").tobytes() for i in range(d.shape[0])]
 
 
-def _next_pow2(n: int) -> int:
-    return 1 << max(0, (n - 1)).bit_length()
-
-
 def sha256_host(msgs: list[bytes], max_blocks: int | None = None) -> list[bytes]:
     """Convenience end-to-end: pad on host, hash on device, bytes out.
 
@@ -132,8 +130,8 @@ def sha256_host(msgs: list[bytes], max_blocks: int | None = None) -> list[bytes]
         return []
     n = len(msgs)
     need = max((len(m) + 8) // 64 + 1 for m in msgs)
-    M = _next_pow2(max_blocks if max_blocks is not None else need)
-    B = _next_pow2(n)
+    M = next_pow2(max_blocks if max_blocks is not None else need)
+    B = next_pow2(n)
     blocks, nb = pad_messages(msgs + [b""] * (B - n), M)
     out = digests_to_bytes(sha256_blocks_jit(jnp.asarray(blocks), jnp.asarray(nb)))
     return out[:n]

@@ -1,0 +1,15 @@
+"""Batch-shape bucketing shared by the TPU data-plane kernels.
+
+Kernels compile once per static shape; bucketing batch sizes to powers
+of two bounds the number of compilations on the block-commit path
+(block tx counts vary per block — reference:
+orderer/common/blockcutter/blockcutter.go:74-130 cuts variable-size
+batches).
+"""
+
+from __future__ import annotations
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (>= 1)."""
+    return 1 << max(0, (n - 1)).bit_length()

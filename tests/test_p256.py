@@ -1,0 +1,188 @@
+"""Bit-exactness tests for the TPU ECDSA P-256 kernel vs host oracles.
+
+Oracles: fabric_tpu.crypto.ec_ref (pure-Python ints) and the
+`cryptography` package (OpenSSL) for signature generation cross-checks.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from fabric_tpu.crypto import ec_ref
+from fabric_tpu.ops import p256
+
+
+def _rand_ints(rng, n, bound):
+    return [int.from_bytes(rng.bytes(40), "big") % bound for _ in range(n)]
+
+
+def test_limb_roundtrip(rng):
+    xs = _rand_ints(rng, 8, 1 << 256)
+    arr = p256.ints_to_limbs(xs)
+    assert p256.limbs_to_ints(arr) == xs
+
+
+@pytest.mark.parametrize("mod", [p256.MODP, p256.MODN])
+def test_mont_mul_matches_int(rng, mod):
+    n = 16
+    a = _rand_ints(rng, n, mod.m)
+    b = _rand_ints(rng, n, mod.m)
+    am = [mod.to_mont_int(x) for x in a]
+    bm = [mod.to_mont_int(x) for x in b]
+    out = p256._mont_mul(
+        jnp.asarray(p256.ints_to_limbs(am)), jnp.asarray(p256.ints_to_limbs(bm)), mod
+    )
+    got = p256.limbs_to_ints(out)
+    want = [mod.to_mont_int(x * y % mod.m) for x, y in zip(a, b)]
+    assert got == want
+
+
+@pytest.mark.parametrize("mod", [p256.MODP, p256.MODN])
+def test_add_sub_mod(rng, mod):
+    n = 16
+    a = _rand_ints(rng, n, mod.m)
+    b = _rand_ints(rng, n, mod.m)
+    da, db = jnp.asarray(p256.ints_to_limbs(a)), jnp.asarray(p256.ints_to_limbs(b))
+    assert p256.limbs_to_ints(p256._add_mod(da, db, mod)) == [
+        (x + y) % mod.m for x, y in zip(a, b)
+    ]
+    assert p256.limbs_to_ints(p256._sub_mod(da, db, mod)) == [
+        (x - y) % mod.m for x, y in zip(a, b)
+    ]
+
+
+def test_mont_pow_inverse(rng):
+    mod = p256.MODN
+    a = _rand_ints(rng, 8, mod.m - 1)
+    a = [x + 1 for x in a]
+    am = jnp.asarray(p256.ints_to_limbs([mod.to_mont_int(x) for x in a]))
+    inv = p256._mont_pow_const(am, p256.N - 2, mod)
+    got = p256.limbs_to_ints(p256._from_mont(inv, mod))
+    want = [pow(x, -1, mod.m) for x in a]
+    assert got == want
+
+
+def _to_affine(X, Y, Z):
+    """Host-side Jacobian→affine for test comparison."""
+    xs, ys, zs = (p256.limbs_to_ints(p256._from_mont(v, p256.MODP)) for v in (X, Y, Z))
+    out = []
+    for x, y, z in zip(xs, ys, zs):
+        if z == 0:
+            out.append(None)
+        else:
+            zi = pow(z, -1, p256.P)
+            out.append(((x * zi * zi) % p256.P, (y * zi * zi * zi) % p256.P))
+    return out
+
+
+def _jacobian(points):
+    """affine points (or None=∞) → Montgomery Jacobian device arrays."""
+    mp = p256.MODP
+    xs = [mp.to_mont_int(pt[0]) if pt else 0 for pt in points]
+    ys = [mp.to_mont_int(pt[1]) if pt else 0 for pt in points]
+    zs = [(1 << 256) % p256.P if pt else 0 for pt in points]
+    return (
+        jnp.asarray(p256.ints_to_limbs(xs)),
+        jnp.asarray(p256.ints_to_limbs(ys)),
+        jnp.asarray(p256.ints_to_limbs(zs)),
+    )
+
+
+def test_point_double_matches_ref(rng):
+    pts = [ec_ref.pt_mul(k + 1, ec_ref.G) for k in _rand_ints(rng, 8, p256.N - 1)]
+    pts.append(None)  # ∞
+    X, Y, Z = _jacobian(pts)
+    got = _to_affine(*p256._pt_double(X, Y, Z))
+    want = [ec_ref.pt_double(pt) for pt in pts]
+    assert got == want
+
+
+def test_point_add_matches_ref(rng):
+    ks = _rand_ints(rng, 6, p256.N - 1)
+    p1 = [ec_ref.pt_mul(k + 1, ec_ref.G) for k in ks]
+    p2 = [ec_ref.pt_mul(3 * k + 7, ec_ref.G) for k in ks]
+    # edge cases: ∞+P, P+∞, P+P (doubling), P+(-P) (→∞)
+    q = ec_ref.pt_mul(12345, ec_ref.G)
+    qneg = (q[0], p256.P - q[1])
+    p1 += [None, q, q, q]
+    p2 += [q, None, q, qneg]
+    X1, Y1, Z1 = _jacobian(p1)
+    X2, Y2, Z2 = _jacobian(p2)
+    got = _to_affine(*p256._pt_add(X1, Y1, Z1, X2, Y2, Z2))
+    want = [ec_ref.pt_add(a, b) for a, b in zip(p1, p2)]
+    assert got == want
+
+
+def test_verify_batch_valid_and_corrupted(rng):
+    keys = [ec_ref.SigningKey(d=_rand_ints(rng, 1, p256.N - 1)[0] + 1) for _ in range(4)]
+    items, want = [], []
+    for i in range(16):
+        sk = keys[i % len(keys)]
+        msg = b"payload-%d" % i
+        e = ec_ref.digest_int(msg)
+        r, s = sk.sign_digest(e)
+        qx, qy = sk.public
+        kind = i % 4
+        if kind == 0:  # valid
+            items.append((e, r, s, qx, qy))
+            want.append(True)
+        elif kind == 1:  # corrupted digest
+            items.append((e ^ 1, r, s, qx, qy))
+            want.append(False)
+        elif kind == 2:  # corrupted s
+            items.append((e, r, (s + 1) % p256.N, qx, qy))
+            want.append(False)
+        else:  # wrong key
+            ox, oy = keys[(i + 1) % len(keys)].public
+            items.append((e, r, s, ox, oy))
+            want.append(False)
+    got = p256.verify_host(items)
+    assert got == want
+    # agree with the pure-python oracle on every case
+    for (e, r, s, qx, qy), g in zip(items, got):
+        assert ec_ref.verify_digest((qx, qy), e, r, s) == g
+
+
+def test_verify_rejects_high_s_and_degenerate(rng):
+    sk = ec_ref.SigningKey.generate()
+    e = ec_ref.digest_int(b"low-s test")
+    r, s = sk.sign_digest(e)
+    qx, qy = sk.public
+    high_s = p256.N - s  # valid ECDSA but high-S: must be rejected
+    items = [
+        (e, r, s, qx, qy),
+        (e, r, high_s, qx, qy),
+        (e, 0, s, qx, qy),
+        (e, r, 0, qx, qy),
+        (e, p256.N, s, qx, qy),
+        (e, r, s, qx, (qy + 1) % p256.P),  # off-curve key
+    ]
+    want = [True, False, False, False, False, False]
+    # pad to the shared 16-wide bucket so the suite compiles one kernel
+    items += [(e, r, s, qx, qy)] * (16 - len(items))
+    want += [True] * (16 - len(want))
+    assert p256.verify_host(items) == want
+
+
+def test_verify_against_openssl_generated():
+    """Cross-check with OpenSSL-generated (non-low-S-normalized) sigs."""
+    from cryptography.hazmat.primitives.asymmetric import ec as cec
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric.utils import decode_dss_signature
+
+    items, want = [], []
+    for i in range(16):
+        key = cec.generate_private_key(cec.SECP256R1())
+        pub = key.public_key().public_numbers()
+        msg = b"openssl-%d" % i
+        sig = key.sign(msg, cec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(sig)
+        if s > p256.HALF_N:
+            s = p256.N - s  # normalize as the reference signer does
+        e = int.from_bytes(hashlib.sha256(msg).digest(), "big")
+        items.append((e, r, s, pub.x, pub.y))
+        want.append(True)
+    assert p256.verify_host(items) == want
