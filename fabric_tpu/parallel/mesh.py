@@ -1,0 +1,44 @@
+"""Mesh sharding of the block-validation data plane.
+
+The reference parallelizes block validation with a goroutine-per-tx
+worker pool on one host (core/committer/txvalidator/v20/validator.go:
+193-208, pool size peer.validatorPoolSize).  The TPU-native analog
+shards the *batch* dimension of the data-plane kernels (signature
+verify, hashing, MVCC) across a device mesh: every chip verifies a
+slice of the block's signatures, and the validity bits are gathered by
+XLA collectives over ICI — the "N-of-M policy parallelism" row of the
+reference's parallelism inventory (SURVEY.md §2.10).
+
+One axis ("data") suffices for the commit path: block batches are
+embarrassingly parallel and the reduction (per-tx policy evaluation)
+is a tiny boolean tree evaluated after an all-gather.  Multi-host
+deployments replicate the whole pipeline per peer (the reference's
+distributed-replication model), so the mesh spans one peer's chips.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def data_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` local devices."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), axis_names=("data",))
+
+
+def batch_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Shard axis 0 (the batch/tx dim) over "data"; replicate the rest."""
+    return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))
+
+
+def shard_args(mesh: Mesh, *arrays):
+    """Device-put arrays with axis-0 sharded over the mesh."""
+    return tuple(
+        jax.device_put(a, batch_sharding(mesh, a.ndim)) for a in arrays
+    )

@@ -1,0 +1,35 @@
+"""Process-level XLA environment knobs.
+
+Import-light on purpose (no jax import): callers must apply these
+BEFORE jax initializes its backends (tests/conftest.py,
+__graft_entry__.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_cpu_compile_workaround() -> None:
+    """Disable the jax 0.9 CPU fusion emitters.
+
+    They blow up superlinearly on the deep uint32 dependency chains of
+    the crypto kernels (a 64-round SHA-256 compression never finishes
+    compiling on a 1-core host); the legacy emitter compiles it in ~2s.
+    Harmless for the TPU backend.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_cpu_use_fusion_emitters" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_cpu_use_fusion_emitters=false"
+        ).strip()
+
+
+def ensure_host_device_count(n: int) -> None:
+    """Request ``n`` virtual host-platform devices (no-op if any count
+    is already configured)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()

@@ -3,17 +3,37 @@
 All unit tests run on a virtual 8-device CPU mesh so that sharding code
 paths (pjit/shard_map over a Mesh) are exercised without TPU hardware,
 mirroring how the driver dry-runs the multi-chip path.
+
+The axon sitecustomize registers the tunneled real-TPU backend in every
+python process and sets jax_platforms="axon,cpu" via jax.config —
+overriding the JAX_PLATFORMS env var.  Tests must never touch the real
+chip (per-shape compiles take minutes and the tunnel is single-client),
+so we force the config back to cpu BEFORE any backend initialization.
 """
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+from fabric_tpu.utils.xla_env import (
+    ensure_cpu_compile_workaround,
+    ensure_host_device_count,
+)
+
+# Belt: env for any subprocesses tests may spawn.
+os.environ["JAX_PLATFORMS"] = "cpu"
+ensure_host_device_count(8)
+ensure_cpu_compile_workaround()
+
+# Suspenders: the axon register() already ran (sitecustomize) and set
+# jax_platforms="axon,cpu"; override it back before backends init.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Persistent compile cache: the P-256 verify graph takes ~8 min to
+# compile on a 1-core host; cache it across test runs.
+jax.config.update("jax_compilation_cache_dir", str(os.path.join(os.path.dirname(__file__), "..", ".jax_cache")))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 import pytest  # noqa: E402
 
