@@ -1,0 +1,290 @@
+"""Signature-policy engine: AST, DSL, compiler → batch plan, interpreter.
+
+The reference compiles a SignaturePolicyEnvelope proto into a tree of
+Go closures evaluated per transaction with short-circuiting and
+signature *consumption* (each endorsement satisfies at most one
+SignedBy leaf) — common/cauthdsl/cauthdsl.go:24-110, policy.go:86 —
+plus a text DSL ``AND('Org1.member', ...)`` (common/policydsl).
+
+The TPU-first redesign flattens the tree into a *batch plan*: a list of
+principals (leaf columns) plus a post-order gate array, so that policy
+evaluation over a whole block becomes array ops on the boolean
+signature-validity vector produced by the batched ECDSA kernel
+(fabric_tpu.ops.p256) — see fabric_tpu.ops.policy_eval.
+
+Two evaluators:
+
+* ``evaluate`` — exact sequential interpreter with the reference's
+  greedy consumption semantics (the oracle, and the fallback for
+  adversarial cases where one signature satisfies multiple leaves).
+* the batch kernel path — exact whenever no signature satisfies two
+  distinct leaf principals (the overwhelming case: org-scoped
+  endorsement policies).  ``plan.consumption_safe(match)`` checks this
+  per transaction at run time, so the fast path is taken per-tx, never
+  unsoundly.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+# ---------------------------------------------------------------------------
+# Principals (subset mirroring msp.MSPPrincipal: ROLE / OU / IDENTITY)
+
+ROLE_MEMBER = "member"
+ROLE_ADMIN = "admin"
+ROLE_CLIENT = "client"
+ROLE_PEER = "peer"
+ROLE_ORDERER = "orderer"
+_ROLES = {ROLE_MEMBER, ROLE_ADMIN, ROLE_CLIENT, ROLE_PEER, ROLE_ORDERER}
+
+
+@dataclass(frozen=True)
+class Principal:
+    """msp_id + role principal (msp/mspimpl.go:425 SatisfiesPrincipal)."""
+
+    msp_id: str
+    role: str = ROLE_MEMBER
+
+    def matched_by(self, identity) -> bool:
+        """identity: any object with .msp_id, .role ('admin'/'client'/
+        'peer'/...), and .is_valid (cert-chain validity)."""
+        if identity.msp_id != self.msp_id or not getattr(identity, "is_valid", True):
+            return False
+        if self.role == ROLE_MEMBER:
+            return True
+        return getattr(identity, "role", None) == self.role
+
+
+# ---------------------------------------------------------------------------
+# Policy AST
+
+
+@dataclass(frozen=True)
+class SignedBy:
+    principal: Principal
+
+
+@dataclass(frozen=True)
+class NOutOf:
+    n: int
+    rules: tuple
+
+    def __post_init__(self):
+        if not (0 <= self.n <= len(self.rules)):
+            raise ValueError(f"NOutOf({self.n}) over {len(self.rules)} rules")
+
+
+def And(*rules):
+    return NOutOf(len(rules), tuple(rules))
+
+
+def Or(*rules):
+    return NOutOf(1, tuple(rules))
+
+
+# ---------------------------------------------------------------------------
+# Text DSL: AND('Org1.member', OR('Org2.admin', 'Org3.peer')),
+# OutOf(2, 'A.member', 'B.member', 'C.member')  (common/policydsl grammar)
+
+_PRINCIPAL_RE = re.compile(r"^([A-Za-z0-9._-]+)\.(\w+)$")
+
+
+def from_dsl(text: str):
+    """Parse the policydsl grammar into the AST."""
+    text = text.strip()
+    tokens = re.findall(r"[A-Za-z]+\(|\)|,|'[^']*'|\"[^\"]*\"|\d+", text)
+    pos = 0
+
+    def parse():
+        nonlocal pos
+        tok = tokens[pos]
+        if tok.endswith("("):
+            op = tok[:-1].upper()
+            pos += 1
+            args = []
+            while tokens[pos] != ")":
+                if tokens[pos] == ",":
+                    pos += 1
+                    continue
+                args.append(parse())
+            pos += 1  # consume ')'
+            if op == "AND":
+                return And(*args)
+            if op == "OR":
+                return Or(*args)
+            if op == "OUTOF":
+                n = args[0]
+                if not isinstance(n, int):
+                    raise ValueError("OutOf needs integer first arg")
+                return NOutOf(n, tuple(args[1:]))
+            raise ValueError(f"unknown op {op}")
+        if tok.isdigit():
+            pos += 1
+            return int(tok)
+        if tok[0] in "'\"":
+            pos += 1
+            m = _PRINCIPAL_RE.match(tok[1:-1])
+            if not m:
+                raise ValueError(f"bad principal {tok}")
+            msp_id, role = m.groups()
+            if role not in _ROLES:
+                raise ValueError(f"bad role {role}")
+            return SignedBy(Principal(msp_id, role))
+        raise ValueError(f"unexpected token {tok}")
+
+    rule = parse()
+    if pos != len(tokens) or isinstance(rule, int):
+        raise ValueError(f"trailing tokens in policy: {text}")
+    return rule
+
+
+# ---------------------------------------------------------------------------
+# Batch plan: flattened post-order gate program
+
+
+@dataclass
+class BatchPlan:
+    """Flattened policy for array evaluation.
+
+    principals: leaf columns, deduplicated.
+    leaf_principal: for each leaf node, its column in ``principals``.
+    gates: post-order list of (n, child_slots) where child_slots index
+        into the value vector: slots [0, n_leaves) are leaves, then one
+        slot per gate in order.  The last gate is the root.
+    A tree that is a bare SignedBy gets a single 1-of-1 gate.
+    """
+
+    principals: list = field(default_factory=list)
+    leaf_principal: list = field(default_factory=list)
+    gates: list = field(default_factory=list)
+
+    @property
+    def n_leaves(self) -> int:
+        return len(self.leaf_principal)
+
+    def leaf_sat(self, match):
+        """match: [S, P] bool (sig × principal) → [n_leaves] bool."""
+        import numpy as np
+
+        m = np.asarray(match)
+        if m.size == 0:
+            return np.zeros(self.n_leaves, bool)
+        anyp = m.any(axis=0)  # [P]
+        return anyp[np.asarray(self.leaf_principal, int)]
+
+    def evaluate_counts(self, match) -> bool:
+        """Count-based evaluation (no consumption): exact when
+        ``consumption_safe``."""
+        import numpy as np
+
+        vals = list(self.leaf_sat(match))
+        for n, children in self.gates:
+            vals.append(sum(bool(vals[c]) for c in children) >= n)
+        return bool(vals[-1])
+
+    def consumption_safe(self, match) -> bool:
+        """True if no signature satisfies two distinct leaf principals
+        (then count semantics == the reference's consumption
+        semantics)."""
+        import numpy as np
+
+        m = np.asarray(match)
+        if m.size == 0:
+            return True
+        cols = np.asarray(sorted(set(self.leaf_principal)), int)
+        return bool((m[:, cols].sum(axis=1) <= 1).all())
+
+
+def compile_plan(rule) -> BatchPlan:
+    """Flatten the AST into a BatchPlan (contrast cauthdsl's closure
+    compiler: the output is data, not code)."""
+    plan = BatchPlan()
+    pindex: dict = {}
+
+    def leaf_col(principal: Principal) -> int:
+        if principal not in pindex:
+            pindex[principal] = len(plan.principals)
+            plan.principals.append(principal)
+        return pindex[principal]
+
+    # first pass: count leaves to lay out slots
+    def walk(node):
+        if isinstance(node, SignedBy):
+            slot = plan.n_leaves
+            plan.leaf_principal.append(leaf_col(node.principal))
+            return ("leaf", slot)
+        if isinstance(node, NOutOf):
+            children = [walk(r) for r in node.rules]
+            return ("gate", node.n, children)
+        raise TypeError(f"bad policy node {node!r}")
+
+    tree = walk(rule)
+    n_leaves = plan.n_leaves
+
+    def emit(node) -> int:
+        if node[0] == "leaf":
+            return node[1]
+        _, n, children = node
+        slots = [emit(c) for c in children]
+        plan.gates.append((n, slots))
+        return n_leaves + len(plan.gates) - 1
+
+    root = emit(tree)
+    if not plan.gates or root != n_leaves + len(plan.gates) - 1:
+        # bare SignedBy root: wrap in a 1-of-1 gate
+        plan.gates.append((1, [root]))
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# Exact interpreter (the reference's consumption semantics)
+
+
+def evaluate(rule, match) -> bool:
+    """Evaluate with greedy signature consumption.
+
+    match: [S, P_all] bool where columns follow ``compile_plan(rule)
+    .principals`` — use ``match_matrix`` to build it.  Mirrors
+    cauthdsl.go:39-110: SignedBy consumes the first unused matching
+    signature; NOutOf evaluates ALL children left-to-right (no
+    short-circuit — every satisfied child consumes its signature) and
+    compares the count against n.
+    """
+    import numpy as np
+
+    plan = compile_plan(rule)
+    pindex = {p: i for i, p in enumerate(plan.principals)}
+    m = np.asarray(match)
+    S = m.shape[0] if m.size else 0
+    used = [False] * S
+
+    def ev(node) -> bool:
+        if isinstance(node, SignedBy):
+            col = pindex[node.principal]
+            for s in range(S):
+                if not used[s] and m[s, col]:
+                    used[s] = True
+                    return True
+            return False
+        count = 0
+        for r in node.rules:
+            if ev(r):
+                count += 1
+        return count >= node.n
+
+    root = rule if isinstance(rule, NOutOf) else NOutOf(1, (rule,))
+    return ev(root)
+
+
+def match_matrix(identities, principals) -> "np.ndarray":
+    """[S, P] bool: identity s satisfies principal p (host-side MSP
+    SatisfiesPrincipal batch)."""
+    import numpy as np
+
+    return np.array(
+        [[p.matched_by(ident) for p in principals] for ident in identities],
+        dtype=bool,
+    ).reshape(len(identities), len(principals))

@@ -1,0 +1,71 @@
+"""Batched endorsement-policy evaluation as array ops.
+
+The reference evaluates each tx's policy tree sequentially over its
+endorsements, verifying ECDSA signatures INSIDE the tree walk
+(common/cauthdsl/cauthdsl.go:24-110 — each SignedBy leaf calls
+SatisfiesPrincipal + Verify).  The TPU-first reordering (SURVEY §2.10
+last row): verify ALL of the block's signatures in one batched kernel
+(ops/p256), then evaluate every tx's policy as a boolean reduction
+over the validity vector — compute first, control flow after.
+
+Shapes: a block has T txs, each with up to S endorsement slots; the
+channel's policies are compiled to BatchPlans (crypto/policy.py) whose
+leaves reference principal columns.  Per tx we get
+
+    sat[t, s, p]  =  principal-match (host MSP) for endorsement slot s
+    valid[t, s]   =  batched signature validity (device)
+
+and the kernel computes leaf truth  any_s(valid & sat)  then folds the
+gate program — all [T, ...]-shaped elementwise ops, one dispatch per
+distinct policy shape (policies are cached per channel+namespace like
+the reference's PluginValidator cache, plugin_validator.go).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("gates",))
+def eval_plan_batch(valid, sat, leaf_principal, gates):
+    """Evaluate one policy plan over a batch of transactions.
+
+    valid: [T, S] bool — signature validity per endorsement slot
+        (False for empty slots).
+    sat:   [T, S, P] bool — slot s satisfies principal column p.
+    leaf_principal: [L] int32 — principal column per leaf.
+    gates: static tuple of (n, child_slots) — slots < L are leaves,
+        slot L+i is gate i; last gate is the root.
+
+    Returns ok [T] bool.
+    """
+    hit = valid[:, :, None] & sat  # [T, S, P]
+    any_p = jnp.any(hit, axis=1)  # [T, P]
+    leaf = jnp.take(any_p, leaf_principal, axis=1)  # [T, L]
+    vals = [leaf[:, i] for i in range(leaf.shape[1])]
+    for n, children in gates:
+        acc = jnp.zeros(valid.shape[0], jnp.int32)
+        for c in children:
+            acc = acc + vals[c].astype(jnp.int32)
+        vals.append(acc >= n)
+    return vals[-1]
+
+
+def eval_block(plan, valid, sat):
+    """Host wrapper: evaluate ``plan`` for every tx of a block.
+
+    plan: crypto.policy.BatchPlan
+    valid: [T, S] bool (numpy or device)
+    sat: [T, S, P] bool principal-match tensor
+    """
+    gates = tuple((n, tuple(children)) for n, children in plan.gates)
+    return eval_plan_batch(
+        jnp.asarray(valid),
+        jnp.asarray(sat),
+        jnp.asarray(np.asarray(plan.leaf_principal, np.int32)),
+        gates,
+    )
