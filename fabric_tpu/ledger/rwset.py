@@ -1,0 +1,163 @@
+"""Read/write-set build & parse (analog of the reference's rwsetutil,
+core/ledger/kvledger/txmgmt/rwsetutil/rwset_proto_util.go).
+
+Two representations:
+
+* proto wire form (fabric_tpu.protos.rwset_pb2) — what travels inside
+  ChaincodeAction.results;
+* host form (``TxRWSet`` below) — namespace-keyed dict of reads/writes/
+  range-queries that the simulator builds and the MVCC preparation
+  (fabric_tpu.ops.mvcc.prepare_block) flattens into device arrays.
+
+Hashed private-collection reads/writes (reference: validator.go:249-283)
+carry (namespace, collection, key_hash) keys — disjoint from public
+(namespace, key) keys by construction of the key tuples.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from fabric_tpu.protos import rwset_pb2
+
+
+Version = tuple[int, int]  # (block_num, tx_num)
+
+
+@dataclass
+class NsRWSet:
+    reads: dict = field(default_factory=dict)        # key -> Version | None
+    writes: dict = field(default_factory=dict)       # key -> bytes | None (None = delete)
+    range_queries: list = field(default_factory=list)  # (start, end, [(key, ver)])
+    metadata_writes: dict = field(default_factory=dict)  # key -> {name: bytes}
+    # collection -> {"reads": {key_hash: ver}, "writes": {key_hash: (value_hash, is_delete)}}
+    hashed: dict = field(default_factory=dict)
+
+
+@dataclass
+class TxRWSet:
+    ns: dict = field(default_factory=dict)  # namespace -> NsRWSet
+
+    def ns_rwset(self, namespace: str) -> NsRWSet:
+        return self.ns.setdefault(namespace, NsRWSet())
+
+    # -- wire form ---------------------------------------------------------
+
+    def to_proto(self) -> rwset_pb2.TxReadWriteSet:
+        out = rwset_pb2.TxReadWriteSet(data_model=rwset_pb2.TxReadWriteSet.KV)
+        for name in sorted(self.ns):
+            n = self.ns[name]
+            kv = rwset_pb2.KVRWSet()
+            for k in sorted(n.reads):
+                r = kv.reads.add(key=k)
+                ver = n.reads[k]
+                if ver is not None:
+                    r.version.block_num, r.version.tx_num = ver
+            for start, end, results in n.range_queries:
+                rq = kv.range_queries_info.add(
+                    start_key=start, end_key=end, itr_exhausted=True
+                )
+                for k, ver in results:
+                    r = rq.raw_reads.kv_reads.add(key=k)
+                    if ver is not None:
+                        r.version.block_num, r.version.tx_num = ver
+            for k in sorted(n.writes):
+                v = n.writes[k]
+                kv.writes.add(key=k, is_delete=v is None, value=v or b"")
+            for k in sorted(n.metadata_writes):
+                mw = kv.metadata_writes.add(key=k)
+                for mname in sorted(n.metadata_writes[k]):
+                    mw.entries.add(name=mname, value=n.metadata_writes[k][mname])
+            ns_pb = out.ns_rwset.add(namespace=name, rwset=kv.SerializeToString())
+            for coll in sorted(n.hashed):
+                h = rwset_pb2.HashedRWSet()
+                cdata = n.hashed[coll]
+                for kh in sorted(cdata.get("reads", {})):
+                    hr = h.hashed_reads.add(key_hash=kh)
+                    ver = cdata["reads"][kh]
+                    if ver is not None:
+                        hr.version.block_num, hr.version.tx_num = ver
+                for kh in sorted(cdata.get("writes", {})):
+                    vh, is_del = cdata["writes"][kh]
+                    h.hashed_writes.add(key_hash=kh, value_hash=vh, is_delete=is_del)
+                ns_pb.collection_hashed_rwset.add(
+                    collection_name=coll,
+                    hashed_rwset=h.SerializeToString(),
+                    pvt_rwset_hash=cdata.get("pvt_hash", b""),
+                )
+        return out
+
+    @classmethod
+    def from_proto(cls, pb: rwset_pb2.TxReadWriteSet) -> "TxRWSet":
+        tx = cls()
+        for ns_pb in pb.ns_rwset:
+            n = tx.ns_rwset(ns_pb.namespace)
+            kv = rwset_pb2.KVRWSet()
+            kv.ParseFromString(ns_pb.rwset)
+            for r in kv.reads:
+                n.reads[r.key] = (
+                    (r.version.block_num, r.version.tx_num)
+                    if r.HasField("version")
+                    else None
+                )
+            for rq in kv.range_queries_info:
+                results = [
+                    (
+                        r.key,
+                        (r.version.block_num, r.version.tx_num)
+                        if r.HasField("version")
+                        else None,
+                    )
+                    for r in rq.raw_reads.kv_reads
+                ]
+                n.range_queries.append((rq.start_key, rq.end_key, results))
+            for w in kv.writes:
+                n.writes[w.key] = None if w.is_delete else w.value
+            for mw in kv.metadata_writes:
+                n.metadata_writes[mw.key] = {e.name: e.value for e in mw.entries}
+            for coll in ns_pb.collection_hashed_rwset:
+                h = rwset_pb2.HashedRWSet()
+                h.ParseFromString(coll.hashed_rwset)
+                cdata = {"reads": {}, "writes": {}, "pvt_hash": coll.pvt_rwset_hash}
+                for hr in h.hashed_reads:
+                    cdata["reads"][hr.key_hash] = (
+                        (hr.version.block_num, hr.version.tx_num)
+                        if hr.HasField("version")
+                        else None
+                    )
+                for hw in h.hashed_writes:
+                    cdata["writes"][hw.key_hash] = (hw.value_hash, hw.is_delete)
+                n.hashed[coll.collection_name] = cdata
+        return tx
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "TxRWSet":
+        pb = rwset_pb2.TxReadWriteSet()
+        pb.ParseFromString(data)
+        return cls.from_proto(pb)
+
+    # -- MVCC kernel form --------------------------------------------------
+
+    def mvcc_form(self):
+        """→ (reads, writes, range_reads) with composite keys for
+        fabric_tpu.ops.mvcc.TxRWSet.  Public keys are ('pub', ns, key);
+        hashed collection keys ('pvt', ns, coll, key_hash) — disjoint
+        spaces, one dense id universe per block."""
+        reads, writes, rqs = [], [], []
+        for name in sorted(self.ns):
+            n = self.ns[name]
+            for k, ver in sorted(n.reads.items()):
+                reads.append((("pub", name, k), ver))
+            for k in sorted(n.writes):
+                writes.append(("pub", name, k))
+            for start, end, results in n.range_queries:
+                for k, ver in results:
+                    reads.append((("pub", name, k), ver))
+                rqs.append((("pub", name, start), ("pub", name, end)))
+            for coll in sorted(n.hashed):
+                cdata = n.hashed[coll]
+                for kh, ver in sorted(cdata.get("reads", {}).items()):
+                    reads.append((("pvt", name, coll, kh), ver))
+                for kh in sorted(cdata.get("writes", {})):
+                    writes.append(("pvt", name, coll, kh))
+        return reads, writes, rqs

@@ -1,0 +1,250 @@
+"""Wire-format helpers (the analog of the reference's protoutil/ package).
+
+Builders and extractors for envelopes, transactions and blocks, plus
+the two hashes that anchor the chain:
+
+* block data hash = SHA-256 over the concatenated serialized envelopes
+  (reference: protoutil/blockutils.go BlockDataHash), batchable on TPU
+  via fabric_tpu.ops.sha256;
+* block header hash = SHA-256 over the ASN.1-DER encoding of
+  (number, previous_hash, data_hash) (reference:
+  protoutil/blockutils.go BlockHeaderBytes) — hand-rolled DER here,
+  ~20 lines, no ASN.1 dependency.
+
+Also the TRANSACTIONS_FILTER helpers (reference: internal/pkg/txflags)
+— the validity-code byte array the TPU validator writes back into
+block metadata.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+
+from fabric_tpu.protos import common_pb2, proposal_pb2, transaction_pb2
+
+
+# ---------------------------------------------------------------------------
+# Minimal DER (only what the header hash needs)
+
+
+def _der_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    body = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([0x80 | len(body)]) + body
+
+
+def _der_int(x: int) -> bytes:
+    if x == 0:
+        body = b"\x00"
+    else:
+        body = x.to_bytes((x.bit_length() + 8) // 8, "big")  # leading 0 if MSB set
+        if body[0] == 0 and len(body) > 1 and body[1] < 0x80:
+            body = body[1:]
+    return b"\x02" + _der_len(len(body)) + body
+
+
+def _der_octets(b: bytes) -> bytes:
+    return b"\x04" + _der_len(len(b)) + b
+
+
+def block_header_bytes(header: common_pb2.BlockHeader) -> bytes:
+    body = (
+        _der_int(header.number)
+        + _der_octets(header.previous_hash)
+        + _der_octets(header.data_hash)
+    )
+    return b"\x30" + _der_len(len(body)) + body
+
+
+def block_header_hash(header: common_pb2.BlockHeader) -> bytes:
+    return hashlib.sha256(block_header_bytes(header)).digest()
+
+
+def block_data_hash(data: common_pb2.BlockData) -> bytes:
+    return hashlib.sha256(b"".join(data.data)).digest()
+
+
+# ---------------------------------------------------------------------------
+# IDs, nonces, signed data
+
+
+def random_nonce() -> bytes:
+    return os.urandom(24)
+
+
+def compute_tx_id(nonce: bytes, creator: bytes) -> str:
+    return hashlib.sha256(nonce + creator).hexdigest()
+
+
+def serialized_identity(msp_id: str, cert_pem: bytes) -> bytes:
+    return common_pb2.SerializedIdentity(mspid=msp_id, id_bytes=cert_pem).SerializeToString()
+
+
+class SignedData:
+    """(data, identity, signature) triple — the unit the policy engine
+    evaluates (reference: protoutil/signeddata.go:25-31)."""
+
+    __slots__ = ("data", "identity", "signature")
+
+    def __init__(self, data: bytes, identity: bytes, signature: bytes):
+        self.data = data
+        self.identity = identity
+        self.signature = signature
+
+
+def envelope_as_signed_data(env: common_pb2.Envelope) -> SignedData:
+    payload = common_pb2.Payload()
+    payload.ParseFromString(env.payload)
+    sh = common_pb2.SignatureHeader()
+    sh.ParseFromString(payload.header.signature_header)
+    return SignedData(env.payload, sh.creator, env.signature)
+
+
+# ---------------------------------------------------------------------------
+# Header/envelope builders
+
+
+def make_channel_header(
+    htype: int,
+    channel_id: str,
+    tx_id: str = "",
+    epoch: int = 0,
+    extension: bytes = b"",
+    version: int = 0,
+) -> common_pb2.ChannelHeader:
+    ch = common_pb2.ChannelHeader(
+        type=htype,
+        version=version,
+        channel_id=channel_id,
+        tx_id=tx_id,
+        epoch=epoch,
+        extension=extension,
+    )
+    now = time.time()
+    ch.timestamp.seconds = int(now)
+    ch.timestamp.nanos = int((now % 1) * 1e9)
+    return ch
+
+
+def make_signature_header(creator: bytes, nonce: bytes) -> common_pb2.SignatureHeader:
+    return common_pb2.SignatureHeader(creator=creator, nonce=nonce)
+
+
+def make_payload(ch, sh, data: bytes) -> common_pb2.Payload:
+    return common_pb2.Payload(
+        header=common_pb2.Header(
+            channel_header=ch.SerializeToString(),
+            signature_header=sh.SerializeToString(),
+        ),
+        data=data,
+    )
+
+
+def sign_envelope(payload: common_pb2.Payload, signer) -> common_pb2.Envelope:
+    """signer: object with .sign(bytes) -> bytes."""
+    pb = payload.SerializeToString()
+    return common_pb2.Envelope(payload=pb, signature=signer.sign(pb))
+
+
+def unmarshal(msg_cls, data: bytes):
+    m = msg_cls()
+    m.ParseFromString(data)
+    return m
+
+
+# ---------------------------------------------------------------------------
+# Block assembly
+
+
+def new_block(number: int, previous_hash: bytes) -> common_pb2.Block:
+    blk = common_pb2.Block()
+    blk.header.number = number
+    blk.header.previous_hash = previous_hash
+    for _ in range(len(common_pb2.BlockMetadataIndex.keys())):
+        blk.metadata.metadata.append(b"")
+    return blk
+
+
+def finalize_block(blk: common_pb2.Block) -> common_pb2.Block:
+    blk.header.data_hash = block_data_hash(blk.data)
+    return blk
+
+
+# ---------------------------------------------------------------------------
+# Transaction extraction (the commit pipeline's parse path)
+
+
+class TxParseError(Exception):
+    def __init__(self, code: int, msg: str):
+        super().__init__(msg)
+        self.code = code
+
+
+def extract_envelope(block: common_pb2.Block, idx: int) -> common_pb2.Envelope:
+    return unmarshal(common_pb2.Envelope, block.data.data[idx])
+
+
+def extract_action(env: common_pb2.Envelope):
+    """Envelope → (channel_header, signature_header, ChaincodeActionPayload,
+    ProposalResponsePayload, ChaincodeAction) for an endorser tx.
+
+    Raises TxParseError with the matching TxValidationCode on malformed
+    structures (reference: core/common/validation/msgvalidation.go:248).
+    """
+    C = transaction_pb2.TxValidationCode
+    if not env.payload:
+        raise TxParseError(C.NIL_ENVELOPE, "empty payload")
+    try:
+        payload = unmarshal(common_pb2.Payload, env.payload)
+        ch = unmarshal(common_pb2.ChannelHeader, payload.header.channel_header)
+        sh = unmarshal(common_pb2.SignatureHeader, payload.header.signature_header)
+    except Exception as e:
+        raise TxParseError(C.BAD_PAYLOAD, f"bad payload: {e}") from e
+    if ch.type != common_pb2.HeaderType.ENDORSER_TRANSACTION:
+        raise TxParseError(C.UNKNOWN_TX_TYPE, f"type {ch.type}")
+    try:
+        tx = unmarshal(transaction_pb2.Transaction, payload.data)
+        if not tx.actions:
+            raise TxParseError(C.NIL_TXACTION, "no actions")
+        cap = unmarshal(
+            transaction_pb2.ChaincodeActionPayload, tx.actions[0].payload
+        )
+        prp = unmarshal(
+            proposal_pb2.ProposalResponsePayload,
+            cap.action.proposal_response_payload,
+        )
+        cca = unmarshal(proposal_pb2.ChaincodeAction, prp.extension)
+    except TxParseError:
+        raise
+    except Exception as e:
+        raise TxParseError(C.BAD_PAYLOAD, f"bad tx: {e}") from e
+    return ch, sh, cap, prp, cca
+
+
+# ---------------------------------------------------------------------------
+# TRANSACTIONS_FILTER (reference: internal/pkg/txflags/validation_flags.go)
+
+
+def new_tx_filter(n: int) -> bytearray:
+    return bytearray([transaction_pb2.TxValidationCode.NOT_VALIDATED] * n)
+
+
+def set_tx_filter(block: common_pb2.Block, flags: bytes) -> None:
+    idx = common_pb2.BlockMetadataIndex.TRANSACTIONS_FILTER
+    while len(block.metadata.metadata) <= idx:
+        block.metadata.metadata.append(b"")
+    block.metadata.metadata[idx] = bytes(flags)
+
+
+def get_tx_filter(block: common_pb2.Block) -> bytes:
+    idx = common_pb2.BlockMetadataIndex.TRANSACTIONS_FILTER
+    if len(block.metadata.metadata) > idx and block.metadata.metadata[idx]:
+        return block.metadata.metadata[idx]
+    return bytes(new_tx_filter(len(block.data.data)))
+
+
+def tx_flag_is_valid(flags: bytes, i: int) -> bool:
+    return flags[i] == transaction_pb2.TxValidationCode.VALID

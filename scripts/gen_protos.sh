@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Regenerate the protobuf gencode (committed; run after editing .proto).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+protoc --python_out=. fabric_tpu/protos/*.proto
+echo "generated: $(ls fabric_tpu/protos/*_pb2.py | wc -l) modules"

@@ -1,0 +1,130 @@
+"""Wire-format tests: proto round-trips, hashes, tx extraction, rwset."""
+
+import hashlib
+
+from fabric_tpu import protoutil as pu
+from fabric_tpu.ledger.rwset import TxRWSet
+from fabric_tpu.protos import common_pb2, proposal_pb2, transaction_pb2
+
+
+class _FakeSigner:
+    def sign(self, data):
+        return b"sig:" + hashlib.sha256(data).digest()[:8]
+
+
+def test_der_header_hash_known_vector():
+    h = common_pb2.BlockHeader(number=5, previous_hash=b"\x01" * 32, data_hash=b"\x02" * 32)
+    der = pu.block_header_bytes(h)
+    # SEQUENCE(INTEGER 5, OCTETS 32, OCTETS 32)
+    assert der[0] == 0x30
+    assert der[2:5] == b"\x02\x01\x05"
+    assert pu.block_header_hash(h) == hashlib.sha256(der).digest()
+    # large number needs multi-byte INTEGER with sign handling
+    h2 = common_pb2.BlockHeader(number=2**40 + 129, previous_hash=b"", data_hash=b"")
+    der2 = pu.block_header_bytes(h2)
+    assert der2[0] == 0x30
+
+
+def test_der_int_sign_padding():
+    # number with MSB set in leading byte must get a 0x00 pad
+    assert pu._der_int(0x80) == b"\x02\x02\x00\x80"
+    assert pu._der_int(0x7F) == b"\x02\x01\x7f"
+    assert pu._der_int(0) == b"\x02\x01\x00"
+
+
+def test_block_roundtrip_and_filter():
+    blk = pu.new_block(3, b"prev" * 8)
+    blk.data.data.append(b"env1")
+    blk.data.data.append(b"env2")
+    pu.finalize_block(blk)
+    assert blk.header.data_hash == hashlib.sha256(b"env1env2").digest()
+    flags = pu.new_tx_filter(2)
+    assert not pu.tx_flag_is_valid(flags, 0)
+    flags[0] = transaction_pb2.TxValidationCode.VALID
+    pu.set_tx_filter(blk, flags)
+    got = pu.get_tx_filter(blk)
+    assert pu.tx_flag_is_valid(got, 0) and not pu.tx_flag_is_valid(got, 1)
+
+
+def _make_endorser_tx(channel="ch1", txid="tx1"):
+    cca = proposal_pb2.ChaincodeAction(results=b"rwset-bytes")
+    prp = proposal_pb2.ProposalResponsePayload(
+        proposal_hash=b"h" * 32, extension=cca.SerializeToString()
+    )
+    cap = transaction_pb2.ChaincodeActionPayload()
+    cap.action.proposal_response_payload = prp.SerializeToString()
+    cap.action.endorsements.add(endorser=b"E1", signature=b"S1")
+    tx = transaction_pb2.Transaction()
+    tx.actions.add(header=b"", payload=cap.SerializeToString())
+    ch = pu.make_channel_header(
+        common_pb2.HeaderType.ENDORSER_TRANSACTION, channel, tx_id=txid
+    )
+    sh = pu.make_signature_header(b"creator", b"nonce")
+    payload = pu.make_payload(ch, sh, tx.SerializeToString())
+    return pu.sign_envelope(payload, _FakeSigner())
+
+
+def test_extract_action():
+    env = _make_endorser_tx()
+    ch, sh, cap, prp, cca = pu.extract_action(env)
+    assert ch.channel_id == "ch1" and ch.tx_id == "tx1"
+    assert sh.creator == b"creator"
+    assert cca.results == b"rwset-bytes"
+    assert cap.action.endorsements[0].endorser == b"E1"
+
+
+def test_extract_action_errors():
+    import pytest
+
+    C = transaction_pb2.TxValidationCode
+    with pytest.raises(pu.TxParseError) as ei:
+        pu.extract_action(common_pb2.Envelope())
+    assert ei.value.code == C.NIL_ENVELOPE
+    # config-type envelope rejected as unknown for this path
+    ch = pu.make_channel_header(common_pb2.HeaderType.CONFIG, "ch1")
+    sh = pu.make_signature_header(b"c", b"n")
+    env = pu.sign_envelope(pu.make_payload(ch, sh, b""), _FakeSigner())
+    with pytest.raises(pu.TxParseError) as ei:
+        pu.extract_action(env)
+    assert ei.value.code == C.UNKNOWN_TX_TYPE
+
+
+def test_signed_data_and_txid():
+    env = _make_endorser_tx()
+    sd = pu.envelope_as_signed_data(env)
+    assert sd.identity == b"creator"
+    assert sd.data == env.payload and sd.signature == env.signature
+    assert pu.compute_tx_id(b"n", b"c") == hashlib.sha256(b"nc").hexdigest()
+
+
+def test_rwset_roundtrip():
+    tx = TxRWSet()
+    n = tx.ns_rwset("mycc")
+    n.reads["a"] = (3, 1)
+    n.reads["absent"] = None
+    n.writes["b"] = b"val"
+    n.writes["del"] = None
+    n.range_queries.append(("k1", "k9", [("k3", (2, 0))]))
+    n.metadata_writes["b"] = {"VALIDATION_PARAMETER": b"pol"}
+    n.hashed["collA"] = {
+        "reads": {b"\xaa" * 32: (1, 0)},
+        "writes": {b"\xbb" * 32: (b"\xcc" * 32, False)},
+        "pvt_hash": b"\xdd" * 32,
+    }
+    data = tx.to_proto().SerializeToString()
+    tx2 = TxRWSet.from_bytes(data)
+    n2 = tx2.ns["mycc"]
+    assert n2.reads == n.reads
+    assert n2.writes == n.writes
+    assert n2.range_queries == n.range_queries
+    assert n2.metadata_writes == n.metadata_writes
+    assert n2.hashed["collA"]["reads"] == n.hashed["collA"]["reads"]
+    assert n2.hashed["collA"]["writes"] == n.hashed["collA"]["writes"]
+
+    reads, writes, rqs = tx2.mvcc_form()
+    keys = [k for k, _ in reads]
+    assert ("pub", "mycc", "a") in keys
+    assert ("pvt", "mycc", "collA", b"\xaa" * 32) in keys
+    assert ("pub", "mycc", "b") in writes
+    assert ("pvt", "mycc", "collA", b"\xbb" * 32) in writes
+    assert rqs == [(("pub", "mycc", "k1"), ("pub", "mycc", "k9"))]
