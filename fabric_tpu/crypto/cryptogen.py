@@ -1,0 +1,211 @@
+"""Dev-network crypto material generator (analog of the reference's
+cryptogen tool, internal/cryptogen, and tlsgen, common/crypto/tlsgen).
+
+Generates per-org ECDSA-P256 CAs and node/user certificates with
+NodeOUs role OUs in the subject, in-memory or onto disk in an
+msp-directory layout.  TLS material (separate CA, SAN=localhost) backs
+the gRPC mutual-TLS transport (fabric_tpu/rpc).
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+from dataclasses import dataclass, field
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+ONE_DAY = datetime.timedelta(days=1)
+TEN_YEARS = datetime.timedelta(days=3650)
+
+
+def _name(cn: str, org: str, ou: str | None = None) -> x509.Name:
+    attrs = [
+        x509.NameAttribute(NameOID.COUNTRY_NAME, "US"),
+        x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+        x509.NameAttribute(NameOID.COMMON_NAME, cn),
+    ]
+    if ou:
+        attrs.insert(2, x509.NameAttribute(NameOID.ORGANIZATIONAL_UNIT_NAME, ou))
+    return x509.Name(attrs)
+
+
+def _pem_key(key) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+
+
+def _pem_cert(cert: x509.Certificate) -> bytes:
+    return cert.public_bytes(serialization.Encoding.PEM)
+
+
+@dataclass
+class CA:
+    """Self-signed ECDSA CA."""
+
+    org: str
+    cn: str
+    key: ec.EllipticCurvePrivateKey
+    cert: x509.Certificate
+
+    @classmethod
+    def create(cls, org: str, cn: str | None = None) -> "CA":
+        cn = cn or f"ca.{org}"
+        key = ec.generate_private_key(ec.SECP256R1())
+        now = datetime.datetime.now(datetime.timezone.utc)
+        name = _name(cn, org)
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(name)
+            .issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - ONE_DAY)
+            .not_valid_after(now + TEN_YEARS)
+            .add_extension(x509.BasicConstraints(ca=True, path_length=1), critical=True)
+            .add_extension(
+                x509.KeyUsage(
+                    digital_signature=True, key_cert_sign=True, crl_sign=True,
+                    content_commitment=False, key_encipherment=False,
+                    data_encipherment=False, key_agreement=False,
+                    encipher_only=False, decipher_only=False,
+                ),
+                critical=True,
+            )
+            .sign(key, hashes.SHA256())
+        )
+        return cls(org=org, cn=cn, key=key, cert=cert)
+
+    @property
+    def cert_pem(self) -> bytes:
+        return _pem_cert(self.cert)
+
+    def issue(
+        self,
+        cn: str,
+        ou: str | None = None,
+        sans: list[str] | None = None,
+        ca: bool = False,
+    ) -> "Enrollment":
+        key = ec.generate_private_key(ec.SECP256R1())
+        now = datetime.datetime.now(datetime.timezone.utc)
+        builder = (
+            x509.CertificateBuilder()
+            .subject_name(_name(cn, self.org, ou))
+            .issuer_name(self.cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - ONE_DAY)
+            .not_valid_after(now + TEN_YEARS)
+            .add_extension(x509.BasicConstraints(ca=ca, path_length=None), critical=True)
+        )
+        if sans:
+            alt = []
+            for s in sans:
+                try:
+                    alt.append(x509.IPAddress(ipaddress.ip_address(s)))
+                except ValueError:
+                    alt.append(x509.DNSName(s))
+            builder = builder.add_extension(
+                x509.SubjectAlternativeName(alt), critical=False
+            )
+        cert = builder.sign(self.key, hashes.SHA256())
+        return Enrollment(key=key, cert=cert, ca_cert=self.cert)
+
+
+@dataclass
+class Enrollment:
+    key: ec.EllipticCurvePrivateKey
+    cert: x509.Certificate
+    ca_cert: x509.Certificate
+
+    @property
+    def key_pem(self) -> bytes:
+        return _pem_key(self.key)
+
+    @property
+    def cert_pem(self) -> bytes:
+        return _pem_cert(self.cert)
+
+    @property
+    def ca_pem(self) -> bytes:
+        return _pem_cert(self.ca_cert)
+
+
+@dataclass
+class OrgMaterial:
+    """All crypto material for one org: signing CA, TLS CA, nodes, users."""
+
+    msp_id: str
+    domain: str
+    ca: CA
+    tls_ca: CA
+    nodes: dict = field(default_factory=dict)  # name -> Enrollment (sign)
+    tls: dict = field(default_factory=dict)    # name -> Enrollment (tls)
+    users: dict = field(default_factory=dict)
+
+    def msp(self):
+        from fabric_tpu.crypto.msp import MSP
+
+        return MSP(
+            msp_id=self.msp_id,
+            root_certs=[self.ca.cert_pem],
+            node_ous=True,
+        )
+
+
+def generate_org(
+    msp_id: str,
+    domain: str,
+    peers: int = 1,
+    orderers: int = 0,
+    users: int = 1,
+    admin: bool = True,
+) -> OrgMaterial:
+    """One org's full material (cryptogen `generate` equivalent)."""
+    ca = CA.create(domain)
+    tls_ca = CA.create(domain, cn=f"tlsca.{domain}")
+    org = OrgMaterial(msp_id=msp_id, domain=domain, ca=ca, tls_ca=tls_ca)
+    for i in range(peers):
+        name = f"peer{i}.{domain}"
+        org.nodes[name] = ca.issue(name, ou="peer")
+        org.tls[name] = tls_ca.issue(name, sans=[name, "localhost", "127.0.0.1"])
+    for i in range(orderers):
+        name = f"orderer{i}.{domain}"
+        org.nodes[name] = ca.issue(name, ou="orderer")
+        org.tls[name] = tls_ca.issue(name, sans=[name, "localhost", "127.0.0.1"])
+    if admin:
+        org.users[f"Admin@{domain}"] = ca.issue(f"Admin@{domain}", ou="admin")
+    for i in range(users):
+        name = f"User{i + 1}@{domain}"
+        org.users[name] = ca.issue(name, ou="client")
+    return org
+
+
+def signing_identity(org: OrgMaterial, name: str):
+    """SigningIdentity for a node or user of the org."""
+    from fabric_tpu.crypto.identity import SigningIdentity
+
+    enr = org.nodes.get(name) or org.users.get(name)
+    if enr is None:
+        raise KeyError(name)
+    return SigningIdentity(org.msp_id, enr.key, enr.cert)
+
+
+def write_msp_dir(base: str, enr: Enrollment, ca_pem: bytes) -> None:
+    """cryptogen-style msp/ directory layout."""
+    for sub in ("cacerts", "keystore", "signcerts"):
+        os.makedirs(os.path.join(base, sub), exist_ok=True)
+    with open(os.path.join(base, "cacerts", "ca.pem"), "wb") as f:
+        f.write(ca_pem)
+    with open(os.path.join(base, "keystore", "key.pem"), "wb") as f:
+        f.write(enr.key_pem)
+    with open(os.path.join(base, "signcerts", "cert.pem"), "wb") as f:
+        f.write(enr.cert_pem)

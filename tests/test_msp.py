@@ -1,0 +1,128 @@
+"""MSP + identity + cryptogen tests (reference semantics:
+msp/mspimpl.go Setup/DeserializeIdentity/SatisfiesPrincipal)."""
+
+import pytest
+
+from fabric_tpu.crypto import cryptogen, ec_ref, msp as msp_mod, policy as pol
+from fabric_tpu.crypto.identity import Identity, SigningIdentity
+from fabric_tpu.protos import policies_pb2
+
+
+@pytest.fixture(scope="module")
+def org():
+    return cryptogen.generate_org("Org1MSP", "org1.example.com", peers=2, users=1)
+
+
+@pytest.fixture(scope="module")
+def org2():
+    return cryptogen.generate_org("Org2MSP", "org2.example.com", peers=1)
+
+
+def test_sign_verify_roundtrip(org):
+    si = cryptogen.signing_identity(org, "peer0.org1.example.com")
+    msg = b"endorsement payload"
+    sig = si.sign(msg)
+    ident = si.identity
+    assert ident.verify(msg, sig)
+    assert not ident.verify(msg + b"x", sig)
+    # low-S enforced at signing
+    from fabric_tpu.crypto.identity import sig_to_ints
+
+    _, s = sig_to_ints(sig)
+    assert s <= ec_ref.HALF_N
+
+
+def test_deserialize_validate_roles(org):
+    m = org.msp()
+    peer = cryptogen.signing_identity(org, "peer0.org1.example.com")
+    ident = m.deserialize_identity(peer.serialized)
+    assert ident.is_valid and ident.role == "peer"
+    admin = cryptogen.signing_identity(org, "Admin@org1.example.com")
+    aident = m.deserialize_identity(admin.serialized)
+    assert aident.is_valid and aident.role == "admin"
+    user = cryptogen.signing_identity(org, "User1@org1.example.com")
+    uident = m.deserialize_identity(user.serialized)
+    assert uident.is_valid and uident.role == "client"
+    # cache hit returns same object
+    assert m.deserialize_identity(peer.serialized) is ident
+
+
+def test_foreign_and_forged_identities_rejected(org, org2):
+    m = org.msp()
+    foreign = cryptogen.signing_identity(org2, "peer0.org2.example.com")
+    ident = m.deserialize_identity(foreign.serialized)
+    assert not ident.is_valid  # wrong msp id → not validated against Org1 roots
+    # forged: Org1 msp id but cert from Org2's CA
+    forged = SigningIdentity("Org1MSP", foreign.key, foreign.cert)
+    fident = m.deserialize_identity(forged.serialized)
+    assert fident.msp_id == "Org1MSP" and not fident.is_valid
+
+
+def test_satisfies_principal_proto(org, org2):
+    mgr = msp_mod.MSPManager({"Org1MSP": org.msp(), "Org2MSP": org2.msp()})
+    peer = cryptogen.signing_identity(org, "peer0.org1.example.com")
+    ident = mgr.deserialize_identity(peer.serialized)
+
+    def role_principal(mspid, role):
+        return policies_pb2.MSPPrincipal(
+            principal_classification=policies_pb2.MSPPrincipal.ROLE,
+            principal=policies_pb2.MSPRole(
+                msp_identifier=mspid, role=role
+            ).SerializeToString(),
+        )
+
+    assert mgr.satisfies_principal(ident, role_principal("Org1MSP", policies_pb2.MSPRole.MEMBER))
+    assert mgr.satisfies_principal(ident, role_principal("Org1MSP", policies_pb2.MSPRole.PEER))
+    assert not mgr.satisfies_principal(ident, role_principal("Org1MSP", policies_pb2.MSPRole.ADMIN))
+    assert not mgr.satisfies_principal(ident, role_principal("Org2MSP", policies_pb2.MSPRole.MEMBER))
+    # OU principal
+    oup = policies_pb2.MSPPrincipal(
+        principal_classification=policies_pb2.MSPPrincipal.ORGANIZATION_UNIT,
+        principal=policies_pb2.OrganizationUnit(
+            msp_identifier="Org1MSP", organizational_unit_identifier="peer"
+        ).SerializeToString(),
+    )
+    assert mgr.satisfies_principal(ident, oup)
+    # IDENTITY principal
+    idp = policies_pb2.MSPPrincipal(
+        principal_classification=policies_pb2.MSPPrincipal.IDENTITY,
+        principal=peer.serialized,
+    )
+    assert mgr.satisfies_principal(ident, idp)
+
+
+def test_match_matrix_and_policy_bridge(org, org2):
+    mgr = msp_mod.MSPManager({"Org1MSP": org.msp(), "Org2MSP": org2.msp()})
+    rule = pol.from_dsl("AND('Org1MSP.peer', 'Org2MSP.member')")
+    plan = pol.compile_plan(rule)
+    s1 = cryptogen.signing_identity(org, "peer0.org1.example.com").serialized
+    s2 = cryptogen.signing_identity(org2, "peer0.org2.example.com").serialized
+    m = mgr.match_matrix([s1, s2], plan.principals)
+    assert pol.evaluate(rule, m)
+    assert plan.consumption_safe(m) and plan.evaluate_counts(m)
+    m1 = mgr.match_matrix([s1], plan.principals)
+    assert not pol.evaluate(rule, m1)
+
+
+def test_policy_proto_roundtrip():
+    rule = pol.from_dsl("OutOf(2, 'A.member', 'B.admin', 'C.peer')")
+    env = msp_mod.policy_to_proto(rule)
+    back = msp_mod.policy_from_proto(env)
+    assert back == rule
+
+
+def test_msp_config_proto_roundtrip(org):
+    m = org.msp()
+    cfg = m.to_proto()
+    m2 = msp_mod.MSP.from_proto(cfg)
+    assert m2.msp_id == "Org1MSP" and m2.node_ous
+    peer = cryptogen.signing_identity(org, "peer1.org1.example.com")
+    assert m2.deserialize_identity(peer.serialized).is_valid
+
+
+def test_revocation(org):
+    m = org.msp()
+    peer = cryptogen.signing_identity(org, "peer0.org1.example.com")
+    m.revoked_serials.add(peer.cert.serial_number)
+    ident = m.deserialize_identity(peer.serialized)
+    assert not ident.is_valid
