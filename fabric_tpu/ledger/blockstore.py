@@ -1,0 +1,200 @@
+"""Append-only block store with sqlite index and crash recovery.
+
+Analog of the reference's block storage
+(common/ledger/blkstorage/blockfile_mgr.go:281 addBlock; index
+blockindex.go).  Blocks are length-prefixed protobufs in numbered
+segment files; a sqlite index maps number/hash/txid → (file, offset).
+On open, a partially written tail record (crash mid-append) is
+truncated — the reference's atomic-write recovery — and the index is
+rebuilt forward from the last indexed block, so the FILES are the
+source of truth and the index is derived state.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import struct
+
+from fabric_tpu import protoutil
+from fabric_tpu.protos import common_pb2
+
+_SEGMENT_MAX = 64 * 1024 * 1024
+_LEN = struct.Struct("<I")
+
+
+class BlockStore:
+    def __init__(self, dirpath: str):
+        self.dir = dirpath
+        os.makedirs(dirpath, exist_ok=True)
+        self._idx = sqlite3.connect(os.path.join(dirpath, "index.db"))
+        self._idx.execute("PRAGMA journal_mode=WAL")
+        self._idx.execute(
+            "CREATE TABLE IF NOT EXISTS blocks ("
+            " num INTEGER PRIMARY KEY, hash BLOB, seg INTEGER, off INTEGER)"
+        )
+        self._idx.execute(
+            "CREATE TABLE IF NOT EXISTS txids ("
+            " txid TEXT PRIMARY KEY, num INTEGER, txnum INTEGER, code INTEGER)"
+        )
+        self._idx.execute(
+            "CREATE INDEX IF NOT EXISTS blocks_hash ON blocks(hash)"
+        )
+        self._recover()
+
+    # -- segment file plumbing --------------------------------------------
+
+    def _seg_path(self, seg: int) -> str:
+        return os.path.join(self.dir, f"blocks_{seg:06d}.bin")
+
+    def _segments(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith("blocks_") and name.endswith(".bin"):
+                out.append(int(name[7:13]))
+        return sorted(out)
+
+    def _recover(self) -> None:
+        segs = self._segments()
+        if not segs:
+            self._seg = 0
+            self._fh = open(self._seg_path(0), "ab")
+            return
+        # truncate torn tail record of the last segment
+        last = segs[-1]
+        path = self._seg_path(last)
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            off = 0
+            while off + _LEN.size <= size:
+                (n,) = _LEN.unpack(f.read(_LEN.size))
+                if off + _LEN.size + n > size:
+                    break
+                f.seek(n, 1)
+                off += _LEN.size + n
+        if off < size:
+            with open(path, "ab") as f:
+                f.truncate(off)
+        # re-index anything beyond the last indexed block
+        row = self._idx.execute("SELECT MAX(num) FROM blocks").fetchone()
+        next_num = (row[0] + 1) if row[0] is not None else 0
+        for seg in segs:
+            for block, offset in self._scan(seg):
+                if block.header.number >= next_num:
+                    self._index_block(block, seg, offset)
+        self._idx.commit()
+        self._seg = last
+        self._fh = open(path, "ab")
+
+    def _scan(self, seg: int):
+        path = self._seg_path(seg)
+        with open(path, "rb") as f:
+            off = 0
+            while True:
+                hdr = f.read(_LEN.size)
+                if len(hdr) < _LEN.size:
+                    return
+                (n,) = _LEN.unpack(hdr)
+                data = f.read(n)
+                if len(data) < n:
+                    return
+                block = common_pb2.Block()
+                block.ParseFromString(data)
+                yield block, off
+                off += _LEN.size + n
+
+    # -- index -------------------------------------------------------------
+
+    def _index_block(self, block: common_pb2.Block, seg: int, off: int) -> None:
+        self._idx.execute(
+            "INSERT OR REPLACE INTO blocks VALUES (?,?,?,?)",
+            (block.header.number, protoutil.block_header_hash(block.header), seg, off),
+        )
+        flags = protoutil.get_tx_filter(block)
+        for i, env_bytes in enumerate(block.data.data):
+            try:
+                env = protoutil.unmarshal(common_pb2.Envelope, env_bytes)
+                payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+                ch = protoutil.unmarshal(
+                    common_pb2.ChannelHeader, payload.header.channel_header
+                )
+                txid = ch.tx_id
+            except Exception:
+                continue
+            if txid:
+                self._idx.execute(
+                    "INSERT OR IGNORE INTO txids VALUES (?,?,?,?)",
+                    (txid, block.header.number, i, flags[i] if i < len(flags) else 254),
+                )
+
+    # -- public API --------------------------------------------------------
+
+    @property
+    def height(self) -> int:
+        row = self._idx.execute("SELECT MAX(num) FROM blocks").fetchone()
+        return (row[0] + 1) if row[0] is not None else 0
+
+    def add_block(self, block: common_pb2.Block) -> None:
+        if block.header.number != self.height:
+            raise ValueError(
+                f"block number {block.header.number} != height {self.height}"
+            )
+        data = block.SerializeToString()
+        if self._fh.tell() + len(data) > _SEGMENT_MAX and self._fh.tell() > 0:
+            self._fh.close()
+            self._seg += 1
+            self._fh = open(self._seg_path(self._seg), "ab")
+        off = self._fh.tell()
+        self._fh.write(_LEN.pack(len(data)))
+        self._fh.write(data)
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._index_block(block, self._seg, off)
+        self._idx.commit()
+
+    def _read_at(self, seg: int, off: int) -> common_pb2.Block | None:
+        try:
+            with open(self._seg_path(seg), "rb") as f:
+                f.seek(off)
+                (n,) = _LEN.unpack(f.read(_LEN.size))
+                block = common_pb2.Block()
+                block.ParseFromString(f.read(n))
+                return block
+        except (OSError, struct.error):
+            return None
+
+    def get_block(self, number: int) -> common_pb2.Block | None:
+        row = self._idx.execute(
+            "SELECT seg, off FROM blocks WHERE num=?", (number,)
+        ).fetchone()
+        return self._read_at(*row) if row else None
+
+    def get_block_by_hash(self, h: bytes) -> common_pb2.Block | None:
+        row = self._idx.execute(
+            "SELECT seg, off FROM blocks WHERE hash=?", (h,)
+        ).fetchone()
+        return self._read_at(*row) if row else None
+
+    def get_tx_loc(self, txid: str):
+        """→ (block_num, tx_num, validation_code) or None (dup-txid
+        check + qscc GetTransactionByID)."""
+        row = self._idx.execute(
+            "SELECT num, txnum, code FROM txids WHERE txid=?", (txid,)
+        ).fetchone()
+        return tuple(row) if row else None
+
+    def tx_exists(self, txid: str) -> bool:
+        return self.get_tx_loc(txid) is not None
+
+    def iter_blocks(self, start: int = 0):
+        num = start
+        while True:
+            blk = self.get_block(num)
+            if blk is None:
+                return
+            yield blk
+            num += 1
+
+    def close(self):
+        self._fh.close()
+        self._idx.close()

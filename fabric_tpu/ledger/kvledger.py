@@ -1,0 +1,136 @@
+"""The per-channel ledger: block store + state + history orchestration.
+
+Analog of the reference's kvledger (core/ledger/kvledger/kv_ledger.go):
+``commit_block`` mirrors kvLedger.commit (:612-731) — already-validated
+block + its TRANSACTIONS_FILTER and prepared update batch go through:
+
+  1. commit-hash chaining (:650) — sha256(prev_commit_hash ‖
+     block-header hash ‖ tx filter), stored in the COMMIT_HASH
+     metadata slot so peers can cross-check state equality;
+  2. block+pvtdata store append (the source of truth);
+  3. state-DB apply with the block height as savepoint;
+  4. history-DB apply.
+
+Crash recovery mirrors recoverDBs (:357): on open, state/history DBs
+behind the block store are caught up by replaying stored blocks
+through a replay callback (the committer's re-validation path), so a
+crash between steps 2-4 self-heals.
+
+Validation itself lives in fabric_tpu.peer.validator (the TPU
+pipeline); the ledger takes its outputs, keeping the layering of the
+reference (txmgr validates, kvledger orchestrates).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+from fabric_tpu import protoutil
+from fabric_tpu.ledger.blockstore import BlockStore
+from fabric_tpu.ledger.history import HistoryDB
+from fabric_tpu.ledger.pvtdata import PvtDataStore
+from fabric_tpu.ledger.statedb import SqliteVersionedDB, UpdateBatch, VersionedDB
+from fabric_tpu.protos import common_pb2
+
+
+class KVLedger:
+    def __init__(
+        self,
+        ledger_dir: str,
+        state_db: VersionedDB | None = None,
+        enable_history: bool = True,
+    ):
+        os.makedirs(ledger_dir, exist_ok=True)
+        self.dir = ledger_dir
+        self.blocks = BlockStore(os.path.join(ledger_dir, "chains"))
+        self.state = state_db or SqliteVersionedDB(os.path.join(ledger_dir, "state.db"))
+        self.state.open()
+        self.history = (
+            HistoryDB(os.path.join(ledger_dir, "history.db")) if enable_history else None
+        )
+        self.pvtdata = PvtDataStore(os.path.join(ledger_dir, "pvtdata.db"))
+        self._commit_hash: bytes | None = self._load_last_commit_hash()
+
+    # -- commit hash chain -------------------------------------------------
+
+    def _load_last_commit_hash(self) -> bytes | None:
+        h = self.blocks.height
+        if h == 0:
+            return None
+        blk = self.blocks.get_block(h - 1)
+        idx = common_pb2.BlockMetadataIndex.COMMIT_HASH
+        if len(blk.metadata.metadata) > idx and blk.metadata.metadata[idx]:
+            return blk.metadata.metadata[idx]
+        return None
+
+    def _next_commit_hash(self, block: common_pb2.Block, tx_filter: bytes) -> bytes:
+        return hashlib.sha256(
+            (self._commit_hash or b"")
+            + protoutil.block_header_hash(block.header)
+            + bytes(tx_filter)
+        ).digest()
+
+    # -- commit (kv_ledger.go:612) ----------------------------------------
+
+    def commit_block(
+        self,
+        block: common_pb2.Block,
+        tx_filter: bytes,
+        batch: UpdateBatch,
+        history_writes: list | None = None,
+        pvt_data: dict | None = None,
+    ) -> None:
+        num = block.header.number
+        if num != self.blocks.height:
+            raise ValueError(f"commit out of order: {num} vs height {self.blocks.height}")
+        protoutil.set_tx_filter(block, tx_filter)
+        commit_hash = self._next_commit_hash(block, tx_filter)
+        idx = common_pb2.BlockMetadataIndex.COMMIT_HASH
+        while len(block.metadata.metadata) <= idx:
+            block.metadata.metadata.append(b"")
+        block.metadata.metadata[idx] = commit_hash
+
+        self.blocks.add_block(block)
+        if pvt_data:
+            self.pvtdata.commit_block(num, pvt_data)
+        self.state.apply_updates(batch, (num, 0))
+        if self.history is not None and history_writes:
+            self.history.commit_block(num, history_writes)
+        self._commit_hash = commit_hash
+
+    # -- recovery (kv_ledger.go:357 recoverDBs) ---------------------------
+
+    def recover(self, replayer) -> int:
+        """replayer(block) -> (tx_filter, UpdateBatch, history_writes);
+        re-derives state for blocks the state DB is missing.  Returns
+        the number of replayed blocks."""
+        height = self.blocks.height
+        sp = self.state.savepoint()
+        start = (sp[0] + 1) if sp else 0
+        replayed = 0
+        for num in range(start, height):
+            block = self.blocks.get_block(num)
+            tx_filter, batch, history_writes = replayer(block)
+            self.state.apply_updates(batch, (num, 0))
+            if self.history is not None and history_writes:
+                hsp = self.history.savepoint()
+                if hsp is None or hsp < num:
+                    self.history.commit_block(num, history_writes)
+            replayed += 1
+        return replayed
+
+    @property
+    def height(self) -> int:
+        return self.blocks.height
+
+    @property
+    def commit_hash(self) -> bytes | None:
+        return self._commit_hash
+
+    def close(self):
+        self.blocks.close()
+        self.state.close()
+        if self.history is not None:
+            self.history.close()
+        self.pvtdata.close()

@@ -1,0 +1,83 @@
+"""Private-data store: cleartext collection write-sets per block.
+
+Analog of core/ledger/pvtdatastorage/store.go: pvt write-sets keyed
+(block, tx, namespace, collection) with block-to-live (BTL) expiry and
+missing-data bookkeeping for the reconciler (gossip/privdata).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+
+
+class PvtDataStore:
+    def __init__(self, path: str):
+        self._conn = sqlite3.connect(path)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS pvt ("
+            " block INTEGER, txnum INTEGER, ns TEXT, coll TEXT, rwset BLOB,"
+            " expiry INTEGER DEFAULT 0,"  # 0 = never (btl unset)
+            " PRIMARY KEY (block, txnum, ns, coll))"
+        )
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS missing ("
+            " block INTEGER, txnum INTEGER, ns TEXT, coll TEXT, eligible INTEGER,"
+            " PRIMARY KEY (block, txnum, ns, coll))"
+        )
+
+    def commit_block(self, block_num: int, data: dict, missing: list | None = None):
+        """data: {(txnum, ns, coll): (rwset_bytes, expiry_block)} —
+        expiry_block 0 = no BTL.  missing: [(txnum, ns, coll, eligible)]."""
+        cur = self._conn.cursor()
+        for (txnum, ns, coll), val in data.items():
+            rwset, expiry = val if isinstance(val, tuple) else (val, 0)
+            cur.execute(
+                "INSERT OR REPLACE INTO pvt VALUES (?,?,?,?,?,?)",
+                (block_num, txnum, ns, coll, rwset, expiry),
+            )
+        for txnum, ns, coll, eligible in missing or ():
+            cur.execute(
+                "INSERT OR REPLACE INTO missing VALUES (?,?,?,?,?)",
+                (block_num, txnum, ns, coll, int(eligible)),
+            )
+        self._conn.commit()
+
+    def get_pvt_data(self, block_num: int) -> dict:
+        out = {}
+        for txnum, ns, coll, rwset in self._conn.execute(
+            "SELECT txnum, ns, coll, rwset FROM pvt WHERE block=?", (block_num,)
+        ):
+            out[(txnum, ns, coll)] = rwset
+        return out
+
+    def missing_data(self, max_block: int, eligible_only: bool = True):
+        q = "SELECT block, txnum, ns, coll FROM missing WHERE block<=?"
+        if eligible_only:
+            q += " AND eligible=1"
+        return list(self._conn.execute(q, (max_block,)))
+
+    def resolve_missing(self, block: int, txnum: int, ns: str, coll: str, rwset: bytes, expiry: int = 0):
+        """Reconciler delivered previously missing data."""
+        cur = self._conn.cursor()
+        cur.execute(
+            "INSERT OR REPLACE INTO pvt VALUES (?,?,?,?,?,?)",
+            (block, txnum, ns, coll, rwset, expiry),
+        )
+        cur.execute(
+            "DELETE FROM missing WHERE block=? AND txnum=? AND ns=? AND coll=?",
+            (block, txnum, ns, coll),
+        )
+        self._conn.commit()
+
+    def purge_expired(self, current_block: int) -> int:
+        """BTL expiry (analog pvtstatepurgemgmt): drop pvt data whose
+        expiry block has passed."""
+        cur = self._conn.execute(
+            "DELETE FROM pvt WHERE expiry > 0 AND expiry <= ?", (current_block,)
+        )
+        self._conn.commit()
+        return cur.rowcount
+
+    def close(self):
+        self._conn.close()

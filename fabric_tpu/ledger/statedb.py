@@ -1,0 +1,251 @@
+"""Versioned state database: SPI + memory and sqlite backends.
+
+Analog of the reference's statedb layer
+(core/ledger/kvledger/txmgmt/statedb/statedb.go:36-76 ``VersionedDB``):
+keyed (namespace, key) → (value, metadata, version), bulk reads, range
+scans, savepoints.  Two backends mirror the reference's split:
+
+* ``MemVersionedDB`` — in-process dict (test/bench fixture, the analog
+  of statedb's mock+leveldb-in-memory usage);
+* ``SqliteVersionedDB`` — durable embedded store (the goleveldb
+  analog); rich JSON queries via sqlite's json functions stand in for
+  the CouchDB backend (statecouchdb) without an external service —
+  the reference itself documents CouchDB as a throughput liability
+  (docs/source/performance.md:180-186).
+
+The TPU-relevant member is ``get_versions_bulk``: one gather of
+committed versions for every read key of a block, feeding
+fabric_tpu.ops.mvcc.prepare_block (the reference bulk-preload:
+txmgmt/validation/validator.go:27-78).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+from bisect import bisect_left
+from dataclasses import dataclass
+
+Version = tuple[int, int]
+
+
+@dataclass
+class VersionedValue:
+    value: bytes | None
+    metadata: bytes | None
+    version: Version
+
+
+class UpdateBatch:
+    """Accumulated writes of a block (analog statedb.UpdateBatch)."""
+
+    def __init__(self):
+        self.updates: dict = {}  # (ns, key) -> VersionedValue (value None = delete)
+
+    def put(self, ns: str, key: str, value: bytes | None, version: Version, metadata: bytes | None = None):
+        self.updates[(ns, key)] = VersionedValue(value, metadata, version)
+
+    def delete(self, ns: str, key: str, version: Version):
+        self.put(ns, key, None, version)
+
+    def items(self):
+        return self.updates.items()
+
+
+class VersionedDB:
+    """SPI (statedb.go:36-76)."""
+
+    def open(self) -> None: ...
+    def close(self) -> None: ...
+
+    def get_state(self, ns: str, key: str) -> VersionedValue | None:
+        raise NotImplementedError
+
+    def get_version(self, ns: str, key: str) -> Version | None:
+        vv = self.get_state(ns, key)
+        return vv.version if vv else None
+
+    def get_versions_bulk(self, keys: list[tuple[str, str]]) -> dict:
+        """{(ns, key): Version} for present keys — the block-level
+        gather used by MVCC preparation."""
+        out = {}
+        for ns, key in keys:
+            v = self.get_version(ns, key)
+            if v is not None:
+                out[(ns, key)] = v
+        return out
+
+    def get_state_range(self, ns: str, start: str, end: str, limit: int = 0):
+        """Yield (key, VersionedValue) for start <= key < end in key
+        order ('' end = unbounded)."""
+        raise NotImplementedError
+
+    def execute_query(self, ns: str, query: dict, limit: int = 0):
+        raise NotImplementedError("rich queries unsupported by this backend")
+
+    def apply_updates(self, batch: UpdateBatch, savepoint: Version | None) -> None:
+        raise NotImplementedError
+
+    def savepoint(self) -> Version | None:
+        raise NotImplementedError
+
+
+class MemVersionedDB(VersionedDB):
+    def __init__(self):
+        self._data: dict = {}  # (ns,key) -> VersionedValue
+        self._sorted_cache: dict = {}  # ns -> sorted key list (invalidated on write)
+        self._savepoint: Version | None = None
+
+    def get_state(self, ns, key):
+        return self._data.get((ns, key))
+
+    def _sorted_keys(self, ns):
+        keys = self._sorted_cache.get(ns)
+        if keys is None:
+            keys = sorted(k for (n, k) in self._data if n == ns)
+            self._sorted_cache[ns] = keys
+        return keys
+
+    def get_state_range(self, ns, start, end, limit=0):
+        keys = self._sorted_keys(ns)
+        i = bisect_left(keys, start)
+        n = 0
+        while i < len(keys) and (not end or keys[i] < end):
+            yield keys[i], self._data[(ns, keys[i])]
+            i += 1
+            n += 1
+            if limit and n >= limit:
+                return
+
+    def execute_query(self, ns, query, limit=0):
+        """CouchDB-selector-style equality matching over JSON values."""
+        sel = query.get("selector", {})
+        n = 0
+        for key in self._sorted_keys(ns):
+            vv = self._data[(ns, key)]
+            if vv.value is None:
+                continue
+            try:
+                doc = json.loads(vv.value)
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if all(doc.get(f) == want for f, want in sel.items()):
+                yield key, vv
+                n += 1
+                if limit and n >= limit:
+                    return
+
+    def apply_updates(self, batch, savepoint):
+        for (ns, key), vv in batch.items():
+            if vv.value is None:
+                self._data.pop((ns, key), None)
+            else:
+                self._data[(ns, key)] = vv
+            self._sorted_cache.pop(ns, None)
+        self._savepoint = savepoint
+
+    def savepoint(self):
+        return self._savepoint
+
+
+class SqliteVersionedDB(VersionedDB):
+    """Durable backend over sqlite (WAL mode)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._conn: sqlite3.Connection | None = None
+
+    def open(self):
+        self._conn = sqlite3.connect(self.path)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS state ("
+            " ns TEXT NOT NULL, key TEXT NOT NULL,"
+            " value BLOB, metadata BLOB,"
+            " block INTEGER NOT NULL, txnum INTEGER NOT NULL,"
+            " PRIMARY KEY (ns, key))"
+        )
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS savepoint ("
+            " id INTEGER PRIMARY KEY CHECK (id = 0),"
+            " block INTEGER, txnum INTEGER)"
+        )
+        self._conn.commit()
+
+    def close(self):
+        if self._conn:
+            self._conn.close()
+            self._conn = None
+
+    def get_state(self, ns, key):
+        row = self._conn.execute(
+            "SELECT value, metadata, block, txnum FROM state WHERE ns=? AND key=?",
+            (ns, key),
+        ).fetchone()
+        if row is None:
+            return None
+        return VersionedValue(row[0], row[1], (row[2], row[3]))
+
+    def get_versions_bulk(self, keys):
+        out = {}
+        cur = self._conn.cursor()
+        for ns, key in keys:
+            row = cur.execute(
+                "SELECT block, txnum FROM state WHERE ns=? AND key=?", (ns, key)
+            ).fetchone()
+            if row:
+                out[(ns, key)] = (row[0], row[1])
+        return out
+
+    def get_state_range(self, ns, start, end, limit=0):
+        q = "SELECT key, value, metadata, block, txnum FROM state WHERE ns=? AND key>=?"
+        args = [ns, start]
+        if end:
+            q += " AND key<?"
+            args.append(end)
+        q += " ORDER BY key"
+        if limit:
+            q += f" LIMIT {int(limit)}"
+        for key, value, md, blk, txn in self._conn.execute(q, args):
+            yield key, VersionedValue(value, md, (blk, txn))
+
+    def execute_query(self, ns, query, limit=0):
+        """Rich queries via sqlite JSON1 (statecouchdb analog)."""
+        sel = query.get("selector", {})
+        clauses, args = [], [ns]
+        for fld, want in sel.items():
+            clauses.append("json_extract(value, ?) = ?")
+            args.append(f"$.{fld}")
+            args.append(want)
+        q = "SELECT key, value, metadata, block, txnum FROM state WHERE ns=?"
+        if clauses:
+            q += " AND " + " AND ".join(clauses)
+        q += " AND json_valid(value) ORDER BY key"
+        if limit:
+            q += f" LIMIT {int(limit)}"
+        for key, value, md, blk, txn in self._conn.execute(q, args):
+            yield key, VersionedValue(value, md, (blk, txn))
+
+    def apply_updates(self, batch, savepoint):
+        cur = self._conn.cursor()
+        for (ns, key), vv in batch.items():
+            if vv.value is None:
+                cur.execute("DELETE FROM state WHERE ns=? AND key=?", (ns, key))
+            else:
+                cur.execute(
+                    "INSERT OR REPLACE INTO state VALUES (?,?,?,?,?,?)",
+                    (ns, key, vv.value, vv.metadata, vv.version[0], vv.version[1]),
+                )
+        if savepoint is not None:
+            cur.execute(
+                "INSERT OR REPLACE INTO savepoint VALUES (0,?,?)",
+                (savepoint[0], savepoint[1]),
+            )
+        self._conn.commit()
+
+    def savepoint(self):
+        row = self._conn.execute(
+            "SELECT block, txnum FROM savepoint WHERE id=0"
+        ).fetchone()
+        return (row[0], row[1]) if row else None

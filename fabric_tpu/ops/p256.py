@@ -383,21 +383,37 @@ def verify_batch(e, r, s, qx, qy):
 verify_batch_jit = jax.jit(verify_batch)
 
 
+def digest_words_to_limbs(words):
+    """SHA-256 digest words (ops.sha256 output, [B, 8] uint32
+    big-endian) → [B, 16] little-endian 16-bit limbs, on device.
+
+    Lets the fused block pipeline keep digests on the TPU between the
+    hash and verify kernels (no host round-trip)."""
+    w = words[..., ::-1]  # little-endian word order
+    lo = w & MASK
+    hi = w >> 16
+    return jnp.stack([lo, hi], axis=-1).reshape(*words.shape[:-1], 16)
+
+
 # ---------------------------------------------------------------------------
 # Host convenience wrappers
+
+
+MIN_BUCKET = 16
 
 
 def verify_host(items) -> list[bool]:
     """items: iterable of (digest_int, r, s, qx, qy) Python ints.
 
-    Pads the batch to a power of two (one compile per bucket) and runs
-    the jitted kernel.
+    Pads the batch to a power of two, floored at MIN_BUCKET (one
+    compile per bucket — small blocks share one cached compile), and
+    runs the jitted kernel.
     """
     items = list(items)
     if not items:
         return []
     n = len(items)
-    bsz = next_pow2(n)
+    bsz = max(MIN_BUCKET, next_pow2(n))
     pad = [(0, 0, 0, 0, 0)] * (bsz - n)
     cols = list(zip(*(items + pad)))
     e, r, s, qx, qy = (jnp.asarray(ints_to_limbs(c)) for c in cols)

@@ -1,0 +1,109 @@
+"""Transaction assembly: proposal → endorsements → envelope.
+
+The client/SDK-side construction path (reference equivalents:
+protoutil/txutils.go CreateSignedTx, core/endorser building
+ProposalResponse).  Shared by the endorser service, the gateway and
+the test/benchmark harnesses.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from fabric_tpu import protoutil
+from fabric_tpu.protos import common_pb2, proposal_pb2, transaction_pb2
+
+
+def create_signed_proposal(signer, channel_id: str, chaincode: str, args: list[bytes], transient: dict | None = None):
+    """→ (SignedProposal, tx_id, proposal) for Evaluate/Endorse."""
+    nonce = protoutil.random_nonce()
+    creator = signer.serialized
+    tx_id = protoutil.compute_tx_id(nonce, creator)
+    ext = proposal_pb2.ChaincodeHeaderExtension()
+    ext.chaincode_id.name = chaincode
+    ch = protoutil.make_channel_header(
+        common_pb2.HeaderType.ENDORSER_TRANSACTION,
+        channel_id,
+        tx_id=tx_id,
+        extension=ext.SerializeToString(),
+    )
+    sh = protoutil.make_signature_header(creator, nonce)
+    spec = proposal_pb2.ChaincodeInvocationSpec()
+    spec.chaincode_spec.type = proposal_pb2.ChaincodeSpec.EXTERNAL
+    spec.chaincode_spec.chaincode_id.name = chaincode
+    spec.chaincode_spec.input.args.extend(args)
+    cpp = proposal_pb2.ChaincodeProposalPayload(input=spec.SerializeToString())
+    for k, v in (transient or {}).items():
+        cpp.TransientMap[k] = v
+    prop = proposal_pb2.Proposal(
+        header=common_pb2.Header(
+            channel_header=ch.SerializeToString(),
+            signature_header=sh.SerializeToString(),
+        ).SerializeToString(),
+        payload=cpp.SerializeToString(),
+    )
+    pbytes = prop.SerializeToString()
+    signed = proposal_pb2.SignedProposal(
+        proposal_bytes=pbytes, signature=signer.sign(pbytes)
+    )
+    return signed, tx_id, prop
+
+
+def proposal_hash(prop: proposal_pb2.Proposal) -> bytes:
+    return hashlib.sha256(prop.SerializeToString()).digest()
+
+
+def create_proposal_response(
+    prop: proposal_pb2.Proposal,
+    rwset_bytes: bytes,
+    endorser_signer,
+    chaincode: str,
+    response_payload: bytes = b"",
+    events: bytes = b"",
+    status: int = 200,
+) -> proposal_pb2.ProposalResponse:
+    """Endorse: build prp, sign prp‖endorser (the exact bytes the TPU
+    kernel verifies at commit — validator_keylevel.go:244-260)."""
+    cca = proposal_pb2.ChaincodeAction(results=rwset_bytes, events=events)
+    cca.response.status = status
+    cca.response.payload = response_payload
+    cca.chaincode_id.name = chaincode
+    prp = proposal_pb2.ProposalResponsePayload(
+        proposal_hash=proposal_hash(prop), extension=cca.SerializeToString()
+    )
+    prp_bytes = prp.SerializeToString()
+    endorser = endorser_signer.serialized
+    resp = proposal_pb2.ProposalResponse(payload=prp_bytes)
+    resp.response.status = status
+    resp.endorsement.endorser = endorser
+    resp.endorsement.signature = endorser_signer.sign(prp_bytes + endorser)
+    return resp
+
+
+def assemble_transaction(
+    prop: proposal_pb2.Proposal,
+    responses: list[proposal_pb2.ProposalResponse],
+    creator_signer,
+) -> common_pb2.Envelope:
+    """Signed tx envelope from matching proposal responses
+    (protoutil CreateSignedTx semantics: all payloads must agree)."""
+    if not responses:
+        raise ValueError("no proposal responses")
+    payloads = {r.payload for r in responses}
+    if len(payloads) != 1:
+        raise ValueError("proposal responses disagree")
+    header = common_pb2.Header()
+    header.ParseFromString(prop.header)
+    cap = transaction_pb2.ChaincodeActionPayload(
+        chaincode_proposal_payload=prop.payload
+    )
+    cap.action.proposal_response_payload = responses[0].payload
+    for r in responses:
+        cap.action.endorsements.add(
+            endorser=r.endorsement.endorser, signature=r.endorsement.signature
+        )
+    sh = protoutil.unmarshal(common_pb2.SignatureHeader, header.signature_header)
+    tx = transaction_pb2.Transaction()
+    tx.actions.add(header=header.signature_header, payload=cap.SerializeToString())
+    payload = common_pb2.Payload(header=header, data=tx.SerializeToString())
+    return protoutil.sign_envelope(payload, creator_signer)

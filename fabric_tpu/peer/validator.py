@@ -1,0 +1,365 @@
+"""The block validator: TPU-batched equivalent of the reference's
+commit-path validation (the north-star component).
+
+Reference shape (SURVEY §3.2): TxValidator v20 runs a goroutine per tx
+(core/committer/txvalidator/v20/validator.go:180-265) doing envelope
+checks + creator ECDSA verify, dup-txid, then the plugin dispatcher
+walks each namespace's validation plugin which verifies every
+endorsement signature inside the policy tree
+(statebased/validator_keylevel.go:244-260, cauthdsl.go:24-110); the
+ledger then runs a serial MVCC loop (validation/validator.go:81-118).
+
+TPU-first re-ordering — compute first, control flow after:
+
+  phase 0 (host)  parse every envelope, collect EVERY signature in the
+                  block — creator sigs and endorsement sigs alike — as
+                  (digest, r, s, qx, qy) tuples; bulk-load committed
+                  versions for every read key.
+  phase 1 (TPU)   ONE batched ECDSA verify over all signatures
+                  (ops.p256), ONE vectorized policy reduction per
+                  distinct policy shape (ops.policy_eval).
+  phase 2 (TPU)   ONE MVCC kernel call over the whole block (ops.mvcc)
+                  with pre_ok = structural ∧ creator-sig ∧ policy.
+  phase 3 (host)  TRANSACTIONS_FILTER codes, update batch, history
+                  writes for the ledger.
+
+The plugin SPI (``ValidationPlugin``) keeps the reference's pluggable
+boundary (core/handlers/validation/api/validation.go:26-38): the
+built-in ``DefaultValidation`` implements phase-1 policy logic; custom
+plugins get the same per-namespace dispatch
+(plugindispatcher/dispatcher.go:102-221).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from fabric_tpu import protoutil
+from fabric_tpu.crypto import policy as pol
+from fabric_tpu.crypto.identity import Identity, sig_to_ints
+from fabric_tpu.ledger.rwset import TxRWSet
+from fabric_tpu.ledger.statedb import UpdateBatch
+from fabric_tpu.ops import mvcc as mvcc_ops
+from fabric_tpu.ops import p256
+from fabric_tpu.protos import common_pb2, transaction_pb2
+
+C = transaction_pb2.TxValidationCode
+
+
+class ValidationPlugin:
+    """SPI mirroring validation.Plugin (api/validation.go:26-38), but
+    batch-shaped: given per-tx endorsement validity bits + identities,
+    decide policy satisfaction for every tx at once."""
+
+    def validate_batch(self, ctx: "BlockValidationCtx") -> np.ndarray:
+        """→ [T] bool policy-ok for txs this plugin owns."""
+        raise NotImplementedError
+
+
+@dataclass
+class NamespaceInfo:
+    """Validation info for one namespace (the dispatcher's
+    GetInfoForValidate analog, plugindispatcher/dispatcher.go:244-263)."""
+
+    policy: object  # crypto.policy AST
+    plugin: str = "default"
+
+
+class PolicyProvider:
+    """namespace → NamespaceInfo; backed by the lifecycle cache once
+    chaincode lifecycle lands (reference: _lifecycle state)."""
+
+    def __init__(self, infos: dict[str, NamespaceInfo], default: NamespaceInfo | None = None):
+        self.infos = dict(infos)
+        self.default = default
+
+    def info(self, namespace: str) -> NamespaceInfo | None:
+        return self.infos.get(namespace) or self.default
+
+
+@dataclass
+class ParsedTx:
+    idx: int
+    code: int = C.NOT_VALIDATED
+    txid: str = ""
+    channel: str = ""
+    creator: bytes = b""
+    namespaces: tuple = ()
+    rwset: TxRWSet | None = None
+    endorsements: list = field(default_factory=list)  # (endorser_serialized, item)
+    creator_item_idx: int = -1
+    endo_item_idx: list = field(default_factory=list)
+    is_config: bool = False
+
+    @property
+    def undetermined(self) -> bool:
+        return self.code == C.NOT_VALIDATED
+
+
+@dataclass
+class BlockValidationCtx:
+    txs: list
+    sig_valid: np.ndarray  # [n_items] bool, global signature batch
+    msp_manager: object
+    policy_provider: PolicyProvider
+
+
+class BlockValidator:
+    """Validate(block) → (tx_filter, UpdateBatch, history_writes)."""
+
+    def __init__(
+        self,
+        msp_manager,
+        policy_provider: PolicyProvider,
+        state_db,
+        block_store=None,
+        plugins: dict[str, ValidationPlugin] | None = None,
+    ):
+        self.msp = msp_manager
+        self.policies = policy_provider
+        self.state = state_db
+        self.blocks = block_store
+        self.plugins = {"default": DefaultValidation(), **(plugins or {})}
+
+    # -- phase 0: parse + collect -----------------------------------------
+
+    def _parse(self, block: common_pb2.Block) -> tuple[list, list]:
+        txs: list[ParsedTx] = []
+        items: list = []  # (digest, r, s, qx, qy)
+        seen_txids: dict[str, int] = {}
+        for i, env_bytes in enumerate(block.data.data):
+            ptx = ParsedTx(idx=i)
+            txs.append(ptx)
+            if not env_bytes:
+                ptx.code = C.NIL_ENVELOPE
+                continue
+            try:
+                env = protoutil.unmarshal(common_pb2.Envelope, env_bytes)
+                payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+                ch = protoutil.unmarshal(
+                    common_pb2.ChannelHeader, payload.header.channel_header
+                )
+                sh = protoutil.unmarshal(
+                    common_pb2.SignatureHeader, payload.header.signature_header
+                )
+            except Exception:
+                ptx.code = C.BAD_PAYLOAD
+                continue
+            ptx.txid, ptx.channel, ptx.creator = ch.tx_id, ch.channel_id, sh.creator
+
+            if ch.type == common_pb2.HeaderType.CONFIG:
+                # config txs are validated by the config machinery, not
+                # the endorsement pipeline (v20/validator.go:397-419)
+                ptx.is_config = True
+                ptx.code = C.VALID
+                continue
+            if ch.type != common_pb2.HeaderType.ENDORSER_TRANSACTION:
+                ptx.code = C.UNKNOWN_TX_TYPE
+                continue
+            if not ch.tx_id:
+                ptx.code = C.BAD_PROPOSAL_TXID
+                continue
+            # dup txid: in-block + vs ledger (v20/validator.go:460-481)
+            if ch.tx_id in seen_txids or (
+                self.blocks is not None and self.blocks.tx_exists(ch.tx_id)
+            ):
+                ptx.code = C.DUPLICATE_TXID
+                continue
+            seen_txids[ch.tx_id] = i
+
+            # creator: deserializable, valid cert, sig over payload
+            try:
+                ident = self.msp.deserialize_identity(sh.creator)
+            except Exception:
+                ptx.code = C.BAD_CREATOR_SIGNATURE
+                continue
+            if not ident.is_valid:
+                ptx.code = C.BAD_CREATOR_SIGNATURE
+                continue
+            try:
+                item = _sig_item(ident, env.payload, env.signature)
+            except Exception:
+                ptx.code = C.BAD_CREATOR_SIGNATURE
+                continue
+            ptx.creator_item_idx = len(items)
+            items.append(item)
+
+            # endorsements + rwset
+            try:
+                _, _, cap, prp, cca = protoutil.extract_action(env)
+                ptx.rwset = TxRWSet.from_bytes(cca.results)
+                ptx.namespaces = tuple(sorted(ptx.rwset.ns))
+                prp_bytes = cap.action.proposal_response_payload
+                for e in cap.action.endorsements:
+                    try:
+                        eident = self.msp.deserialize_identity(e.endorser)
+                        eitem = _sig_item(eident, prp_bytes + e.endorser, e.signature)
+                    except Exception:
+                        continue  # unparseable endorsement: contributes nothing
+                    ptx.endo_item_idx.append(len(items))
+                    ptx.endorsements.append((e.endorser, eident))
+                    items.append(eitem)
+            except protoutil.TxParseError as e:
+                ptx.code = e.code
+                continue
+            except Exception:
+                ptx.code = C.BAD_RWSET
+                continue
+        return txs, items
+
+    # -- the pipeline ------------------------------------------------------
+
+    def validate(self, block: common_pb2.Block):
+        txs, items = self._parse(block)
+
+        # phase 1a: one batched ECDSA verify for the whole block
+        sig_valid = np.asarray(p256.verify_host(items), bool) if items else np.zeros(0, bool)
+
+        for ptx in txs:
+            if ptx.undetermined and ptx.creator_item_idx >= 0:
+                if not sig_valid[ptx.creator_item_idx]:
+                    ptx.code = C.BAD_CREATOR_SIGNATURE
+
+        # phase 1b: per-namespace plugin dispatch (policy reduction)
+        ctx = BlockValidationCtx(
+            txs=txs, sig_valid=sig_valid, msp_manager=self.msp,
+            policy_provider=self.policies,
+        )
+        by_plugin: dict[str, list[ParsedTx]] = {}
+        for ptx in txs:
+            if not ptx.undetermined:
+                continue
+            plugin = "default"
+            infos = [self.policies.info(ns) for ns in ptx.namespaces]
+            if not ptx.namespaces or any(i is None for i in infos):
+                ptx.code = C.INVALID_CHAINCODE
+                continue
+            if infos and infos[0].plugin:
+                plugin = infos[0].plugin
+            by_plugin.setdefault(plugin, []).append(ptx)
+        for name, group in by_plugin.items():
+            plug = self.plugins.get(name)
+            if plug is None:
+                for ptx in group:
+                    ptx.code = C.INVALID_OTHER_REASON
+                continue
+            ok = plug.validate_batch_group(ctx, group) if hasattr(
+                plug, "validate_batch_group"
+            ) else plug.validate_batch(ctx)
+            for ptx, good in zip(group, ok):
+                if not good:
+                    ptx.code = C.ENDORSEMENT_POLICY_FAILURE
+
+        # phase 2: MVCC over the whole block
+        mvcc_txs, committed = self._mvcc_inputs(txs)
+        pre_ok = np.array([ptx.undetermined for ptx in txs], bool)
+        if txs:
+            valid, conflict, phantom = mvcc_ops.mvcc_validate_block(
+                mvcc_txs, committed, pre_ok
+            )
+            for ptx, v, ph in zip(txs, valid, phantom):
+                if not ptx.undetermined:
+                    continue
+                if v:
+                    ptx.code = C.VALID
+                else:
+                    ptx.code = C.PHANTOM_READ_CONFLICT if ph else C.MVCC_READ_CONFLICT
+
+        # phase 3: filter + update batch + history
+        tx_filter = bytes(ptx.code for ptx in txs)
+        batch, history = self._build_updates(block.header.number, txs)
+        return tx_filter, batch, history
+
+    def _mvcc_inputs(self, txs):
+        mvcc_txs = []
+        all_read_keys = set()
+        for ptx in txs:
+            if ptx.rwset is None or not ptx.undetermined:
+                mvcc_txs.append(mvcc_ops.TxRWSet(reads=[], writes=[], range_reads=[]))
+                continue
+            reads, writes, rqs = ptx.rwset.mvcc_form()
+            mvcc_txs.append(
+                mvcc_ops.TxRWSet(reads=reads, writes=writes, range_reads=rqs)
+            )
+            all_read_keys.update(k for k, _ in reads)
+        committed = {}
+        if all_read_keys:
+            pub_keys = [
+                (k[1], k[2]) for k in all_read_keys if k[0] == "pub"
+            ]
+            vers = self.state.get_versions_bulk(pub_keys)
+            for k in all_read_keys:
+                if k[0] == "pub" and (k[1], k[2]) in vers:
+                    committed[k] = vers[(k[1], k[2])]
+                elif k[0] == "pvt":
+                    v = self.state.get_version(f"{k[1]}${k[2]}#hashed", _hex(k[3]))
+                    if v is not None:
+                        committed[k] = v
+        return mvcc_txs, committed
+
+    def _build_updates(self, block_num: int, txs):
+        batch = UpdateBatch()
+        history = []
+        for ptx in txs:
+            if ptx.code != C.VALID or ptx.rwset is None:
+                continue
+            ver = (block_num, ptx.idx)
+            for ns_name in sorted(ptx.rwset.ns):
+                n = ptx.rwset.ns[ns_name]
+                for key in sorted(n.writes):
+                    val = n.writes[key]
+                    if val is None:
+                        batch.delete(ns_name, key, ver)
+                    else:
+                        batch.put(ns_name, key, val, ver)
+                    history.append((ns_name, key, ptx.idx))
+                for coll in sorted(n.hashed):
+                    hns = f"{ns_name}${coll}#hashed"
+                    for kh, (vh, is_del) in sorted(n.hashed[coll].get("writes", {}).items()):
+                        if is_del:
+                            batch.delete(hns, _hex(kh), ver)
+                        else:
+                            batch.put(hns, _hex(kh), vh, ver)
+        return batch, history
+
+
+class DefaultValidation(ValidationPlugin):
+    """Built-in plugin (analog builtin/default_validation.go +
+    v20/validation_logic.go): evaluate each tx's chaincode policy over
+    its verified endorsements."""
+
+    def validate_batch_group(self, ctx: BlockValidationCtx, group):
+        out = []
+        for ptx in group:
+            ok_all = True
+            for ns in ptx.namespaces:
+                info = ctx.policy_provider.info(ns)
+                plan = pol.compile_plan(info.policy)
+                idents = [ident for (_, ident) in ptx.endorsements]
+                m = pol.match_matrix(idents, plan.principals)
+                valid = np.array(
+                    [ctx.sig_valid[i] for i in ptx.endo_item_idx], bool
+                )
+                m = m & valid[:, None] if len(idents) else m
+                if plan.consumption_safe(m):
+                    ok = plan.evaluate_counts(m)
+                else:
+                    ok = pol.evaluate(info.policy, m)
+                if not ok:
+                    ok_all = False
+                    break
+            out.append(ok_all)
+        return out
+
+
+def _sig_item(ident: Identity, message: bytes, der_sig: bytes):
+    r, s = sig_to_ints(der_sig)
+    qx, qy = ident.public_numbers
+    return (int.from_bytes(hashlib.sha256(message).digest(), "big"), r, s, qx, qy)
+
+
+def _hex(b: bytes) -> str:
+    return b.hex()
