@@ -150,16 +150,37 @@ class MSP:
         self._cache[serialized] = ident
         return ident
 
-    def _chain_ok(self, cert: x509.Certificate) -> bool:
-        now = datetime.datetime.now(datetime.timezone.utc)
+    def _cert_ok(self, cert: x509.Certificate, now) -> bool:
+        """Validity window + revocation — applied to EVERY cert in the
+        chain, not just the leaf (the reference's Go x509 verifier
+        checks windows chain-wide; CRLs apply per issuing CA)."""
         if not (cert.not_valid_before_utc <= now <= cert.not_valid_after_utc):
             return False
-        if cert.serial_number in self.revoked_serials:
+        return cert.serial_number not in self.revoked_serials
+
+    def _chain_ok(self, cert: x509.Certificate) -> bool:
+        """ANY fully valid chain accepts the cert — a failing candidate
+        chain (e.g. an expired intermediate whose renewed reissue is
+        also configured, as after CA rotation) must not preempt a valid
+        alternate path."""
+        now = datetime.datetime.now(datetime.timezone.utc)
+        if not self._cert_ok(cert, now):
             return False
+
+        def root_anchored(c: x509.Certificate) -> bool:
+            return any(
+                _verify_issued_by(c, root) and self._cert_ok(root, now)
+                for root in self.roots
+            )
+
         for ca in self.intermediates:
-            if _verify_issued_by(cert, ca):
-                return any(_verify_issued_by(ca, root) for root in self.roots)
-        return any(_verify_issued_by(cert, root) for root in self.roots)
+            if (
+                _verify_issued_by(cert, ca)
+                and self._cert_ok(ca, now)
+                and root_anchored(ca)
+            ):
+                return True
+        return root_anchored(cert)
 
     def _validate(self, ident: Identity) -> None:
         ident.is_valid = self._chain_ok(ident.cert)

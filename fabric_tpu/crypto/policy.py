@@ -159,6 +159,7 @@ class BatchPlan:
 
     principals: list = field(default_factory=list)
     leaf_principal: list = field(default_factory=list)
+    leaf_rank: list = field(default_factory=list)
     gates: list = field(default_factory=list)
 
     @property
@@ -166,20 +167,28 @@ class BatchPlan:
         return len(self.leaf_principal)
 
     def leaf_sat(self, match):
-        """match: [S, P] bool (sig × principal) → [n_leaves] bool."""
+        """match: [S, P] bool (sig × principal) → [n_leaves] bool.
+
+        Leaf truth under consumption: the r-th leaf (in evaluation
+        order) referencing principal column p is satisfied iff at least
+        r+1 signatures match p — so repeated-principal policies like
+        ``OutOf(2, 'Org1.member', 'Org1.member')`` need two DISTINCT
+        signatures (cauthdsl.go greedy consumption; exact whenever
+        ``consumption_safe``)."""
         import numpy as np
 
+        if self.n_leaves == 0:
+            return np.zeros(0, bool)
         m = np.asarray(match)
         if m.size == 0:
             return np.zeros(self.n_leaves, bool)
-        anyp = m.any(axis=0)  # [P]
-        return anyp[np.asarray(self.leaf_principal, int)]
+        counts = m.sum(axis=0)  # [P] distinct sigs matching each column
+        cols = np.asarray(self.leaf_principal, int)
+        ranks = np.asarray(self.leaf_rank, int)
+        return ranks < counts[cols]
 
     def evaluate_counts(self, match) -> bool:
-        """Count-based evaluation (no consumption): exact when
-        ``consumption_safe``."""
-        import numpy as np
-
+        """Count-based evaluation: exact when ``consumption_safe``."""
         vals = list(self.leaf_sat(match))
         for n, children in self.gates:
             vals.append(sum(bool(vals[c]) for c in children) >= n)
@@ -210,11 +219,19 @@ def compile_plan(rule) -> BatchPlan:
             plan.principals.append(principal)
         return pindex[principal]
 
+    col_uses: dict = {}
+
     # first pass: count leaves to lay out slots
     def walk(node):
         if isinstance(node, SignedBy):
             slot = plan.n_leaves
-            plan.leaf_principal.append(leaf_col(node.principal))
+            col = leaf_col(node.principal)
+            plan.leaf_principal.append(col)
+            # rank of this leaf among leaves of the same column, in
+            # evaluation (DFS, left-to-right) order — consumption's
+            # per-column signature budget index
+            plan.leaf_rank.append(col_uses.get(col, 0))
+            col_uses[col] = col_uses.get(col, 0) + 1
             return ("leaf", slot)
         if isinstance(node, NOutOf):
             children = [walk(r) for r in node.rules]

@@ -153,7 +153,11 @@ class TxRWSet:
             for start, end, results in n.range_queries:
                 for k, ver in results:
                     reads.append((("pub", name, k), ver))
-                rqs.append((("pub", name, start), ("pub", name, end)))
+                # end == "" is an unbounded (to namespace end) scan;
+                # ns+"\x00" sorts after every ("pub", name, k) key, so
+                # the id interval covers the whole namespace
+                hi = ("pub", name, end) if end else ("pub", name + "\x00", "")
+                rqs.append((("pub", name, start), hi))
             for coll in sorted(n.hashed):
                 cdata = n.hashed[coll]
                 for kh, ver in sorted(cdata.get("reads", {}).items()):

@@ -31,21 +31,24 @@ from functools import partial
 
 
 @partial(jax.jit, static_argnames=("gates",))
-def eval_plan_batch(valid, sat, leaf_principal, gates):
+def eval_plan_batch(valid, sat, leaf_principal, leaf_rank, gates):
     """Evaluate one policy plan over a batch of transactions.
 
     valid: [T, S] bool — signature validity per endorsement slot
         (False for empty slots).
     sat:   [T, S, P] bool — slot s satisfies principal column p.
     leaf_principal: [L] int32 — principal column per leaf.
+    leaf_rank: [L] int32 — per-column evaluation-order rank, so the
+        r-th leaf of a column needs r+1 matching signatures (the
+        consumption budget; crypto/policy.BatchPlan.leaf_sat).
     gates: static tuple of (n, child_slots) — slots < L are leaves,
         slot L+i is gate i; last gate is the root.
 
     Returns ok [T] bool.
     """
     hit = valid[:, :, None] & sat  # [T, S, P]
-    any_p = jnp.any(hit, axis=1)  # [T, P]
-    leaf = jnp.take(any_p, leaf_principal, axis=1)  # [T, L]
+    counts = jnp.sum(hit.astype(jnp.int32), axis=1)  # [T, P]
+    leaf = jnp.take(counts, leaf_principal, axis=1) > leaf_rank[None, :]  # [T, L]
     vals = [leaf[:, i] for i in range(leaf.shape[1])]
     for n, children in gates:
         acc = jnp.zeros(valid.shape[0], jnp.int32)
@@ -67,5 +70,6 @@ def eval_block(plan, valid, sat):
         jnp.asarray(valid),
         jnp.asarray(sat),
         jnp.asarray(np.asarray(plan.leaf_principal, np.int32)),
+        jnp.asarray(np.asarray(plan.leaf_rank, np.int32)),
         gates,
     )

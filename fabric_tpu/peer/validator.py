@@ -44,7 +44,7 @@ from fabric_tpu.ledger.rwset import TxRWSet
 from fabric_tpu.ledger.statedb import UpdateBatch
 from fabric_tpu.ops import mvcc as mvcc_ops
 from fabric_tpu.ops import p256
-from fabric_tpu.protos import common_pb2, transaction_pb2
+from fabric_tpu.protos import common_pb2, configtx_pb2, transaction_pb2
 
 C = transaction_pb2.TxValidationCode
 
@@ -117,12 +117,14 @@ class BlockValidator:
         state_db,
         block_store=None,
         plugins: dict[str, ValidationPlugin] | None = None,
+        config_processor=None,
     ):
         self.msp = msp_manager
         self.policies = policy_provider
         self.state = state_db
         self.blocks = block_store
         self.plugins = {"default": DefaultValidation(), **(plugins or {})}
+        self.config_processor = config_processor
 
     # -- phase 0: parse + collect -----------------------------------------
 
@@ -151,15 +153,32 @@ class BlockValidator:
             ptx.txid, ptx.channel, ptx.creator = ch.tx_id, ch.channel_id, sh.creator
 
             if ch.type == common_pb2.HeaderType.CONFIG:
-                # config txs are validated by the config machinery, not
-                # the endorsement pipeline (v20/validator.go:397-419)
+                # config txs go to the config machinery, not the
+                # endorsement pipeline (v20/validator.go:397-419): the
+                # creator signature still rides the block's signature
+                # batch; structure + policy checks happen in
+                # _validate_config after phase 1a.
                 ptx.is_config = True
-                ptx.code = C.VALID
+                try:
+                    ident = self.msp.deserialize_identity(sh.creator)
+                    if not ident.is_valid:
+                        raise ValueError("invalid creator identity")
+                    item = _sig_item(ident, env.payload, env.signature)
+                except Exception:
+                    ptx.code = C.BAD_CREATOR_SIGNATURE
+                    continue
+                ptx.creator_item_idx = len(items)
+                items.append(item)
                 continue
             if ch.type != common_pb2.HeaderType.ENDORSER_TRANSACTION:
                 ptx.code = C.UNKNOWN_TX_TYPE
                 continue
-            if not ch.tx_id:
+            # txid binding: tx_id must equal sha256(nonce ‖ creator) —
+            # prevents txid squatting / DUPLICATE_TXID poisoning
+            # (protoutil/proputils.go:362 CheckTxID)
+            if not ch.tx_id or ch.tx_id != protoutil.compute_tx_id(
+                sh.nonce, sh.creator
+            ):
                 ptx.code = C.BAD_PROPOSAL_TXID
                 continue
             # dup txid: in-block + vs ledger (v20/validator.go:460-481)
@@ -193,12 +212,20 @@ class BlockValidator:
                 ptx.rwset = TxRWSet.from_bytes(cca.results)
                 ptx.namespaces = tuple(sorted(ptx.rwset.ns))
                 prp_bytes = cap.action.proposal_response_payload
+                seen_endorsers: set[bytes] = set()
                 for e in cap.action.endorsements:
+                    # dedup by identity: a repeated endorser counts as
+                    # ONE signature toward the policy (reference
+                    # SignatureSetToValidIdentities,
+                    # common/policies/policy.go:360-363)
+                    if e.endorser in seen_endorsers:
+                        continue
                     try:
                         eident = self.msp.deserialize_identity(e.endorser)
                         eitem = _sig_item(eident, prp_bytes + e.endorser, e.signature)
                     except Exception:
                         continue  # unparseable endorsement: contributes nothing
+                    seen_endorsers.add(e.endorser)
                     ptx.endo_item_idx.append(len(items))
                     ptx.endorsements.append((e.endorser, eident))
                     items.append(eitem)
@@ -223,34 +250,45 @@ class BlockValidator:
                 if not sig_valid[ptx.creator_item_idx]:
                     ptx.code = C.BAD_CREATOR_SIGNATURE
 
-        # phase 1b: per-namespace plugin dispatch (policy reduction)
+        # config txs: structural + signature + config-machinery checks
+        # (v20/validator.go:397-419 — never rubber-stamped)
+        for ptx in txs:
+            if ptx.is_config and ptx.undetermined:
+                ptx.code = self._validate_config(block, ptx)
+
+        # phase 1b: per-namespace plugin dispatch (policy reduction).
+        # A tx is valid only if EVERY written namespace's plugin
+        # approves it (plugindispatcher/dispatcher.go:190-217).
         ctx = BlockValidationCtx(
             txs=txs, sig_valid=sig_valid, msp_manager=self.msp,
             policy_provider=self.policies,
         )
-        by_plugin: dict[str, list[ParsedTx]] = {}
+        by_plugin: dict[str, list[tuple[ParsedTx, tuple]]] = {}
         for ptx in txs:
-            if not ptx.undetermined:
+            if not ptx.undetermined or ptx.is_config:
                 continue
-            plugin = "default"
             infos = [self.policies.info(ns) for ns in ptx.namespaces]
             if not ptx.namespaces or any(i is None for i in infos):
                 ptx.code = C.INVALID_CHAINCODE
                 continue
-            if infos and infos[0].plugin:
-                plugin = infos[0].plugin
-            by_plugin.setdefault(plugin, []).append(ptx)
+            for ns, info in zip(ptx.namespaces, infos):
+                name = info.plugin or "default"
+                by_plugin.setdefault(name, []).append((ptx, ns))
         for name, group in by_plugin.items():
             plug = self.plugins.get(name)
             if plug is None:
-                for ptx in group:
+                for ptx, _ in group:
                     ptx.code = C.INVALID_OTHER_REASON
                 continue
-            ok = plug.validate_batch_group(ctx, group) if hasattr(
-                plug, "validate_batch_group"
-            ) else plug.validate_batch(ctx)
-            for ptx, good in zip(group, ok):
-                if not good:
+            if hasattr(plug, "validate_batch_group"):
+                ok = plug.validate_batch_group(ctx, group)
+            else:
+                # legacy SPI returns [T] per-tx verdicts; realign to the
+                # per-(tx, namespace) group entries by block position
+                per_tx = plug.validate_batch(ctx)
+                ok = [per_tx[ptx.idx] for ptx, _ in group]
+            for (ptx, _), good in zip(group, ok):
+                if not good and ptx.undetermined:
                     ptx.code = C.ENDORSEMENT_POLICY_FAILURE
 
         # phase 2: MVCC over the whole block
@@ -280,6 +318,17 @@ class BlockValidator:
             if ptx.rwset is None or not ptx.undetermined:
                 mvcc_txs.append(mvcc_ops.TxRWSet(reads=[], writes=[], range_reads=[]))
                 continue
+            # re-execute range queries against COMMITTED state: a key
+            # committed after simulation but inside the range is a
+            # phantom even with no in-block writer (the reference
+            # merges committed state into the range re-check,
+            # validation/validator.go:205-247, combined_iterator.go:44).
+            # Per-result version staleness rides the normal read checks;
+            # in-block writers ride the id-interval kernel check.
+            if self._committed_range_phantom(ptx):
+                ptx.code = C.PHANTOM_READ_CONFLICT
+                mvcc_txs.append(mvcc_ops.TxRWSet(reads=[], writes=[], range_reads=[]))
+                continue
             reads, writes, rqs = ptx.rwset.mvcc_form()
             mvcc_txs.append(
                 mvcc_ops.TxRWSet(reads=reads, writes=writes, range_reads=rqs)
@@ -299,6 +348,36 @@ class BlockValidator:
                     if v is not None:
                         committed[k] = v
         return mvcc_txs, committed
+
+    def _committed_range_phantom(self, ptx) -> bool:
+        """True iff some committed key falls inside a recorded range
+        query but is missing from its recorded results (end_key == ''
+        means unbounded, per the reference's open-ended iterators)."""
+        for ns_name, n in ptx.rwset.ns.items():
+            for start, end, results in n.range_queries:
+                recorded = {k for k, _ in results}
+                for key, _vv in self.state.get_state_range(ns_name, start, end):
+                    if key not in recorded:
+                        return True
+        return False
+
+    def _validate_config(self, block, ptx) -> int:
+        """Config-tx validation: structure must parse as a
+        ConfigEnvelope and the configured processor must accept it —
+        CONFIG envelopes are never rubber-stamped
+        (v20/validator.go:397-419)."""
+        try:
+            env = protoutil.unmarshal(common_pb2.Envelope, block.data.data[ptx.idx])
+            payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+            cfg_env = protoutil.unmarshal(configtx_pb2.ConfigEnvelope, payload.data)
+        except Exception:
+            return C.BAD_PAYLOAD
+        if self.config_processor is not None:
+            try:
+                return self.config_processor.validate_config_tx(ptx, cfg_env)
+            except Exception:
+                return C.INVALID_OTHER_REASON
+        return C.VALID
 
     def _build_updates(self, block_num: int, txs):
         batch = UpdateBatch()
@@ -328,30 +407,40 @@ class BlockValidator:
 
 class DefaultValidation(ValidationPlugin):
     """Built-in plugin (analog builtin/default_validation.go +
-    v20/validation_logic.go): evaluate each tx's chaincode policy over
-    its verified endorsements."""
+    v20/validation_logic.go): evaluate one (tx, namespace) pair's
+    chaincode policy over the tx's verified endorsements.  Plans are
+    compiled once per policy object and cached (the reference caches
+    per plugin^channel, plugin_validator.go)."""
+
+    def __init__(self):
+        # keyed by the (frozen, hashable) policy AST itself — id()-keys
+        # could alias a recycled address after a config update GCs the
+        # old policy object
+        self._plan_cache: dict[object, pol.BatchPlan] = {}
+
+    def _plan(self, policy) -> pol.BatchPlan:
+        plan = self._plan_cache.get(policy)
+        if plan is None:
+            plan = pol.compile_plan(policy)
+            self._plan_cache[policy] = plan
+        return plan
 
     def validate_batch_group(self, ctx: BlockValidationCtx, group):
         out = []
-        for ptx in group:
-            ok_all = True
-            for ns in ptx.namespaces:
-                info = ctx.policy_provider.info(ns)
-                plan = pol.compile_plan(info.policy)
-                idents = [ident for (_, ident) in ptx.endorsements]
-                m = pol.match_matrix(idents, plan.principals)
-                valid = np.array(
-                    [ctx.sig_valid[i] for i in ptx.endo_item_idx], bool
-                )
-                m = m & valid[:, None] if len(idents) else m
-                if plan.consumption_safe(m):
-                    ok = plan.evaluate_counts(m)
-                else:
-                    ok = pol.evaluate(info.policy, m)
-                if not ok:
-                    ok_all = False
-                    break
-            out.append(ok_all)
+        for ptx, ns in group:
+            info = ctx.policy_provider.info(ns)
+            plan = self._plan(info.policy)
+            idents = [ident for (_, ident) in ptx.endorsements]
+            m = pol.match_matrix(idents, plan.principals)
+            valid = np.array(
+                [ctx.sig_valid[i] for i in ptx.endo_item_idx], bool
+            )
+            m = m & valid[:, None] if len(idents) else m
+            if plan.consumption_safe(m):
+                ok = plan.evaluate_counts(m)
+            else:
+                ok = pol.evaluate(info.policy, m)
+            out.append(bool(ok))
         return out
 
 

@@ -126,3 +126,66 @@ def test_nested_plan_compile():
     m = pol.match_matrix(idents, plan.principals)
     assert plan.evaluate_counts(m)
     assert pol.evaluate(rule, m)
+
+
+def test_repeated_principal_needs_distinct_signatures():
+    """OutOf(2, A, A) — the standard "two endorsers from one org"
+    policy — must NOT be satisfied by one signature counted twice
+    (round-1/2 endorsement-policy bypass regression)."""
+    a = pol.SignedBy(pol.Principal("A"))
+    rule = pol.NOutOf(2, (a, a))
+    plan, m = _sat(rule, [FakeIdentity("A")])
+    assert plan.consumption_safe(m)  # one column only — counts path taken
+    assert not plan.evaluate_counts(m)
+    assert not pol.evaluate(rule, m)
+    plan, m = _sat(rule, [FakeIdentity("A"), FakeIdentity("A")])
+    assert plan.evaluate_counts(m)
+    assert pol.evaluate(rule, m)
+
+
+def test_counts_equal_interpreter_with_repeats(rng):
+    """Randomized with REPEATED principals allowed: counts == greedy
+    interpreter whenever consumption_safe (single-column matches keep
+    the condition true even with repeats)."""
+    orgs = ["O1", "O2", "O3"]
+    for trial in range(300):
+        k = int(rng.integers(1, 6))
+        leaves = [pol.SignedBy(pol.Principal(str(o)))
+                  for o in rng.choice(orgs, k, replace=True)]
+        n = int(rng.integers(0, k + 1))
+        rule = pol.NOutOf(n, tuple(leaves))
+        idents = [FakeIdentity(str(o)) for o in rng.choice(orgs, rng.integers(0, 6))]
+        plan, m = _sat(rule, idents)
+        assert plan.consumption_safe(m)
+        assert plan.evaluate_counts(m) == pol.evaluate(rule, m), (rule, idents)
+
+
+def test_batch_kernel_repeated_principal(rng):
+    """Device kernel honors per-column consumption budgets."""
+    from fabric_tpu.ops import policy_eval
+
+    a = pol.SignedBy(pol.Principal("A"))
+    rule = pol.NOutOf(2, (a, a))
+    plan = pol.compile_plan(rule)
+    # tx0: one A-signature; tx1: two A-signatures
+    valid = np.array([[True, False], [True, True]])
+    sat = np.ones((2, 2, 1), bool)
+    got = np.asarray(policy_eval.eval_block(plan, valid, sat))
+    assert list(got) == [False, True]
+
+
+def test_nested_repeated_principals_across_gates(rng):
+    """Leaves of the same principal under DIFFERENT gates share the
+    signature pool (greedy DFS order), and counts must agree."""
+    a = pol.SignedBy(pol.Principal("A"))
+    b = pol.SignedBy(pol.Principal("B"))
+    rule = pol.And(pol.Or(a, b), a)  # A-sig consumed by first OR branch
+    plan, m = _sat(rule, [FakeIdentity("A")])
+    assert plan.consumption_safe(m)
+    assert plan.evaluate_counts(m) == pol.evaluate(rule, m) == False  # noqa: E712
+    # [A, B]: the OR consumes BOTH (children always evaluated, no
+    # short-circuit — cauthdsl), leaving nothing for the outer A leaf
+    plan, m = _sat(rule, [FakeIdentity("A"), FakeIdentity("B")])
+    assert plan.evaluate_counts(m) == pol.evaluate(rule, m) == False  # noqa: E712
+    plan, m = _sat(rule, [FakeIdentity("A"), FakeIdentity("A")])
+    assert plan.evaluate_counts(m) == pol.evaluate(rule, m) == True  # noqa: E712

@@ -86,7 +86,9 @@ def test_valid_and_policy_failure(net, validator):
 
 def test_tampered_endorsement_rejected(net, validator):
     env, _ = _tx(net, [net["p1"], net["p2"]], writes=[("k", b"v")])
-    # corrupt one endorsement signature byte
+    # corrupt one endorsement signature byte, then RE-SIGN the envelope
+    # as the creator so only the endorsement check can fire (a stale
+    # envelope signature would trip BAD_CREATOR_SIGNATURE first)
     payload = pu.unmarshal(common_pb2.Payload, env.payload)
     tx = pu.unmarshal(transaction_pb2.Transaction, payload.data)
     cap = pu.unmarshal(transaction_pb2.ChaincodeActionPayload, tx.actions[0].payload)
@@ -95,9 +97,7 @@ def test_tampered_endorsement_rejected(net, validator):
     cap.action.endorsements[1].signature = bytes(sig)
     tx.actions[0].payload = cap.SerializeToString()
     payload.data = tx.SerializeToString()
-    env2 = common_pb2.Envelope(
-        payload=payload.SerializeToString(), signature=env.signature
-    )
+    env2 = pu.sign_envelope(payload, net["client"])
     flt, _, _ = validator.validate(_block([env2]))
     assert list(flt) == [C.ENDORSEMENT_POLICY_FAILURE]
 
@@ -158,3 +158,102 @@ def test_garbage_envelope(net, validator):
     env = common_pb2.Envelope(payload=b"\x01\x02garbage")
     flt, _, _ = validator.validate(_block([env]))
     assert list(flt) == [C.BAD_PAYLOAD]
+
+
+def _rwset_ranges(ranges, reads=(), writes=(), ns=CC):
+    """rwset with range queries: ranges = [(start, end, [(key, ver)])]."""
+    tx = TxRWSet()
+    n = tx.ns_rwset(ns)
+    for k, ver in reads:
+        n.reads[k] = ver
+    for k, v in writes:
+        n.writes[k] = v
+    for start, end, results in ranges:
+        n.range_queries.append((start, end, list(results)))
+    return tx.to_proto().SerializeToString()
+
+
+def _tx_raw(net, endorsers, rwset_bytes, signer=None, ns=CC):
+    signer = signer or net["client"]
+    signed, tx_id, prop = txa.create_signed_proposal(signer, CHANNEL, ns, [b"invoke"])
+    responses = [
+        txa.create_proposal_response(prop, rwset_bytes, e, ns) for e in endorsers
+    ]
+    return txa.assemble_transaction(prop, responses, signer), tx_id
+
+
+def test_repeated_endorsement_not_double_counted(net):
+    """A client repeating one endorser's endorsement must not satisfy a
+    2-of-same-org policy (round-1/2 bypass #2 regression)."""
+    state = MemVersionedDB()
+    policy = pol.from_dsl("OutOf(2, 'Org1MSP.member', 'Org1MSP.member')")
+    prov = PolicyProvider({CC: NamespaceInfo(policy=policy)})
+    v = BlockValidator(net["mgr"], prov, state)
+    # same endorser twice → ONE signature toward the policy
+    env_dup, _ = _tx(net, [net["p1"], net["p1"]], writes=[("k", b"v")])
+    # two distinct Org1 members → satisfied
+    env_ok, _ = _tx(net, [net["p1"], net["client"]], writes=[("k2", b"v")])
+    flt, _, _ = v.validate(_block([env_dup, env_ok]))
+    assert list(flt) == [C.ENDORSEMENT_POLICY_FAILURE, C.VALID]
+
+
+def test_txid_binding(net, validator):
+    """tx_id must equal sha256(nonce ‖ creator) — squatting rejected."""
+    env, _ = _tx(net, [net["p1"], net["p2"]], writes=[("k", b"v")])
+    payload = pu.unmarshal(common_pb2.Payload, env.payload)
+    ch = pu.unmarshal(common_pb2.ChannelHeader, payload.header.channel_header)
+    ch.tx_id = "f" * 64  # squat someone else's id space
+    payload.header.channel_header = ch.SerializeToString()
+    env2 = pu.sign_envelope(payload, net["client"])
+    flt, _, _ = validator.validate(_block([env2]))
+    assert list(flt) == [C.BAD_PROPOSAL_TXID]
+
+
+def test_committed_state_range_phantom(net, validator):
+    """A key committed inside a recorded range but missing from its
+    results is a phantom even with NO in-block writer (the reference
+    merges committed state into the range re-check)."""
+    # validator fixture state has CC/"existing"@(1,0)
+    ok_results = [("existing", (1, 0))]
+    env_ok, _ = _tx_raw(net, [net["p1"], net["p2"]],
+                        _rwset_ranges([("a", "z", ok_results)]))
+    env_phantom, _ = _tx_raw(net, [net["p1"], net["p2"]],
+                             _rwset_ranges([("a", "z", [])]))  # missed it
+    flt, _, _ = validator.validate(_block([env_ok, env_phantom]))
+    assert list(flt) == [C.VALID, C.PHANTOM_READ_CONFLICT]
+
+
+def test_unbounded_range_phantom_in_block(net, validator):
+    """end_key == '' scans to the namespace end: an in-block write far
+    beyond any bounded guess must still phantom the range."""
+    env_w, _ = _tx(net, [net["p1"], net["p2"]], writes=[("zzzz", b"v")])
+    env_rq, _ = _tx_raw(
+        net, [net["p1"], net["p2"]],
+        _rwset_ranges([("existing", "", [("existing", (1, 0))])]),
+    )
+    flt, _, _ = validator.validate(_block([env_w, env_rq]))
+    assert list(flt) == [C.VALID, C.PHANTOM_READ_CONFLICT]
+
+
+def test_range_results_stale_version(net, validator):
+    """Recorded range results carry versions; staleness fails the tx."""
+    env, _ = _tx_raw(net, [net["p1"], net["p2"]],
+                     _rwset_ranges([("a", "z", [("existing", (0, 0))])]))
+    flt, _, _ = validator.validate(_block([env]))
+    assert list(flt) == [C.MVCC_READ_CONFLICT]
+
+
+def test_config_tx_garbage_rejected(net, validator):
+    """CONFIG envelopes are not rubber-stamped: unparseable config
+    payloads and bad signatures are rejected."""
+    ch = pu.make_channel_header(common_pb2.HeaderType.CONFIG, CHANNEL)
+    sh = pu.make_signature_header(net["client"].serialized, b"n")
+    payload = pu.make_payload(ch, sh, b"\x01\x02\x03garbage-not-a-config")
+    env = pu.sign_envelope(payload, net["client"])
+    flt, _, _ = validator.validate(_block([env]))
+    assert list(flt) == [C.BAD_PAYLOAD]
+
+    env2 = pu.sign_envelope(pu.make_payload(ch, sh, b""), net["client"])
+    env2.signature = bytes(len(env2.signature))
+    flt, _, _ = validator.validate(_block([env2]))
+    assert list(flt) == [C.BAD_CREATOR_SIGNATURE]
