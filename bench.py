@@ -121,7 +121,223 @@ def _bench_sha256():
     }
 
 
+def _build_commit_network(n_tx: int):
+    """3 orgs, 2-of-3 endorsement policy, n_tx signed txs reading seeded
+    keys and writing fresh ones — the BASELINE.json config-#2 workload
+    (1000-tx block through the validator, 2-of-3 ECDSA-P256)."""
+    from fabric_tpu import protoutil as pu
+    from fabric_tpu.crypto import cryptogen, policy as pol
+    from fabric_tpu.crypto.msp import MSPManager
+    from fabric_tpu.ledger.rwset import TxRWSet
+    from fabric_tpu.ledger.statedb import MemVersionedDB, UpdateBatch
+    from fabric_tpu.peer import txassembly as txa
+    from fabric_tpu.peer.validator import (
+        BlockValidator, NamespaceInfo, PolicyProvider,
+    )
+
+    CHANNEL, CC = "benchchan", "benchcc"
+    orgs = [
+        cryptogen.generate_org(f"Org{i}MSP", f"org{i}.example.com", peers=1, users=1)
+        for i in (1, 2, 3)
+    ]
+    mgr = MSPManager({o.msp().msp_id: o.msp() for o in orgs})
+    peers = [
+        cryptogen.signing_identity(o, f"peer0.org{i}.example.com")
+        for i, o in zip((1, 2, 3), orgs)
+    ]
+    client = cryptogen.signing_identity(orgs[0], "User1@org1.example.com")
+    policy = pol.from_dsl(
+        "OutOf(2, 'Org1MSP.peer', 'Org2MSP.peer', 'Org3MSP.peer')"
+    )
+    prov = PolicyProvider({CC: NamespaceInfo(policy=policy)})
+
+    seed = UpdateBatch()
+    for i in range(n_tx):
+        seed.put(CC, f"seed{i:05d}", b"genesis", (1, 0))
+        seed.put(CC, f"ro{i:05d}", b"genesis", (1, 0))
+
+    envs = []
+    for i in range(n_tx):
+        _, _, prop = txa.create_signed_proposal(client, CHANNEL, CC, [b"invoke"])
+        tx = TxRWSet()
+        ns = tx.ns_rwset(CC)
+        ns.reads[f"seed{i:05d}"] = (1, 0)
+        ns.reads[f"ro{i:05d}"] = (1, 0)  # read-only pool: never written in-block
+        ns.writes[f"w{i:05d}"] = b"value-%d" % i
+        ns.writes[f"seed{i:05d}"] = b"updated"
+        rw = tx.to_proto().SerializeToString()
+        two = (peers[i % 3], peers[(i + 1) % 3])  # rotating 2-of-3
+        resps = [txa.create_proposal_response(prop, rw, e, CC) for e in two]
+        envs.append(txa.assemble_transaction(prop, resps, client))
+
+    blk = pu.new_block(2, b"prevhash")
+    for env in envs:
+        blk.data.data.append(env.SerializeToString())
+    blk = pu.finalize_block(blk)
+
+    def fresh_state():
+        db = MemVersionedDB()
+        db.apply_updates(seed, (1, 0))
+        return db
+
+    def fresh_validator(state):
+        return BlockValidator(mgr, prov, state)
+
+    return blk, fresh_state, fresh_validator, mgr, prov, CC
+
+
+def _serial_baseline_validate(blk, mgr, prov, state):
+    """The reference's commit path re-done serially on host CPU: per tx
+    parse → creator sig (OpenSSL) → endorsement sigs (OpenSSL) →
+    consumption policy walk → serial MVCC with write application
+    (v20/validator.go:180 + validation/validator.go:81, one thread)."""
+    import numpy as np
+
+    from fabric_tpu import protoutil as pu
+    from fabric_tpu.crypto import policy as pol
+    from fabric_tpu.ledger.rwset import TxRWSet
+    from fabric_tpu.protos import common_pb2, transaction_pb2
+
+    C = transaction_pb2.TxValidationCode
+    codes = []
+    updates: dict = {}
+    plan_cache: dict = {}  # compile once per namespace, like the reference
+    for env_bytes in blk.data.data:
+        env = pu.unmarshal(common_pb2.Envelope, env_bytes)
+        try:
+            ch, sh, cap, prp, cca = pu.extract_action(env)
+        except pu.TxParseError as e:
+            codes.append(e.code)
+            continue
+        creator = mgr.deserialize_identity(sh.creator)
+        if not creator.is_valid or not creator.verify(env.payload, env.signature):
+            codes.append(C.BAD_CREATOR_SIGNATURE)
+            continue
+        idents, valid = [], []
+        prp_bytes = cap.action.proposal_response_payload
+        for e in cap.action.endorsements:
+            ident = mgr.deserialize_identity(e.endorser)
+            idents.append(ident)
+            valid.append(
+                ident.is_valid
+                and ident.verify(prp_bytes + e.endorser, e.signature)
+            )
+        rwset = TxRWSet.from_bytes(cca.results)
+        ok = True
+        for ns_name in rwset.ns:
+            info = prov.info(ns_name)
+            if info is None:
+                ok = False
+                break
+            plan = plan_cache.get(ns_name)
+            if plan is None:
+                plan = plan_cache[ns_name] = pol.compile_plan(info.policy)
+            m = pol.match_matrix(idents, plan.principals)
+            m = m & np.asarray(valid, bool)[:, None]
+            if not pol.evaluate(info.policy, m):
+                ok = False
+                break
+        if not ok:
+            codes.append(C.ENDORSEMENT_POLICY_FAILURE)
+            continue
+        # serial MVCC vs committed state + in-block updates
+        conflict = False
+        for ns_name, n in rwset.ns.items():
+            for k, ver in n.reads.items():
+                if (ns_name, k) in updates:
+                    conflict = True
+                    break
+                cv = state.get_version(ns_name, k)
+                if cv != ver:
+                    conflict = True
+                    break
+            if conflict:
+                break
+        if conflict:
+            codes.append(C.MVCC_READ_CONFLICT)
+            continue
+        for ns_name, n in rwset.ns.items():
+            for k in n.writes:
+                updates[(ns_name, k)] = True
+        codes.append(C.VALID)
+    return bytes(codes), updates
+
+
+def _bench_block_commit(n_tx: int = 1000):
+    """North-star metric (BASELINE.json): validated tx/s per peer on
+    1000-tx blocks with a 2-of-3 ECDSA-P256 endorsement policy, through
+    BlockValidator.validate + KVLedger.commit_block, vs the same work
+    done serially on one host CPU thread."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from fabric_tpu.ledger.kvledger import KVLedger
+    from fabric_tpu.protos import common_pb2
+
+    blk, fresh_state, fresh_validator, mgr, prov, _ = _build_commit_network(n_tx)
+
+    def run_tpu():
+        state = fresh_state()
+        v = fresh_validator(state)
+        tmp = tempfile.mkdtemp(prefix="benchledger")
+        lg = KVLedger(tmp, state_db=state, enable_history=True)
+        b = common_pb2.Block()
+        b.CopyFrom(blk)
+        b.header.number = lg.blocks.height  # commit as next block
+        t0 = time.perf_counter()
+        flt, batch, hist = v.validate(b)
+        lg.commit_block(b, flt, batch, hist)
+        dt = time.perf_counter() - t0
+        lg.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+        return dt, flt
+
+    run_tpu()  # compile + warm caches
+    runs = [run_tpu() for _ in range(3)]
+    tpu_s = min(dt for dt, _ in runs)
+    flt = runs[0][1]
+    n_valid = sum(1 for c in flt if c == 0)
+    assert n_valid == n_tx, f"expected all {n_tx} valid, got {n_valid}"
+
+    # serial host baseline (validation + same storage commit machinery)
+    def run_cpu():
+        state = fresh_state()
+        tmp = tempfile.mkdtemp(prefix="benchledgercpu")
+        lg = KVLedger(tmp, state_db=state, enable_history=True)
+        b = common_pb2.Block()
+        b.CopyFrom(blk)
+        b.header.number = lg.blocks.height
+        t0 = time.perf_counter()
+        codes, updates = _serial_baseline_validate(b, mgr, prov, state)
+        from fabric_tpu.ledger.statedb import UpdateBatch
+
+        batch = UpdateBatch()
+        for (ns_name, k) in updates:
+            batch.put(ns_name, k, b"x", (b.header.number, 0))
+        lg.commit_block(b, codes, batch, [])
+        dt = time.perf_counter() - t0
+        lg.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+        return dt, codes
+
+    cpu_runs = [run_cpu() for _ in range(2)]
+    cpu_s = min(dt for dt, _ in cpu_runs)
+    assert sum(1 for c in cpu_runs[0][1] if c == 0) == n_valid
+
+    tpu_rate = n_tx / tpu_s
+    cpu_rate = n_tx / cpu_s
+    return {
+        "metric": f"validated_tx_per_sec_block{n_tx}",
+        "value": round(tpu_rate, 1),
+        "unit": "tx/s",
+        "vs_baseline": round(tpu_rate / cpu_rate, 3),
+    }
+
+
 _BENCHES = {
+    "block_commit": _bench_block_commit,
     "p256_verify": _bench_p256_verify,
     "sha256": _bench_sha256,
 }
@@ -130,7 +346,7 @@ _BENCHES = {
 def main():
     import sys
 
-    name = sys.argv[1] if len(sys.argv) > 1 else "p256_verify"
+    name = sys.argv[1] if len(sys.argv) > 1 else "block_commit"
     result = _BENCHES[name]()
     print(json.dumps(result))
 

@@ -1,0 +1,7 @@
+from fabric_tpu.comm.rpc import (  # noqa: F401
+    RpcClient,
+    RpcError,
+    RpcServer,
+    make_client_tls,
+    make_server_tls,
+)

@@ -1,0 +1,289 @@
+"""Modular big-int arithmetic in a signed-digit representation built
+for the TPU's MXU, shared by the ECDSA kernels (mod p and mod n).
+
+Why not the classic Montgomery-limb form (fabric_tpu.ops.p256): CIOS
+REDC is a 16-step *serial* dependency chain of tiny vector ops, so the
+ladder's depth — not the batch width — dominates wall-clock on TPU
+(round-2 bench: 0.406× one CPU thread).  This module reformulates
+field multiplication so the heavy lifting is matrix multiplies:
+
+* A value is K=43 little-endian signed base-2^6 digits in int32 lanes
+  (canonical digits are 0..63; intermediates may run negative or above
+  64 — the representation is redundant, only the value mod m matters).
+* Digit products stay well under 2^24, so polynomial multiplication is
+  EXACT in float32 — outer product + one [B,K²]@[K²,2K-1] one-hot
+  contraction (MXU) per mul.
+* Modular reduction is LINEAR over the high columns: column k carries
+  c_k·2^(6k) and 2^(6k) mod m is a constant — so reduction is one
+  [B,·]@[·,K] matmul against a precomputed chunk matrix (MXU again),
+  not a serial REDC chain.
+* Carry normalization ("settle") is a short fixed schedule of
+  shift/mask passes and sparse balanced-digit folds (VPU elementwise);
+  addition/subtraction are plain elementwise ± with NO carries.
+
+Exactness discipline: float32 represents integers exactly up to 2^24;
+`bound_check()` runs interval arithmetic over the exact op schedule and
+certifies (a) every f32 matmul's worst-case |partial sum| < 2^24 and
+(b) settled digits meet the documented bounds.  The property tests
+(tests/test_p256v2.py) additionally drive adversarial max-magnitude
+inputs and compare bit-exactly against Python ints.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+W = 6                      # bits per digit
+BASE = 1 << W              # 64
+DMASK = BASE - 1
+K = 43                     # digits per 256-bit value (43*6 = 258 bits)
+PRODCOLS = 2 * K - 1       # columns of a KxK digit product
+F32_EXACT = (1 << 24) - 1  # largest guaranteed-exact f32 integer range
+
+# settle schedule: rounds of (passes, chunked fold) plus a final
+# (pass, fold) tidy stage; certified by bound_check()
+SETTLE_PASSES = 3
+SETTLE_ROUNDS = 3
+
+# |digit| bound contract for mul inputs: |a|_inf * |b|_inf * K < 2^24
+# is sufficient (columns sum at most K products).  SETTLED <= 96 is
+# certified by bound_check(); inputs may be sums of up to 6 settled
+# values on either side ((6*96)^2 * 43 < 2^24).
+SETTLED_MAX = 96
+assert (6 * SETTLED_MAX) ** 2 * K < 1 << 24
+
+
+def int_to_digits(x: int) -> np.ndarray:
+    return np.array([(x >> (W * i)) & DMASK for i in range(K)], np.int32)
+
+
+def ints_to_digits(xs) -> np.ndarray:
+    if not len(xs):
+        return np.zeros((0, K), np.int32)
+    return np.stack([int_to_digits(int(x)) for x in xs])
+
+
+def digits_to_int(row) -> int:
+    return sum(int(d) << (W * i) for i, d in enumerate(np.asarray(row)))
+
+
+def _balanced_digits(x: int, n: int) -> np.ndarray:
+    """n signed digits in [-32, 32] representing x (minimizes |digit|,
+    so folds re-inject as little magnitude as possible)."""
+    out = np.zeros(n, np.int64)
+    for i in range(n):
+        d = x & DMASK
+        if d > BASE // 2:
+            d -= BASE
+        out[i] = d
+        x = (x - d) >> W
+    assert x == 0, "balanced_digits overflow"
+    return out.astype(np.int32)
+
+
+class DigitMod:
+    """Precomputed reduction/fold matrices for one modulus m < 2^257."""
+
+    def __init__(self, m: int):
+        self.m = m
+        self.digits = jnp.asarray(int_to_digits(m))
+        # product-column reduction: high cols K..PRODCOLS-1 are split
+        # into 6-bit chunks lo/mid/hi; row (c*H + k) holds the balanced
+        # digits of 2^(6(K+k+c)) mod m.
+        H = PRODCOLS - K
+        self._H = H
+        R = np.zeros((3 * H, K), np.float32)
+        for k in range(H):
+            for c in range(3):
+                R[c * H + k] = _balanced_digits(pow(2, W * (K + k + c), m), K)
+        self._R = jnp.asarray(R)
+        # settle fold rows: balanced digits of 2^(6(K+j)) mod m for the
+        # carry-out columns a settle round accumulates
+        F = np.stack([
+            _balanced_digits(pow(2, W * (K + j), m), K)
+            for j in range(SETTLE_PASSES + 1)
+        ])
+        self._F = jnp.asarray(F.astype(np.int32))
+        self._Fnp = F.astype(np.int64)
+        self._Rnp = np.asarray(R, np.int64)
+
+    # -- core ops (all shapes [..., K] int32) -----------------------------
+
+    def mul(self, a, b):
+        """a*b mod m value-wise; output settled (|d| <= SETTLED_MAX).
+
+        Caller contract: |a|_inf * |b|_inf * K < 2^24 (e.g. both
+        operands are settled values or <= 3-way sums of them)."""
+        af = a.astype(jnp.float32)
+        bf = b.astype(jnp.float32)
+        o = (af[..., :, None] * bf[..., None, :]).reshape(*a.shape[:-1], K * K)
+        cols = jax.lax.dot_general(
+            o, _SHIFT_ONEHOT,
+            (((o.ndim - 1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+        ).astype(jnp.int32)
+        low, high = cols[..., :K], cols[..., K:]
+        hlo = high & DMASK
+        hmid = (high >> W) & DMASK
+        hhi = high >> (2 * W)
+        chunks = jnp.concatenate([hlo, hmid, hhi], axis=-1).astype(jnp.float32)
+        red = jax.lax.dot_general(
+            chunks, self._R,
+            (((chunks.ndim - 1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+        ).astype(jnp.int32)
+        return self.settle(low + red)
+
+    def settle(self, t):
+        """Carry-normalize [..., K] int32 (|d| < 2^24) to
+        |d| <= SETTLED_MAX, value preserved mod m (schedule certified
+        by bound_check).
+
+        Each pass drops every digit to [0,63] plus an incoming carry;
+        pass carry-outs all have weight 2^(6K) (the width stays K), so
+        their sum folds back via the F rows.  The fold CHUNKS the top
+        into 6-bit pieces first — folding a large top directly would
+        re-inject ~top·32 magnitude and never converge."""
+        for _ in range(SETTLE_ROUNDS):
+            top = None
+            for _p in range(SETTLE_PASSES):
+                lo = t & DMASK
+                carry = t >> W
+                t = lo + jnp.pad(carry[..., :-1], _pad_width(t.ndim))
+                top = carry[..., -1] if top is None else top + carry[..., -1]
+            t0 = (top & DMASK)[..., None]
+            t1 = ((top >> W) & DMASK)[..., None]
+            t2 = (top >> (2 * W))[..., None]
+            t = t + t0 * self._F[0] + t1 * self._F[1] + t2 * self._F[2]
+        # tidy stage: by now digits are small enough that one pass
+        # leaves a |top| <= 1ish carry, folded without chunking
+        lo = t & DMASK
+        carry = t >> W
+        t = lo + jnp.pad(carry[..., :-1], _pad_width(t.ndim))
+        t = t + carry[..., -1:] * self._F[0]
+        return t
+
+    def condense(self, t):
+        """Settle for values accumulated by adds/subs between muls."""
+        return self.settle(t)
+
+    def canonical(self, t):
+        """Fully canonical digits of (value mod m): digits in [0,63],
+        value in [0, m).  Kernel edges only (final compare, infinity
+        test) — one sequential sweep over K digits, not the hot loop."""
+        t = self.settle(t)
+
+        def sweep(carry, d):
+            v = d + carry
+            return v >> W, v & DMASK
+
+        carry0 = jnp.zeros(t.shape[:-1], jnp.int32)
+        # negative or >=2^(6K) values need the top carry folded back
+        # in; settled values have |value| < 8*2^258, and the worst
+        # quotient chain (7 -> 1 -> 1 -> 0) dies within three
+        # fold+sweep rounds
+        for _ in range(3):
+            over, dig = jax.lax.scan(sweep, carry0, jnp.moveaxis(t, -1, 0))
+            t = jnp.moveaxis(dig, 0, -1) + over[..., None] * self._F[0]
+        over, dig = jax.lax.scan(sweep, carry0, jnp.moveaxis(t, -1, 0))
+        t = jnp.moveaxis(dig, 0, -1)
+        # value in [0, 2^258); subtract m up to 4 times (2^258 < 5m for
+        # both P-256 moduli)
+        for _ in range(4):
+            ge = self._geq(t, self.digits)
+            t = t - jnp.where(ge[..., None], self.digits, 0)
+            _, dig = jax.lax.scan(sweep, carry0, jnp.moveaxis(t, -1, 0))
+            t = jnp.moveaxis(dig, 0, -1)
+        return t
+
+    @staticmethod
+    def _geq(a, b):
+        """a >= b over canonical digit arrays (b broadcastable)."""
+        bb = jnp.broadcast_to(b, a.shape)
+
+        def step(state, pair):
+            ai, bi = pair
+            gt, lt = state
+            gt_new = gt | (~gt & ~lt & (ai > bi))
+            lt_new = lt | (~gt & ~lt & (ai < bi))
+            return (gt_new, lt_new), 0.0
+
+        init = (
+            jnp.zeros(a.shape[:-1], bool),
+            jnp.zeros(a.shape[:-1], bool),
+        )
+        (gt, lt), _ = jax.lax.scan(
+            step, init,
+            (jnp.moveaxis(a[..., ::-1], -1, 0), jnp.moveaxis(bb[..., ::-1], -1, 0)),
+        )
+        return gt | ~lt
+
+    def eq_zero(self, t):
+        """value ≡ 0 (mod m), any representation."""
+        return jnp.all(self.canonical(t) == 0, axis=-1)
+
+    # -- bound certification (numpy interval arithmetic) ------------------
+
+    def bound_check(self, a_bound: int = SETTLED_MAX * 3,
+                    b_bound: int = SETTLED_MAX * 3):
+        """Interval-arithmetic certification of the mul+settle schedule.
+
+        Walks the exact op sequence of `mul` with per-digit magnitude
+        bounds and asserts every f32 contraction stays under 2^24 and
+        the settled output meets SETTLED_MAX.  a_bound/b_bound are the
+        largest |digit| the caller feeds each operand (default: 3-way
+        sums of settled values)."""
+        prod = a_bound * b_bound
+        assert prod * K < (1 << 24), ("f32 product contraction", prod * K)
+        colbound = prod * K
+        H = self._H
+        Rabs = np.abs(self._Rnp)
+        hi_max = colbound >> (2 * W)
+        per_digit = (
+            63 * Rabs[:H].sum(axis=0)
+            + 63 * Rabs[H:2 * H].sum(axis=0)
+            + hi_max * Rabs[2 * H:].sum(axis=0)
+        )
+        worst_col = int(per_digit.max())
+        assert worst_col < (1 << 24), ("f32 reduction contraction", worst_col)
+        t = np.full(K, colbound + worst_col, np.int64)  # low + red
+        out = self._settle_bound(t)
+        assert out <= SETTLED_MAX, ("settled bound", out)
+        return out
+
+    def _settle_bound(self, t) -> int:
+        """Interval image of settle() for a per-digit bound vector."""
+        Fabs = np.abs(self._Fnp)
+        for _ in range(SETTLE_ROUNDS):
+            top = 0
+            for _p in range(SETTLE_PASSES):
+                carry = t >> W
+                t = np.concatenate([[0], carry[:-1]]) + DMASK
+                top = top + int(carry[-1])
+            fold = (
+                min(top, DMASK) * Fabs[0]
+                + min(top >> W, DMASK) * Fabs[1]
+                + (top >> (2 * W)) * Fabs[2]
+            )
+            t = t + fold
+        carry = t >> W
+        t = np.concatenate([[0], carry[:-1]]) + DMASK
+        t = t + int(carry[-1]) * Fabs[0]
+        return int(t.max())
+
+def _pad_width(ndim):
+    return [(0, 0)] * (ndim - 1) + [(1, 0)]
+
+
+def _build_shift_onehot() -> jnp.ndarray:
+    """[K*K, 2K-1] one-hot: product term (i,j) lands in column i+j."""
+    S = np.zeros((K * K, PRODCOLS), np.float32)
+    for i in range(K):
+        for j in range(K):
+            S[i * K + j, i + j] = 1.0
+    return jnp.asarray(S)
+
+
+_SHIFT_ONEHOT = _build_shift_onehot()

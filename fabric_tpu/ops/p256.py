@@ -401,17 +401,29 @@ def digest_words_to_limbs(words):
 
 MIN_BUCKET = 16
 
+# the MXU-first kernel (ops.p256v2: RCB complete formulas over the
+# signed-digit field core) is the default; set FABRIC_TPU_P256=v1 to
+# fall back to this module's Montgomery-limb ladder for comparison
+import os as _os
+
+_USE_V2 = _os.environ.get("FABRIC_TPU_P256", "v2") != "v1"
+
 
 def verify_host(items) -> list[bool]:
     """items: iterable of (digest_int, r, s, qx, qy) Python ints.
 
-    Pads the batch to a power of two, floored at MIN_BUCKET (one
-    compile per bucket — small blocks share one cached compile), and
-    runs the jitted kernel.
+    Dispatches to the v2 MXU kernel by default.  The v1 path pads the
+    batch to a power of two, floored at MIN_BUCKET (one compile per
+    bucket — small blocks share one cached compile), and runs the
+    jitted limb kernel.
     """
     items = list(items)
     if not items:
         return []
+    if _USE_V2:
+        from fabric_tpu.ops import p256v2
+
+        return p256v2.verify_host(items)
     n = len(items)
     bsz = max(MIN_BUCKET, next_pow2(n))
     pad = [(0, 0, 0, 0, 0)] * (bsz - n)

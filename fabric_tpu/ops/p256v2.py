@@ -1,0 +1,435 @@
+"""Batched ECDSA P-256 verification, MXU-first ("v2") kernel.
+
+Replaces the depth-bound Montgomery ladder of fabric_tpu.ops.p256 (the
+round-2 bench ran at 0.406× one CPU thread) with a design whose serial
+depth is ~8× shorter and whose inner multiplications ride the MXU:
+
+* Field arithmetic: signed-digit base-2^6 form (fabric_tpu.ops.digits)
+  — poly-mul and modular reduction are f32 matmuls, carries are a
+  short certified settle schedule, add/sub are carry-free.
+* Point arithmetic: Renes–Costello–Batina 2016 COMPLETE projective
+  formulas for a = -3 (add 12M+2mb, doubling 8M+3S+2mb).  Complete
+  means NO exceptional cases — ∞ = (0:1:0), doubling, and inverses all
+  flow through the same straight-line code, so the ladder needs no
+  zero-tests or per-lane branches even for adversarial signatures
+  (P-256 has prime order: the formulas are total).
+* Scalar ladder: 4-bit windows, 64 iterations of [4 doublings + one
+  table add for u2·Q + one mixed add for u1·G] instead of 256
+  double-and-add rounds.  The u2·Q window table (15 multiples) is
+  built in-kernel with the same complete adds; the u1·G table is a
+  host-precomputed constant (G is fixed).
+* Division s⁻¹ mod n: Fermat via a 256-round fori_loop (square +
+  bit-masked multiply), on the whole batch at once.
+
+Reference semantics matched exactly (bccsp/sw/ecdsa.go:41-58, the SW
+BCCSP verifier): accept iff r,s ∈ [1, n-1], s ≤ n/2 (low-S), Q on
+curve, R = u1·G + u2·Q ≠ ∞, and x(R) ≡ r (mod n).  Bit-exact against
+the pure-Python oracle fabric_tpu.crypto.ec_ref (tests/test_p256v2.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from fabric_tpu.crypto import ec_ref
+from fabric_tpu.ops import digits as dg
+from fabric_tpu.utils.batching import next_pow2
+
+K = dg.K
+P = ec_ref.P
+N = ec_ref.N
+B_COEF = ec_ref.B
+GX, GY = ec_ref.GX, ec_ref.GY
+HALF_N = ec_ref.HALF_N
+
+MODP = dg.DigitMod(P)
+MODN = dg.DigitMod(N)
+
+WINDOW = 4
+STEPS = 64  # 256 bits / WINDOW
+
+_F32_SUM_LIMIT = (1 << 24) // dg.K  # pairing bound: |a|*|b| must stay under
+
+
+class FV:
+    """Field value with a trace-time |digit| bound.
+
+    The bound rides along symbolic tracing (plain Python ints), so
+    pairing-limit violations are caught — and fixed by condensing the
+    fatter operand — while BUILDING the graph, with zero runtime cost
+    for the bookkeeping itself."""
+
+    __slots__ = ("arr", "bound", "mod")
+
+    def __init__(self, arr, bound: int, mod: dg.DigitMod):
+        self.arr = arr
+        self.bound = int(bound)
+        self.mod = mod
+
+    def __add__(self, other):
+        return FV(self.arr + other.arr, self.bound + other.bound, self.mod)
+
+    def __sub__(self, other):
+        return FV(self.arr - other.arr, self.bound + other.bound, self.mod)
+
+    def condensed(self) -> "FV":
+        return FV(self.mod.settle(self.arr), _SETTLED[id(self.mod)], self.mod)
+
+    def __mul__(self, other):
+        a, b = self, other
+        if a.bound * b.bound >= _F32_SUM_LIMIT:
+            # condense the fatter side first (trace-time decision)
+            if a.bound >= b.bound:
+                a = a.condensed()
+            else:
+                b = b.condensed()
+            if a.bound * b.bound >= _F32_SUM_LIMIT:
+                a, b = a.condensed(), b.condensed()
+        return FV(a.mod.mul(a.arr, b.arr), _SETTLED[id(a.mod)], a.mod)
+
+
+# certify mul+settle at the largest legal pairing (624^2 * 43 < 2^24);
+# FV.__mul__ never exceeds it, so these settled bounds hold everywhere
+_MAX_SIDE = int((( 1 << 24) / dg.K) ** 0.5)  # 624
+_SETTLED = {
+    id(MODP): MODP.bound_check(a_bound=_MAX_SIDE, b_bound=_MAX_SIDE),
+    id(MODN): MODN.bound_check(a_bound=_MAX_SIDE, b_bound=_MAX_SIDE),
+}
+
+
+def _const_fv(x: int, shape_like, mod: dg.DigitMod) -> FV:
+    d = jnp.asarray(dg.int_to_digits(x))
+    return FV(jnp.broadcast_to(d, shape_like.shape), 63, mod)
+
+
+# ---------------------------------------------------------------------------
+# RCB complete point ops (projective X:Y:Z, a = -3).  Every variable is
+# an FV; bound tracking inserts settles exactly where the certified
+# pairing limit requires.
+
+
+def _pt(x, y, z):
+    return (x, y, z)
+
+
+def pt_add(p1, p2, b_fv):
+    """Complete projective addition (RCB16 algorithm 4, a = -3)."""
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    t0 = X1 * X2
+    t1 = Y1 * Y2
+    t2 = Z1 * Z2
+    t3 = X1 + Y1
+    t4 = X2 + Y2
+    t3 = t3 * t4
+    t4 = t0 + t1
+    t3 = t3 - t4
+    t4 = Y1 + Z1
+    X3 = Y2 + Z2
+    t4 = t4 * X3
+    X3 = t1 + t2
+    t4 = t4 - X3
+    X3 = X1 + Z1
+    Y3 = X2 + Z2
+    X3 = X3 * Y3
+    Y3 = t0 + t2
+    Y3 = X3 - Y3
+    Z3 = b_fv * t2
+    X3 = Y3 - Z3
+    Z3 = X3 + X3
+    X3 = X3 + Z3
+    Z3 = t1 - X3
+    X3 = t1 + X3
+    Y3 = b_fv * Y3
+    t1 = t2 + t2
+    t2 = t1 + t2
+    Y3 = Y3 - t2
+    Y3 = Y3 - t0
+    t1 = Y3 + Y3
+    Y3 = t1 + Y3
+    t1 = t0 + t0
+    t0 = t1 + t0
+    t0 = t0 - t2
+    t1 = t4 * Y3
+    t2 = t0 * Y3
+    Y3 = X3 * Z3
+    Y3 = Y3 + t2
+    X3 = t3 * X3
+    X3 = X3 - t1
+    Z3 = t4 * Z3
+    t1 = t3 * t0
+    Z3 = Z3 + t1
+    return _pt(X3, Y3, Z3)
+
+
+def pt_add_mixed(p1, x2, y2, b_fv):
+    """Complete mixed addition (RCB16 algorithm 5, Z2 = 1): P2 is an
+    affine point — complete in P1 (incl. ∞) but P2 must NOT be ∞; the
+    comb handles digit-0 (∞) table slots with a select in the caller."""
+    X1, Y1, Z1 = p1
+    X2, Y2 = x2, y2
+    t0 = X1 * X2
+    t1 = Y1 * Y2
+    t3 = X2 + Y2
+    t4 = X1 + Y1
+    t3 = t3 * t4
+    t4 = t0 + t1
+    t3 = t3 - t4
+    t4 = Y2 * Z1
+    t4 = t4 + Y1
+    Y3 = X2 * Z1
+    Y3 = Y3 + X1
+    Z3 = b_fv * Z1
+    X3 = Y3 - Z3
+    Z3 = X3 + X3
+    X3 = X3 + Z3
+    Z3 = t1 - X3
+    X3 = t1 + X3
+    Y3 = b_fv * Y3
+    t1 = Z1 + Z1
+    t2 = t1 + Z1
+    Y3 = Y3 - t2
+    Y3 = Y3 - t0
+    t1 = Y3 + Y3
+    Y3 = t1 + Y3
+    t1 = t0 + t0
+    t0 = t1 + t0
+    t0 = t0 - t2
+    t1 = t4 * Y3
+    t2 = t0 * Y3
+    Y3 = X3 * Z3
+    Y3 = Y3 + t2
+    X3 = t3 * X3
+    X3 = X3 - t1
+    Z3 = t4 * Z3
+    t1 = t3 * t0
+    Z3 = Z3 + t1
+    return _pt(X3, Y3, Z3)
+
+
+def pt_double(p, b_fv):
+    """Complete projective doubling (RCB16 algorithm 6, a = -3)."""
+    X, Y, Z = p
+    t0 = X * X
+    t1 = Y * Y
+    t2 = Z * Z
+    t3 = X * Y
+    t3 = t3 + t3
+    Z3 = X * Z
+    Z3 = Z3 + Z3
+    Y3 = b_fv * t2
+    Y3 = Y3 - Z3
+    X3 = Y3 + Y3
+    Y3 = X3 + Y3
+    X3 = t1 - Y3
+    Y3 = t1 + Y3
+    Y3 = X3 * Y3
+    X3 = X3 * t3
+    t3 = t2 + t2
+    t2 = t2 + t3
+    Z3 = b_fv * Z3
+    Z3 = Z3 - t2
+    Z3 = Z3 - t0
+    t3 = Z3 + Z3
+    Z3 = Z3 + t3
+    t3 = t0 + t0
+    t0 = t3 + t0
+    t0 = t0 - t2
+    t0 = t0 * Z3
+    Y3 = Y3 + t0
+    t0 = Y * Z
+    t0 = t0 + t0
+    Z3 = t0 * Z3
+    X3 = X3 - Z3
+    Z3 = t0 * t1
+    Z3 = Z3 + Z3
+    Z3 = Z3 + Z3
+    return _pt(X3, Y3, Z3)
+
+
+# ---------------------------------------------------------------------------
+# Host-precomputed u1·G window table: TG[d] = d·G affine, d = 1..15
+# (digit 0 = ∞ handled by a select).
+
+_TG = np.zeros((16, 2, K), np.int32)
+for _d in range(1, 16):
+    _px, _py = ec_ref.pt_mul(_d, (GX, GY))
+    _TG[_d, 0] = dg.int_to_digits(_px)
+    _TG[_d, 1] = dg.int_to_digits(_py)
+_TG_J = jnp.asarray(_TG)
+
+
+def _settled_fv(arr, mod):
+    return FV(arr, _SETTLED[id(mod)], mod)
+
+
+def _window_digits(scalar_digits):
+    """Canonical base-64 digits [B,K] → 4-bit window digits [B, 64],
+    most-significant window first."""
+    bits = (scalar_digits[..., :, None] >> jnp.arange(dg.W, dtype=jnp.int32)) & 1
+    bits = bits.reshape(*scalar_digits.shape[:-1], K * dg.W)[..., :256]
+    w = bits.reshape(*scalar_digits.shape[:-1], STEPS, WINDOW)
+    weights = jnp.asarray([1, 2, 4, 8], jnp.int32)
+    digs = jnp.sum(w * weights, axis=-1)  # [..., STEPS] little-endian windows
+    return digs[..., ::-1]  # MSB window first
+
+
+def verify_batch(e, r, s, rpn, rpn_ok, qx, qy, pre_ok):
+    """Batched ECDSA P-256 verify on digit-form inputs.
+
+    e, r, s, qx, qy: [B, K] canonical base-2^6 digit arrays.
+    rpn: digits of r+n; rpn_ok: [B] bool, r+n < p (host precomputed).
+    pre_ok: [B] bool host-side admission results (r,s in [1,n-1],
+        s <= n/2, qx,qy < p, (qx,qy) != (0,0)) — cheap exact integer
+        checks on values the host already holds as Python ints.
+
+    Returns [B] bool, the exact accept set of the reference verifier.
+    """
+    B = e.shape[0]
+
+    # --- on-curve check (mod p): y^2 == x^3 - 3x + b
+    qx_p = FV(qx, 63, MODP)
+    qy_p = FV(qy, 63, MODP)
+    b_p = _const_fv(B_COEF, qx, MODP)
+    y2 = qy_p * qy_p
+    x2 = qx_p * qx_p
+    x3 = x2 * qx_p
+    three_x = qx_p + qx_p + qx_p
+    rhs = x3 - three_x + b_p
+    on_curve = MODP.eq_zero((y2 - rhs).arr)
+
+    # --- u1 = e/s, u2 = r/s (mod n) via Fermat
+    s_n = FV(s, 63, MODN)
+    n_minus_2_bits = jnp.asarray(
+        np.array([(N - 2) >> (255 - i) & 1 for i in range(256)], np.int32)
+    )
+    one_n = jnp.broadcast_to(jnp.asarray(dg.int_to_digits(1)), s.shape)
+
+    def inv_body(i, acc):
+        acc_fv = _settled_fv(acc, MODN)
+        sq = acc_fv * acc_fv
+        mulres = sq * s_n
+        bit = n_minus_2_bits[i]
+        return jnp.where(bit == 1, mulres.arr, sq.arr)
+
+    s_inv = jax.lax.fori_loop(0, 256, inv_body, one_n)
+    s_inv_fv = _settled_fv(s_inv, MODN)
+    u1 = MODN.canonical((FV(e, 63, MODN) * s_inv_fv).arr)
+    u2 = MODN.canonical((FV(r, 63, MODN) * s_inv_fv).arr)
+
+    # --- u2·Q window table: T[d] = d·Q, d = 0..15, T[0] = ∞
+    b_fv = b_p
+    zero = jnp.zeros_like(qx)
+    one_digits = jnp.broadcast_to(jnp.asarray(dg.int_to_digits(1)), qx.shape)
+    inf = _pt(FV(zero, 0, MODP), FV(one_digits, 63, MODP), FV(zero, 0, MODP))
+    q1 = _pt(qx_p, qy_p, FV(one_digits, 63, MODP))
+
+    table = [inf, q1]
+    acc = q1
+    for _d in range(2, 16):
+        acc = pt_add(acc, q1, b_fv)
+        table.append(acc)
+    # stack: [B, 16, 3, K]
+    tq = jnp.stack(
+        [jnp.stack([pt[0].arr, pt[1].arr, pt[2].arr], axis=-2) for pt in table],
+        axis=-3,
+    )
+    tq_bound = max(max(pt[0].bound, pt[1].bound, pt[2].bound) for pt in table)
+
+    w1 = _window_digits(u1)  # [B, 64] MSB-first
+    w2 = _window_digits(u2)
+
+    tg_flat = _TG_J.reshape(16, 2 * K).astype(jnp.float32)  # constants
+
+    def ladder_body(i, state):
+        Xa, Ya, Za = state
+        R = _pt(_settled_fv(Xa, MODP), _settled_fv(Ya, MODP), _settled_fv(Za, MODP))
+        for _ in range(WINDOW):
+            R = pt_double(R, b_fv)
+        # add T_Q[w2[i]] (one-hot gather; complete add handles ∞ slot)
+        d2 = jax.lax.dynamic_index_in_dim(w2, i, axis=1, keepdims=False)  # [B]
+        oh2 = (d2[:, None] == jnp.arange(16)[None, :]).astype(jnp.float32)
+        sel = jnp.einsum(
+            "bt,btck->bck", oh2, tq.astype(jnp.float32),
+            precision=jax.lax.Precision.HIGHEST,
+        ).astype(jnp.int32)
+        T2 = _pt(
+            FV(sel[:, 0], tq_bound, MODP),
+            FV(sel[:, 1], tq_bound, MODP),
+            FV(sel[:, 2], tq_bound, MODP),
+        )
+        R = pt_add(R, T2, b_fv)
+        # add T_G[w1[i]] (affine constants; skip when digit == 0)
+        d1 = jax.lax.dynamic_index_in_dim(w1, i, axis=1, keepdims=False)
+        oh1 = (d1[:, None] == jnp.arange(16)[None, :]).astype(jnp.float32)
+        selg = (oh1 @ tg_flat).astype(jnp.int32).reshape(-1, 2, K)
+        Rg = pt_add_mixed(
+            R, FV(selg[:, 0], 63, MODP), FV(selg[:, 1], 63, MODP), b_fv
+        )
+        skip = (d1 == 0)[:, None]
+        X3 = jnp.where(skip, R[0].arr, Rg[0].arr)
+        Y3 = jnp.where(skip, R[1].arr, Rg[1].arr)
+        Z3 = jnp.where(skip, R[2].arr, Rg[2].arr)
+        # settle to keep the loop-carried bound static across iterations
+        return (MODP.settle(X3), MODP.settle(Y3), MODP.settle(Z3))
+
+    state0 = (zero, one_digits, zero)
+    Xr, Yr, Zr = jax.lax.fori_loop(0, STEPS, ladder_body, state0)
+
+    # --- accept iff R != ∞ and x(R) = X/Z ≡ r (mod n):
+    # X ≡ r·Z (mod p), or X ≡ (r+n)·Z (mod p) when r+n < p
+    Z_fv = _settled_fv(Zr, MODP)
+    X_fv = _settled_fv(Xr, MODP)
+    not_inf = ~MODP.eq_zero(Zr)
+    rz = FV(r, 63, MODP) * Z_fv
+    cmp1 = MODP.eq_zero((X_fv - rz).arr)
+    rpnz = FV(rpn, 63, MODP) * Z_fv
+    cmp2 = MODP.eq_zero((X_fv - rpnz).arr) & rpn_ok
+    return pre_ok & on_curve & not_inf & (cmp1 | cmp2)
+
+
+verify_batch_jit = jax.jit(verify_batch)
+
+
+# ---------------------------------------------------------------------------
+# Host wrappers (drop-in for ops.p256.verify_host)
+
+MIN_BUCKET = 16
+
+
+def verify_host(items) -> list[bool]:
+    """items: iterable of (digest_int, r, s, qx, qy) Python ints —
+    same interface as ops.p256.verify_host, same accept set."""
+    items = list(items)
+    if not items:
+        return []
+    n = len(items)
+    bsz = max(MIN_BUCKET, next_pow2(n))
+    pad = [(0, 1, 1, 0, 0)] * (bsz - n)  # padded lanes fail pre_ok anyway
+    full = items + pad
+
+    pre_ok, rpn, rpn_ok = [], [], []
+    for (ei, ri, si, xi, yi) in full:
+        ok = (
+            0 < ri < N and 0 < si <= HALF_N
+            and 0 <= xi < P and 0 <= yi < P and not (xi == 0 and yi == 0)
+        )
+        pre_ok.append(ok)
+        rp = ri + N
+        rpn_ok.append(rp < P)
+        rpn.append(rp if rp < P else 0)
+
+    cols = list(zip(*full))
+    arrs = [
+        dg.ints_to_digits([int(x) % (1 << 258) for x in col])
+        for col in (cols[0], cols[1], cols[2], rpn, cols[3], cols[4])
+    ]
+    e_d, r_d, s_d, rpn_d, qx_d, qy_d = (jnp.asarray(a) for a in arrs)
+    out = verify_batch_jit(
+        e_d, r_d, s_d, rpn_d,
+        jnp.asarray(np.array(rpn_ok)), qx_d, qy_d,
+        jnp.asarray(np.array(pre_ok)),
+    )
+    return [bool(v) for v in np.asarray(out)[:n]]

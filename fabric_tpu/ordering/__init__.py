@@ -1,0 +1,8 @@
+from fabric_tpu.ordering.blockcutter import BatchConfig, BlockCutter  # noqa: F401
+from fabric_tpu.ordering.chain import MsgProcessor, OrderingChain  # noqa: F401
+from fabric_tpu.ordering.node import (  # noqa: F401
+    BroadcastClient,
+    DeliverClient,
+    OrdererNode,
+)
+from fabric_tpu.ordering.raft import RaftNode, WAL  # noqa: F401

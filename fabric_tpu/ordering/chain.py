@@ -1,0 +1,205 @@
+"""Per-channel ordering chain: broadcast → filters → blockcutter →
+raft → deterministic block assembly → deliver.
+
+Reference shape: `Chain.run` propose/apply loop
+(orderer/consensus/etcdraft/chain.go:614), broadcast filter chain
+(orderer/common/msgprocessor/standardchannel.go:100), block writer
+(orderer/common/multichannel/blockwriter.go).  Re-design notes:
+
+* Raft entries are BATCHES (lists of envelopes), not blocks: every
+  node assembles the block from the committed batch DETERMINISTICALLY
+  (number = height, prev_hash = own chain tip) so the chain of blocks
+  is identical on all nodes without shipping headers through raft.
+* The batch timeout rides the leader's event loop; followers redirect
+  Broadcast callers to the leader (the reference forwards instead —
+  a client-visible difference kept deliberately: retry-with-redirect
+  is simpler and the SDK contract allows it).
+* Deliver is a height-watched block stream off the block store, the
+  seek semantics of common/deliver/deliver.go:158.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from fabric_tpu import protoutil
+from fabric_tpu.ledger.blockstore import BlockStore
+from fabric_tpu.ordering.blockcutter import BatchConfig, BlockCutter
+from fabric_tpu.ordering.raft import Entry, RaftNode, WAL
+from fabric_tpu.protos import common_pb2
+
+
+class MsgProcessor:
+    """Broadcast admission: size cap, optional signature policy check
+    (sigfilter/sizefilter analogs)."""
+
+    def __init__(self, config: BatchConfig, msp_manager=None, policy=None):
+        self.config = config
+        self.msp = msp_manager
+        self.policy = policy
+
+    def check(self, env_bytes: bytes) -> str | None:
+        """→ None if admitted, else reject reason."""
+        if not env_bytes:
+            return "empty envelope"
+        if len(env_bytes) > self.config.absolute_max_bytes:
+            return "message too large"
+        if self.msp is not None and self.policy is not None:
+            try:
+                env = protoutil.unmarshal(common_pb2.Envelope, env_bytes)
+                sd = protoutil.envelope_as_signed_data(env)
+                ident = self.msp.deserialize_identity(sd.identity)
+                if not ident.is_valid or not ident.verify(sd.data, sd.signature):
+                    return "signature check failed"
+            except Exception as e:
+                return f"bad envelope: {e}"
+        return None
+
+
+class OrderingChain:
+    """One channel's chain on one orderer node."""
+
+    def __init__(self, channel_id: str, node_id: str, peers: list[str],
+                 data_dir: str, send_cb, config: BatchConfig | None = None,
+                 msgproc: MsgProcessor | None = None,
+                 genesis_block: common_pb2.Block | None = None):
+        self.channel = channel_id
+        self.config = config or BatchConfig()
+        self.cutter = BlockCutter(self.config)
+        self.msgproc = msgproc or MsgProcessor(self.config)
+        self.blocks = BlockStore(f"{data_dir}/chains")
+        if self.blocks.height == 0 and genesis_block is not None:
+            self.blocks.add_block(genesis_block)
+        self.raft = RaftNode(
+            node_id, peers, WAL(f"{data_dir}/wal"),
+            apply_cb=self._apply, send_cb=send_cb,
+        )
+        self._applied_batches = 0
+        self._recovered_batches = 0
+        self._timer_task: asyncio.Task | None = None
+        self._height_changed = asyncio.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        # Re-derive how many raft entries are already materialized as
+        # blocks so WAL replay doesn't re-append them.  Batch blocks
+        # carry ORDERER consensus metadata; a genesis/config block 0
+        # doesn't — that distinguishes the two layouts on restart.
+        h = self.blocks.height
+        offset = 0
+        if h > 0:
+            idx = common_pb2.BlockMetadataIndex.ORDERER
+            b0 = self.blocks.get_block(0)
+            has_meta = len(b0.metadata.metadata) > idx and b0.metadata.metadata[idx]
+            offset = 0 if has_meta else 1
+        self._recovered_batches = max(0, h - offset)
+        self._applied_batches = 0
+        self.raft.start()
+
+    def stop(self):
+        self.raft.stop()
+        if self._timer_task:
+            self._timer_task.cancel()
+        self.blocks.close()
+
+    # -- broadcast ----------------------------------------------------------
+
+    async def broadcast(self, env_bytes: bytes) -> dict:
+        """→ {status} or {status, info/redirect}."""
+        reason = self.msgproc.check(env_bytes)
+        if reason is not None:
+            return {"status": 400, "info": reason}
+        if self.raft.state != "leader":
+            return {"status": 503, "info": "not leader",
+                    "leader": self.raft.leader_id}
+        batches, pending = self.cutter.ordered(env_bytes)
+        last_index = None
+        for batch in batches:
+            last_index = self._propose_batch(batch)
+        if pending:
+            self._arm_timer()
+        elif self._timer_task:
+            self._timer_task.cancel()
+            self._timer_task = None
+        if last_index is not None:
+            try:
+                await asyncio.wait_for(
+                    self.raft.wait_applied(last_index),
+                    timeout=10.0,
+                )
+            except asyncio.TimeoutError:
+                return {"status": 500, "info": "commit timeout"}
+        return {"status": 200}
+
+    def _propose_batch(self, batch: list[bytes]) -> int | None:
+        payload = json.dumps([b.hex() for b in batch]).encode()
+        return self.raft.propose(payload)
+
+    def _arm_timer(self):
+        if self._timer_task is not None and not self._timer_task.done():
+            return
+
+        async def fire():
+            await asyncio.sleep(self.config.batch_timeout_s)
+            if self.raft.state == "leader":
+                batch = self.cutter.cut()
+                if batch:
+                    self._propose_batch(batch)
+
+        self._timer_task = asyncio.ensure_future(fire())
+
+    # -- raft apply → block assembly -----------------------------------------
+
+    def _apply(self, entry: Entry):
+        batch = [bytes.fromhex(h) for h in json.loads(entry.data.decode())]
+        self._applied_batches += 1
+        if self._applied_batches <= self._recovered_batches:
+            return  # already materialized before restart
+        prev = (
+            protoutil.block_header_hash(
+                self.blocks.get_block(self.blocks.height - 1).header
+            )
+            if self.blocks.height
+            else b"\x00" * 32
+        )
+        blk = protoutil.new_block(self.blocks.height, prev)
+        for env in batch:
+            blk.data.data.append(env)
+        blk = protoutil.finalize_block(blk)
+        # orderer metadata: consensus term/index for forensic parity
+        idx = common_pb2.BlockMetadataIndex.ORDERER
+        while len(blk.metadata.metadata) <= idx:
+            blk.metadata.metadata.append(b"")
+        blk.metadata.metadata[idx] = json.dumps(
+            {"term": entry.term, "index": entry.index}
+        ).encode()
+        self.blocks.add_block(blk)
+        self._height_changed.set()
+        self._height_changed = asyncio.Event()
+
+    # -- deliver --------------------------------------------------------------
+
+    async def deliver(self, start: int, stop: int | None = None):
+        """Async iterator of serialized blocks [start, stop]; blocks at
+        the tip until new blocks are cut (deliver.go:158 seek
+        semantics: stop=None streams forever)."""
+        num = start
+        while stop is None or num <= stop:
+            if num < self.blocks.height:
+                blk = self.blocks.get_block(num)
+                yield blk.SerializeToString()
+                num += 1
+            else:
+                # grab the event BEFORE re-checking height: _apply sets
+                # then replaces the event, so a block landing between
+                # the check and the wait still wakes this waiter
+                ev = self._height_changed
+                if num < self.blocks.height:
+                    continue
+                await ev.wait()
+
+    @property
+    def height(self) -> int:
+        return self.blocks.height

@@ -1,0 +1,261 @@
+"""Orderer node assembly: registrar + RPC services.
+
+The analog of orderer/common/server/main.go:69-222 plus the
+multichannel registrar (registrar.go:93): one process hosts N
+channels, each with its own raft chain; exposed services:
+
+* ``Broadcast``  — submit an envelope to a channel (unary; non-leader
+  answers 503 with a leader hint and the client retries there).
+* ``Deliver``    — stream blocks from a seek position (server-stream).
+* ``Step``       — orderer↔orderer raft transport (fire-and-forget
+  messages; the cluster-comm analog, orderer/common/cluster/comm.go).
+* ``Join``       — channel participation: create a chain from a
+  genesis block (channelparticipation/restapi.go analog).
+
+Wire format: tiny JSON headers + raw envelope/block bytes — the
+content payloads themselves are the canonical protos.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from fabric_tpu.comm.rpc import RpcClient, RpcServer
+from fabric_tpu.ordering.blockcutter import BatchConfig
+from fabric_tpu.ordering.chain import MsgProcessor, OrderingChain
+from fabric_tpu.protos import common_pb2
+
+
+class OrdererNode:
+    def __init__(self, node_id: str, data_dir: str,
+                 cluster: dict[str, tuple[str, int]],
+                 host: str = "127.0.0.1", port: int = 0,
+                 batch_config: BatchConfig | None = None,
+                 msp_manager=None):
+        self.id = node_id
+        self.dir = data_dir
+        self.cluster = dict(cluster)  # node_id -> (host, port)
+        self.host, self.port = host, port
+        self.batch_config = batch_config or BatchConfig()
+        self.msp = msp_manager
+        self.chains: dict[str, OrderingChain] = {}
+        self.server = RpcServer(host, port)
+        self._peer_clients: dict[str, RpcClient] = {}
+        self._loop = None
+
+    # -- raft transport -------------------------------------------------------
+
+    def _send(self, channel: str):
+        def send(peer_id: str, msg: dict):
+            asyncio.ensure_future(self._send_async(peer_id, channel, msg))
+        return send
+
+    async def _peer_client(self, peer_id: str) -> RpcClient:
+        """Connect-once per peer: the dict holds a Task so concurrent
+        senders (a heartbeat round fans out) share ONE connection
+        instead of racing to create and leak several."""
+        task = self._peer_clients.get(peer_id)
+        if task is None:
+            addr = self.cluster[peer_id]
+
+            async def connect():
+                cli = RpcClient(*addr)
+                await cli.connect()
+                return cli
+
+            task = asyncio.ensure_future(connect())
+            self._peer_clients[peer_id] = task
+        return await asyncio.shield(task)
+
+    async def _send_async(self, peer_id: str, channel: str, msg: dict):
+        if peer_id not in self.cluster:
+            return
+        try:
+            cli = await self._peer_client(peer_id)
+            st = await cli.open_stream("Step")
+            await st.send(json.dumps({"channel": channel, "msg": msg}).encode())
+            await st.end()
+            st.dispose()  # fire-and-forget: the peer never answers
+        except (OSError, ConnectionError):
+            task = self._peer_clients.pop(peer_id, None)
+            if task is not None and task.done() and not task.cancelled():
+                try:
+                    cli = task.result()
+                except Exception:
+                    cli = None
+                if cli is not None:
+                    try:
+                        await cli.close()
+                    except Exception:
+                        pass
+
+    # -- channel lifecycle ------------------------------------------------------
+
+    def join_channel(self, channel_id: str,
+                     genesis_block: common_pb2.Block | None = None,
+                     start: bool = True) -> OrderingChain:
+        if channel_id in self.chains:
+            return self.chains[channel_id]
+        chain = OrderingChain(
+            channel_id, self.id, list(self.cluster),
+            data_dir=f"{self.dir}/{channel_id}",
+            send_cb=self._send(channel_id),
+            config=self.batch_config,
+            msgproc=MsgProcessor(self.batch_config, self.msp),
+            genesis_block=genesis_block,
+        )
+        self.chains[channel_id] = chain
+        if start:
+            chain.start()
+        return chain
+
+    # -- services -----------------------------------------------------------------
+
+    async def start(self):
+        self.server.register_unary("Broadcast", self._on_broadcast)
+        self.server.register("Deliver", self._on_deliver)
+        self.server.register("Step", self._on_step)
+        self.server.register_unary("Join", self._on_join)
+        self.server.register_unary("Info", self._on_info)
+        await self.server.start()
+        self.port = self.server.port
+        return self
+
+    async def stop(self):
+        for chain in self.chains.values():
+            chain.stop()
+        for task in self._peer_clients.values():
+            if task.done() and not task.cancelled():
+                try:
+                    await task.result().close()
+                except Exception:
+                    pass
+            else:
+                task.cancel()
+        await self.server.stop()
+
+    async def _on_broadcast(self, req: bytes) -> bytes:
+        hdr_len = int.from_bytes(req[:4], "big")
+        hdr = json.loads(req[4:4 + hdr_len])
+        env = req[4 + hdr_len:]
+        chain = self.chains.get(hdr["channel"])
+        if chain is None:
+            return json.dumps({"status": 404, "info": "no such channel"}).encode()
+        res = await chain.broadcast(env)
+        if res.get("leader") and res["leader"] in self.cluster:
+            res["leader_addr"] = list(self.cluster[res["leader"]])
+        return json.dumps(res).encode()
+
+    async def _on_deliver(self, stream):
+        req = await stream.__anext__()
+        hdr = json.loads(req)
+        chain = self.chains.get(hdr["channel"])
+        if chain is None:
+            await stream.error("no such channel")
+            return
+        start = hdr.get("start", 0)
+        stop = hdr.get("stop")
+        async for blk in chain.deliver(start, stop):
+            await stream.send(blk)
+        await stream.end()
+
+    async def _on_step(self, stream):
+        async for payload in stream:
+            msg = json.loads(payload)
+            chain = self.chains.get(msg["channel"])
+            if chain is not None:
+                chain.raft.handle(msg["msg"])
+
+    async def _on_join(self, req: bytes) -> bytes:
+        hdr_len = int.from_bytes(req[:4], "big")
+        hdr = json.loads(req[4:4 + hdr_len])
+        blk_bytes = req[4 + hdr_len:]
+        genesis = None
+        if blk_bytes:
+            genesis = common_pb2.Block()
+            genesis.ParseFromString(blk_bytes)
+        self.join_channel(hdr["channel"], genesis)
+        return json.dumps({"status": 201}).encode()
+
+    async def _on_info(self, req: bytes) -> bytes:
+        hdr = json.loads(req)
+        chain = self.chains.get(hdr["channel"])
+        if chain is None:
+            return json.dumps({"status": 404}).encode()
+        return json.dumps({
+            "status": 200, "height": chain.height,
+            "state": chain.raft.state, "leader": chain.raft.leader_id,
+        }).encode()
+
+
+class BroadcastClient:
+    """Client-side submit with leader-redirect retry (the SDK-facing
+    behavior the reference gets from leader forwarding)."""
+
+    def __init__(self, endpoints: list[tuple[str, int]]):
+        self.endpoints = list(endpoints)
+        self._clients: dict[tuple[str, int], RpcClient] = {}
+
+    async def _client(self, addr) -> RpcClient:
+        addr = tuple(addr)
+        cli = self._clients.get(addr)
+        if cli is None:
+            cli = RpcClient(*addr)
+            await cli.connect()
+            self._clients[addr] = cli
+        return cli
+
+    async def broadcast(self, channel: str, env_bytes: bytes,
+                        retries: int = 20) -> dict:
+        hdr = json.dumps({"channel": channel}).encode()
+        req = len(hdr).to_bytes(4, "big") + hdr + env_bytes
+        last = {"status": 503, "info": "no endpoints"}
+        hint = None  # leader address learned from the last redirect
+        for attempt in range(retries):
+            addr = hint or self.endpoints[attempt % len(self.endpoints)]
+            hint = None
+            try:
+                cli = await self._client(addr)
+                resp = json.loads(await cli.unary("Broadcast", req, timeout=15))
+            except Exception as e:  # connection refused / reset / rpc error
+                self._clients.pop(tuple(addr), None)
+                last = {"status": 503, "info": str(e)}
+                await asyncio.sleep(0.1)
+                continue
+            if resp["status"] == 200:
+                return resp
+            if 400 <= resp["status"] < 500:
+                return resp  # deterministic rejection — retrying can't help
+            if resp.get("leader_addr"):
+                hint = tuple(resp["leader_addr"])
+            last = resp
+            if resp["status"] == 503:
+                await asyncio.sleep(0.05 * min(attempt + 1, 6))
+        return last
+
+    async def close(self):
+        for cli in self._clients.values():
+            await cli.close()
+
+
+class DeliverClient:
+    """Pull a block stream from an orderer (peer side)."""
+
+    def __init__(self, host: str, port: int):
+        self.addr = (host, port)
+
+    async def blocks(self, channel: str, start: int = 0, stop: int | None = None):
+        cli = RpcClient(*self.addr)
+        await cli.connect()
+        try:
+            st = await cli.open_stream("Deliver")
+            await st.send(json.dumps(
+                {"channel": channel, "start": start, "stop": stop}
+            ).encode())
+            async for payload in st:
+                blk = common_pb2.Block()
+                blk.ParseFromString(payload)
+                yield blk
+        finally:
+            await cli.close()

@@ -1,0 +1,276 @@
+"""Ordering service tests: blockcutter rules, raft consensus (leader
+election, replication, failover, WAL recovery), and a 3-orderer
+localhost cluster streaming identical blocks through Broadcast/Deliver
+(the reference's raft integration-suite behaviors, scaled to unit
+speed: orderer/common/blockcutter tests, etcdraft chain tests)."""
+
+import asyncio
+import json
+
+import pytest
+
+from fabric_tpu import protoutil as pu
+from fabric_tpu.ordering.blockcutter import BatchConfig, BlockCutter
+from fabric_tpu.ordering.node import BroadcastClient, DeliverClient, OrdererNode
+from fabric_tpu.ordering.raft import Entry, RaftNode, WAL
+from fabric_tpu.protos import common_pb2
+
+
+def run(coro, timeout=30):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# blockcutter
+
+
+def test_blockcutter_count_cut():
+    bc = BlockCutter(BatchConfig(max_message_count=3))
+    cut, pending = bc.ordered(b"a")
+    assert cut == [] and pending
+    cut, _ = bc.ordered(b"b")
+    assert cut == []
+    cut, pending = bc.ordered(b"c")
+    assert cut == [[b"a", b"b", b"c"]] and not pending
+
+
+def test_blockcutter_preferred_bytes():
+    bc = BlockCutter(BatchConfig(max_message_count=100, preferred_max_bytes=10))
+    bc.ordered(b"aaaa")            # 4 bytes pending
+    cut, pending = bc.ordered(b"bbbbbbbb")  # 4+8 > 10: cut pending first
+    assert cut == [[b"aaaa"]] and pending
+    assert bc.cut() == [b"bbbbbbbb"]
+
+
+def test_blockcutter_isolated_oversize():
+    bc = BlockCutter(BatchConfig(max_message_count=100, preferred_max_bytes=10))
+    bc.ordered(b"aa")
+    cut, pending = bc.ordered(b"x" * 50)  # oversize: flush + isolate
+    assert cut == [[b"aa"], [b"x" * 50]] and not pending
+
+
+# ---------------------------------------------------------------------------
+# raft core over an in-memory lossless transport
+
+
+class Net:
+    """In-memory transport: loop.call_soon delivery, droppable."""
+
+    def __init__(self):
+        self.nodes = {}
+        self.down = set()
+
+    def send(self, frm):
+        def cb(peer, msg):
+            if peer in self.down or frm in self.down:
+                return
+            node = self.nodes.get(peer)
+            if node is not None:
+                asyncio.get_event_loop().call_soon(node.handle, msg)
+        return cb
+
+
+async def _wait_for(cond, timeout=5.0, interval=0.01):
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return False
+
+
+def _mk_cluster(tmp_path, net, ids=("o1", "o2", "o3")):
+    applied = {i: [] for i in ids}
+    nodes = {}
+    for i in ids:
+        wal = WAL(str(tmp_path / i))
+        nodes[i] = RaftNode(
+            i, list(ids), wal,
+            apply_cb=lambda e, i=i: applied[i].append(e),
+            send_cb=net.send(i),
+            election_timeout=(0.05, 0.12), heartbeat=0.02,
+        )
+    net.nodes = nodes
+    return nodes, applied
+
+
+def test_raft_elects_replicates_and_fails_over(tmp_path):
+    async def scenario():
+        net = Net()
+        nodes, applied = _mk_cluster(tmp_path, net)
+        for n in nodes.values():
+            n.start()
+        assert await _wait_for(
+            lambda: any(n.state == "leader" for n in nodes.values()))
+        leader = next(n for n in nodes.values() if n.state == "leader")
+        for i in range(5):
+            assert leader.propose(b"entry-%d" % i) is not None
+        assert await _wait_for(
+            lambda: all(len(applied[i]) == 5 for i in applied))
+        assert [e.data for e in applied["o1"]] == [b"entry-%d" % i for i in range(5)]
+        assert applied["o1"] == applied["o2"] == applied["o3"]
+
+        # kill the leader: a new one rises and the log continues
+        net.down.add(leader.id)
+        leader.stop()
+        rest = [n for n in nodes.values() if n.id != leader.id]
+        assert await _wait_for(
+            lambda: any(n.state == "leader" for n in rest), timeout=10)
+        leader2 = next(n for n in rest if n.state == "leader")
+        assert leader2.propose(b"after-failover") is not None
+        live = [i for i in applied if i != leader.id]
+        assert await _wait_for(
+            lambda: all(len(applied[i]) == 6 for i in live))
+        for n in rest:
+            n.stop()
+
+    run(scenario())
+
+
+def test_raft_wal_recovery(tmp_path):
+    wal = WAL(str(tmp_path / "w"))
+    wal.save_meta(3, "o2")
+    wal.append([Entry(1, 1, b"a"), Entry(1, 2, b"b"), Entry(3, 3, b"c")])
+    wal.close()
+    # torn tail: append garbage half-frame
+    with open(str(tmp_path / "w" / "wal.bin"), "ab") as f:
+        f.write(b"\x00\x00\x00\x10partial")
+    w2 = WAL(str(tmp_path / "w"))
+    assert w2.term == 3 and w2.voted_for == "o2"
+    assert [(e.term, e.index, e.data) for e in w2.entries] == [
+        (1, 1, b"a"), (1, 2, b"b"), (3, 3, b"c")
+    ]
+    w2.close()
+
+
+# ---------------------------------------------------------------------------
+# 3-orderer cluster over real localhost sockets
+
+
+def _env(i: int) -> bytes:
+    ch = pu.make_channel_header(common_pb2.HeaderType.ENDORSER_TRANSACTION, "ch1")
+    sh = pu.make_signature_header(b"creator-%d" % i, b"nonce-%d" % i)
+    payload = pu.make_payload(ch, sh, b"tx-payload-%d" % i)
+    return common_pb2.Envelope(
+        payload=payload.SerializeToString(), signature=b"sig"
+    ).SerializeToString()
+
+
+@pytest.mark.slow
+def test_orderer_cluster_end_to_end(tmp_path):
+    async def scenario():
+        cluster = {}
+        nodes = []
+        for i in range(3):
+            n = OrdererNode(f"o{i}", str(tmp_path / f"o{i}"), cluster)
+            await n.start()
+            cluster[n.id] = ("127.0.0.1", n.port)
+            nodes.append(n)
+        cfg = BatchConfig(max_message_count=4, batch_timeout_s=0.3)
+        for n in nodes:
+            n.cluster.update(cluster)  # all addresses known before joining
+            n.batch_config = cfg
+            n.join_channel("ch1")
+
+        assert await _wait_for(
+            lambda: any(n.chains["ch1"].raft.state == "leader" for n in nodes),
+            timeout=10)
+
+        client = BroadcastClient([cluster[n.id] for n in nodes])
+        for i in range(10):
+            res = await client.broadcast("ch1", _env(i))
+            assert res["status"] == 200, res
+
+        # all nodes converge to identical chains (10 txs = 2 full
+        # batches of 4 + timeout batch of 2)
+        assert await _wait_for(
+            lambda: all(n.chains["ch1"].height >= 3 for n in nodes), timeout=10)
+        chains = []
+        for n in nodes:
+            blks = [n.chains["ch1"].blocks.get_block(k).SerializeToString()
+                    for k in range(3)]
+            chains.append(blks)
+        assert chains[0] == chains[1] == chains[2]
+        total = sum(
+            len(nodes[0].chains["ch1"].blocks.get_block(k).data.data)
+            for k in range(3)
+        )
+        assert total == 10
+
+        # deliver stream from a random node matches
+        got = []
+        dc = DeliverClient(*cluster["o1"])
+        async for blk in dc.blocks("ch1", 0, 2):
+            got.append(blk.SerializeToString())
+        assert got == chains[0]
+
+        # kill the leader; a client keeps submitting and the cluster
+        # keeps cutting identical blocks
+        leader = next(n for n in nodes if n.chains["ch1"].raft.state == "leader")
+        await leader.stop()
+        rest = [n for n in nodes if n is not leader]
+        assert await _wait_for(
+            lambda: any(n.chains["ch1"].raft.state == "leader" for n in rest),
+            timeout=10)
+        for i in range(10, 14):
+            res = await client.broadcast("ch1", _env(i))
+            assert res["status"] == 200, res
+        assert await _wait_for(
+            lambda: all(n.chains["ch1"].height >= 4 for n in rest), timeout=10)
+        h = min(n.chains["ch1"].height for n in rest)
+        for k in range(h):
+            assert (rest[0].chains["ch1"].blocks.get_block(k).SerializeToString()
+                    == rest[1].chains["ch1"].blocks.get_block(k).SerializeToString())
+
+        await client.close()
+        for n in rest:
+            await n.stop()
+
+    run(scenario(), timeout=60)
+
+
+def test_chain_restart_does_not_duplicate_blocks(tmp_path):
+    """WAL replay after restart must not re-append materialized
+    batches (with and without a genesis block in the store)."""
+    async def scenario(subdir, genesis):
+        from fabric_tpu.ordering.chain import OrderingChain
+
+        sent = []
+        chain = OrderingChain(
+            "chz", "solo", ["solo"], str(tmp_path / subdir),
+            send_cb=lambda p, m: sent.append((p, m)),
+            config=BatchConfig(max_message_count=1),
+            genesis_block=genesis,
+        )
+        chain.start()
+        assert await _wait_for(lambda: chain.raft.state == "leader")
+        for i in range(3):
+            await chain.broadcast(_env(i))
+        base = 1 if genesis is not None else 0
+        assert await _wait_for(lambda: chain.height == base + 3)
+        blocks = [chain.blocks.get_block(k).SerializeToString()
+                  for k in range(chain.height)]
+        chain.stop()
+
+        chain2 = OrderingChain(
+            "chz", "solo", ["solo"], str(tmp_path / subdir),
+            send_cb=lambda p, m: None,
+            config=BatchConfig(max_message_count=1),
+        )
+        chain2.start()
+        assert await _wait_for(lambda: chain2.raft.state == "leader")
+        await chain2.broadcast(_env(99))
+        assert await _wait_for(lambda: chain2.height == base + 4)
+        # replay did not duplicate: prefix identical, one new block
+        for k in range(base + 3):
+            assert chain2.blocks.get_block(k).SerializeToString() == blocks[k]
+        chain2.stop()
+
+    gen = pu.finalize_block(pu.new_block(0, b"\x00" * 32))
+    run(scenario("with_gen", gen))
+    run(scenario("no_gen", None))

@@ -1,0 +1,243 @@
+"""MXU-first ECDSA kernel (ops.digits + ops.p256v2) tests.
+
+Three layers of oracle:
+1. digit field core vs Python ints (adversarial magnitudes, long
+   chains, the certified bound schedule);
+2. RCB complete point formulas vs crypto.ec_ref point ops, including
+   every degenerate case (doubling lane, inverse lane, infinity);
+3. full verify_batch vs the reference accept set (ec_ref /
+   bccsp/sw/ecdsa.go:41-58 semantics: low-S, ranges, on-curve).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fabric_tpu.crypto import ec_ref
+from fabric_tpu.ops import digits as dg
+from fabric_tpu.ops import p256v2 as v2
+
+
+def _to_digits(vals):
+    return jnp.asarray(dg.ints_to_digits(vals))
+
+
+def _from_digits_mod(arr, m):
+    return [dg.digits_to_int(r) % m for r in np.asarray(arr)]
+
+
+# ---------------------------------------------------------------------------
+# field core
+
+
+def test_bound_certificates():
+    """The interval certificates the kernel relies on must hold."""
+    side = v2._MAX_SIDE
+    assert dg.SETTLED_MAX * 6 <= side
+    assert v2.MODP.bound_check(side, side) <= dg.SETTLED_MAX
+    assert v2.MODN.bound_check(side, side) <= dg.SETTLED_MAX
+
+
+@pytest.mark.parametrize("mod", [v2.MODP, v2.MODN], ids=["p", "n"])
+def test_mul_chain_exact(mod, rng):
+    """300 chained muls bit-exact vs Python ints; digits stay within
+    the certified settled bound."""
+    B = 8
+    m = mod.m
+    a_int = [int.from_bytes(rng.bytes(32), "big") % m for _ in range(B)]
+    b_int = [m - 1, 1, 0, 2] + [
+        int.from_bytes(rng.bytes(32), "big") % m for _ in range(B - 4)
+    ]
+    mul = jax.jit(mod.mul)
+    a = _to_digits(a_int)
+    b = _to_digits(b_int)
+    want = list(a_int)
+    maxd = 0
+    for it in range(300):
+        a = mul(a, b)
+        for lane in range(B):
+            want[lane] = want[lane] * b_int[lane] % m
+        if it % 97 == 0 or it == 299:
+            maxd = max(maxd, int(np.abs(np.asarray(a)).max()))
+            assert _from_digits_mod(a, m) == want, it
+    assert maxd <= dg.SETTLED_MAX
+
+
+@pytest.mark.parametrize("mod", [v2.MODP, v2.MODN], ids=["p", "n"])
+def test_mul_adversarial_magnitudes(mod):
+    """Inputs at the pairing limit, mixed signs — f32 exactness and
+    settle bounds must hold at the extremes, not just on average."""
+    side = v2._MAX_SIDE
+    m = mod.m
+    patterns = [
+        np.full(dg.K, side, np.int32),
+        np.full(dg.K, -side, np.int32),
+        np.array([side if i % 2 else -side for i in range(dg.K)], np.int32),
+        np.array([(-1) ** i * (side - i) for i in range(dg.K)], np.int32),
+    ]
+    a = jnp.asarray(np.stack(patterns))
+    b = jnp.asarray(np.stack(patterns[::-1]))
+    out = jax.jit(mod.mul)(a, b)
+    assert int(np.abs(np.asarray(out)).max()) <= dg.SETTLED_MAX
+    for lane in range(len(patterns)):
+        av = dg.digits_to_int(patterns[lane])
+        bv = dg.digits_to_int(patterns[::-1][lane])
+        assert _from_digits_mod(out, m)[lane] == (av * bv) % m
+
+
+@pytest.mark.parametrize("mod", [v2.MODP, v2.MODN], ids=["p", "n"])
+def test_canonical(mod, rng):
+    m = mod.m
+    vals = [0, 1, m - 1, m, m + 1, 2 * m + 5]
+    vals += [int.from_bytes(rng.bytes(33), "big") % (1 << 258) for _ in range(6)]
+    t = _to_digits([v % (1 << 258) for v in vals])
+    got = _from_digits_mod(jax.jit(mod.canonical)(t), 1 << 300)
+    assert got == [v % m for v in vals]
+    # negative representations (from subtraction chains)
+    neg = jnp.asarray(dg.ints_to_digits([5])) - jnp.asarray(dg.ints_to_digits([7]))
+    got = _from_digits_mod(jax.jit(mod.canonical)(neg), 1 << 300)
+    assert got == [(5 - 7) % m]
+
+
+# ---------------------------------------------------------------------------
+# point ops
+
+
+def _rand_pt(rng):
+    k = int.from_bytes(rng.bytes(32), "big") % ec_ref.N or 1
+    return ec_ref.pt_mul(k, (ec_ref.GX, ec_ref.GY))
+
+
+def _proj(pts):
+    xs = _to_digits([p[0] if p else 0 for p in pts])
+    ys = _to_digits([p[1] if p else 1 for p in pts])
+    zs = _to_digits([0 if p is None else 1 for p in pts])
+    return xs, ys, zs
+
+
+def _fv3(arrs, bound=63):
+    return tuple(v2.FV(a, bound, v2.MODP) for a in arrs)
+
+
+def _affine(arrs):
+    X = _from_digits_mod(v2.MODP.canonical(arrs[0]), ec_ref.P)
+    Y = _from_digits_mod(v2.MODP.canonical(arrs[1]), ec_ref.P)
+    Z = _from_digits_mod(v2.MODP.canonical(arrs[2]), ec_ref.P)
+    out = []
+    for x, y, z in zip(X, Y, Z):
+        if z == 0:
+            out.append(None)
+        else:
+            zi = pow(z, -1, ec_ref.P)
+            out.append((x * zi % ec_ref.P, y * zi % ec_ref.P))
+    return out
+
+
+def test_rcb_complete_add_and_double(rng):
+    pts1 = [_rand_pt(rng) for _ in range(5)]
+    pts2 = [_rand_pt(rng) for _ in range(5)]
+    pts1[1] = pts2[1]                                   # doubling lane
+    pts2[2] = (pts1[2][0], (-pts1[2][1]) % ec_ref.P)    # inverse → ∞
+    pts2[3] = None                                      # ∞ operand
+    pts1[4] = None
+
+    def run_add(a, b):
+        b_fv = v2._const_fv(ec_ref.B, a[0], v2.MODP)
+        return [t.arr for t in v2.pt_add(_fv3(a), _fv3(b), b_fv)]
+
+    got = _affine(jax.jit(run_add)(_proj(pts1), _proj(pts2)))
+    assert got == [ec_ref.pt_add(a, b) for a, b in zip(pts1, pts2)]
+
+    def run_dbl(a):
+        b_fv = v2._const_fv(ec_ref.B, a[0], v2.MODP)
+        return [t.arr for t in v2.pt_double(_fv3(a), b_fv)]
+
+    got = _affine(jax.jit(run_dbl)(_proj(pts1)))
+    assert got == [ec_ref.pt_double(a) for a in pts1]
+
+
+def test_rcb_mixed_add(rng):
+    pts1 = [_rand_pt(rng) for _ in range(4)]
+    pts2 = [_rand_pt(rng) for _ in range(4)]
+    pts1[2] = None          # ∞ + affine
+    pts1[3] = pts2[3]       # doubling via mixed
+
+    def run(a, x2, y2):
+        b_fv = v2._const_fv(ec_ref.B, x2, v2.MODP)
+        return [
+            t.arr for t in v2.pt_add_mixed(
+                _fv3(a), v2.FV(x2, 63, v2.MODP), v2.FV(y2, 63, v2.MODP), b_fv
+            )
+        ]
+
+    got = _affine(jax.jit(run)(
+        _proj(pts1),
+        _to_digits([p[0] for p in pts2]),
+        _to_digits([p[1] for p in pts2]),
+    ))
+    assert got == [ec_ref.pt_add(a, b) for a, b in zip(pts1, pts2)]
+
+
+# ---------------------------------------------------------------------------
+# full verify
+
+
+@pytest.fixture(scope="module")
+def sigs(rng):
+    keys = [ec_ref.SigningKey.generate() for _ in range(3)]
+    return keys
+
+
+def test_verify_accepts_valid_and_rejects_adversarial(sigs, rng):
+    keys = sigs
+    items, want = [], []
+    for i in range(12):
+        k = keys[i % 3]
+        e = ec_ref.digest_int(b"payload-%d" % i)
+        r, s = k.sign_digest(e)
+        items.append((e, r, s, *k.public))
+        want.append(True)
+    e = ec_ref.digest_int(b"hs")
+    r, s = keys[0].sign_digest(e)
+    adversarial = [
+        (ec_ref.digest_int(b"other"), r, s, *keys[0].public),  # wrong digest
+        (e, r, ec_ref.N - s, *keys[0].public),                 # high-S
+        (e, 0, s, *keys[0].public),                            # r = 0
+        (e, r, 0, *keys[0].public),                            # s = 0
+        (e, ec_ref.N, s, *keys[0].public),                     # r = n
+        (e, s, r, *keys[0].public),                            # swapped
+        (e, r, s, keys[0].public[0] + 1, keys[0].public[1]),   # off-curve Q
+        (e, r, s, *keys[1].public),                            # wrong key
+        (e, r, s, 0, 0),                                       # Q = ∞ encoding
+    ]
+    items += adversarial
+    want += [False] * len(adversarial)
+    got = v2.verify_host(items)
+    assert got == want
+    # oracle agreement on every case
+    for (ei, ri, si, xi, yi), g in zip(items, got):
+        assert g == ec_ref.verify_digest((xi, yi), ei, ri, si)
+
+
+def test_verify_matches_oracle_randomized(sigs, rng):
+    """Random mutations of valid signatures — kernel accept set must
+    equal the oracle accept set exactly."""
+    keys = sigs
+    items = []
+    for i in range(48):
+        k = keys[i % 3]
+        e = ec_ref.digest_int(rng.bytes(16))
+        r, s = k.sign_digest(e)
+        kind = i % 6
+        if kind == 1:
+            r = (r + int(rng.integers(0, 3))) % ec_ref.N
+        elif kind == 2:
+            s = (s + int(rng.integers(0, 3))) % ec_ref.N
+        elif kind == 3:
+            e = (e + int(rng.integers(0, 2))) % (1 << 256)
+        items.append((e, r, s, *k.public))
+    got = v2.verify_host(items)
+    want = [ec_ref.verify_digest((x, y), e, r, s) for (e, r, s, x, y) in items]
+    assert got == want
+    assert any(want) and not all(want)
