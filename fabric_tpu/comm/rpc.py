@@ -103,6 +103,10 @@ class _Conn:
         self.next_id = 1
         self.lock = asyncio.Lock()
         self.closed = asyncio.Event()
+        # asyncio holds tasks only weakly: fire-and-forget dispatch
+        # tasks must be strongly referenced or the GC can destroy them
+        # mid-handler (observed as aclose()-while-running errors)
+        self._tasks: set = set()
 
     async def pump(self, dispatch=None):
         """Read frames and route to streams; ``dispatch`` handles new
@@ -115,7 +119,9 @@ class _Conn:
                         continue
                     st = _Stream(self, stream_id)
                     self.streams[stream_id] = st
-                    asyncio.ensure_future(dispatch(payload.decode(), st))
+                    t = asyncio.ensure_future(dispatch(payload.decode(), st))
+                    self._tasks.add(t)
+                    t.add_done_callback(self._tasks.discard)
                 elif stream_id in self.streams:
                     st = self.streams[stream_id]
                     if kind == KIND_MSG:

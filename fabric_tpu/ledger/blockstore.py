@@ -27,7 +27,9 @@ class BlockStore:
     def __init__(self, dirpath: str):
         self.dir = dirpath
         os.makedirs(dirpath, exist_ok=True)
-        self._idx = sqlite3.connect(os.path.join(dirpath, "index.db"))
+        self._idx = sqlite3.connect(
+            os.path.join(dirpath, "index.db"), check_same_thread=False
+        )
         self._idx.execute("PRAGMA journal_mode=WAL")
         self._idx.execute(
             "CREATE TABLE IF NOT EXISTS blocks ("

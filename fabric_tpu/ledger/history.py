@@ -8,7 +8,7 @@ import sqlite3
 
 class HistoryDB:
     def __init__(self, path: str):
-        self._conn = sqlite3.connect(path)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute(
             "CREATE TABLE IF NOT EXISTS hist ("

@@ -156,7 +156,7 @@ class SqliteVersionedDB(VersionedDB):
         self._conn: sqlite3.Connection | None = None
 
     def open(self):
-        self._conn = sqlite3.connect(self.path)
+        self._conn = sqlite3.connect(self.path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA synchronous=NORMAL")
         self._conn.execute(

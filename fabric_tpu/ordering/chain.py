@@ -192,13 +192,10 @@ class OrderingChain:
                 yield blk.SerializeToString()
                 num += 1
             else:
-                # grab the event BEFORE re-checking height: _apply sets
-                # then replaces the event, so a block landing between
-                # the check and the wait still wakes this waiter
-                ev = self._height_changed
-                if num < self.blocks.height:
-                    continue
-                await ev.wait()
+                # single event loop: no await between the height check
+                # and this wait, so no wakeup can be missed (_apply
+                # sets the event then replaces it)
+                await self._height_changed.wait()
 
     @property
     def height(self) -> int:

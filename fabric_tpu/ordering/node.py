@@ -42,13 +42,15 @@ class OrdererNode:
         self.chains: dict[str, OrderingChain] = {}
         self.server = RpcServer(host, port)
         self._peer_clients: dict[str, RpcClient] = {}
-        self._loop = None
+        self._bg: set = set()  # strong refs: GC destroys weakly-held tasks
 
     # -- raft transport -------------------------------------------------------
 
     def _send(self, channel: str):
         def send(peer_id: str, msg: dict):
-            asyncio.ensure_future(self._send_async(peer_id, channel, msg))
+            t = asyncio.ensure_future(self._send_async(peer_id, channel, msg))
+            self._bg.add(t)
+            t.add_done_callback(self._bg.discard)
         return send
 
     async def _peer_client(self, peer_id: str) -> RpcClient:

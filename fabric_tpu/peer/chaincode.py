@@ -1,0 +1,197 @@
+"""Chaincode runtime: contract execution building rwsets via the
+simulator.
+
+The reference launches chaincode out-of-process (Docker or external
+service) and speaks a duplex gRPC FSM
+(core/chaincode/chaincode_support.go:160 Execute, handler.go:364
+ProcessStream — GetState/PutState round-trips per call).  Two modes
+here, matching its external-builder direction but without Docker:
+
+* **In-process contracts** (devmode analog): a `Contract` subclass is
+  registered with the runtime and invoked directly against the
+  simulator — zero IPC, the mode benchmarks and tests use.
+* **Chaincode-as-a-service** (ccaas analog): the contract runs in its
+  own process hosting an RPC server; the peer calls ``Invoke`` and the
+  chaincode calls back state ops over the same stream, mirroring the
+  handler FSM message loop (see fabric_tpu/peer/ccaas.py).
+
+Either way the runtime owns namespace scoping: a contract only touches
+its own namespace unless it explicitly invokes another chaincode
+(InvokeChaincode semantics — same-channel read-write)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+
+class ChaincodeError(Exception):
+    pass
+
+
+@dataclass
+class Response:
+    status: int = 200
+    payload: bytes = b""
+    message: str = ""
+
+
+class ContractStub:
+    """The API a contract sees (shim/stub analog), bound to one
+    (simulator, namespace, invocation)."""
+
+    def __init__(self, runtime: "ChaincodeRuntime", sim, namespace: str,
+                 args: list[bytes], transient: dict | None = None,
+                 creator: bytes = b""):
+        self._rt = runtime
+        self._sim = sim
+        self.namespace = namespace
+        self.args = args
+        self.transient = transient or {}
+        self.creator = creator
+        self.events: list[tuple[str, bytes]] = []
+
+    # state ---------------------------------------------------------------
+    def get_state(self, key: str) -> bytes | None:
+        return self._sim.get_state(self.namespace, key)
+
+    def put_state(self, key: str, value: bytes) -> None:
+        self._sim.set_state(self.namespace, key, value)
+
+    def del_state(self, key: str) -> None:
+        self._sim.delete_state(self.namespace, key)
+
+    def get_state_range(self, start: str, end: str, limit: int = 0):
+        return self._sim.get_state_range(self.namespace, start, end, limit)
+
+    def get_private(self, coll: str, key: str) -> bytes | None:
+        return self._sim.get_private_data(self.namespace, coll, key)
+
+    def put_private(self, coll: str, key: str, value: bytes) -> None:
+        self._sim.set_private_data(self.namespace, coll, key, value)
+
+    # events / cross-chaincode --------------------------------------------
+    def set_event(self, name: str, payload: bytes) -> None:
+        self.events.append((name, payload))
+
+    def invoke_chaincode(self, chaincode: str, args: list[bytes]) -> Response:
+        """Same-channel chaincode-to-chaincode call: the callee builds
+        its rwset into the SAME simulator under its own namespace
+        (handler.go HandleInvokeChaincode semantics)."""
+        return self._rt.execute(self._sim, chaincode, args,
+                                transient=self.transient, creator=self.creator)
+
+
+class Contract:
+    """Subclass and register: dispatches args[0] as the method name."""
+
+    def invoke(self, stub: ContractStub) -> Response:
+        if not stub.args:
+            return Response(400, message="no function")
+        fn_name = stub.args[0].decode()
+        # only subclass-defined public methods are invocable — base
+        # machinery (invoke itself) would recurse unboundedly
+        if fn_name.startswith("_") or hasattr(Contract, fn_name):
+            return Response(400, message=f"unknown function {fn_name}")
+        fn = getattr(self, fn_name, None)
+        if not callable(fn):
+            return Response(400, message=f"unknown function {fn_name}")
+        try:
+            out = fn(stub, *stub.args[1:])
+        except ChaincodeError as e:
+            return Response(500, message=str(e))
+        if isinstance(out, Response):
+            return out
+        return Response(200, payload=out if isinstance(out, bytes) else b"")
+
+
+class ChaincodeRuntime:
+    """namespace → executable contract (the ChaincodeSupport registry
+    analog; launchers register in-process or ccaas-backed handlers)."""
+
+    def __init__(self):
+        self._contracts: dict[str, object] = {}
+
+    def register(self, name: str, contract) -> None:
+        self._contracts[name] = contract
+
+    def registered(self, name: str) -> bool:
+        return name in self._contracts
+
+    def execute(self, sim, name: str, args: list[bytes],
+                transient: dict | None = None, creator: bytes = b"") -> Response:
+        contract = self._contracts.get(name)
+        if contract is None:
+            raise ChaincodeError(f"chaincode {name} not installed")
+        stub = ContractStub(self, sim, name, args, transient, creator)
+        resp = contract.invoke(stub)
+        resp.events = stub.events  # type: ignore[attr-defined]
+        return resp
+
+
+# ---------------------------------------------------------------------------
+# sample contracts (integration/chaincode analogs, used by tests/bench)
+
+
+class KVContract(Contract):
+    """simple key-value chaincode (integration/chaincode/simple)."""
+
+    def put(self, stub, key: bytes, value: bytes):
+        stub.put_state(key.decode(), value)
+        return b"ok"
+
+    def get(self, stub, key: bytes):
+        v = stub.get_state(key.decode())
+        if v is None:
+            return Response(404, message="not found")
+        return v
+
+    def delete(self, stub, key: bytes):
+        stub.del_state(key.decode())
+        return b"ok"
+
+    def transfer(self, stub, frm: bytes, to: bytes, amount: bytes):
+        if frm == to:
+            return Response(400, message="self-transfer")
+        a = int(stub.get_state(frm.decode()) or b"0")
+        b = int(stub.get_state(to.decode()) or b"0")
+        amt = int(amount)
+        if a < amt:
+            return Response(500, message="insufficient funds")
+        stub.put_state(frm.decode(), str(a - amt).encode())
+        stub.put_state(to.decode(), str(b + amt).encode())
+        return b"ok"
+
+    def range_sum(self, stub, start: bytes, end: bytes):
+        total = sum(
+            int(v) for _, v in stub.get_state_range(start.decode(), end.decode())
+        )
+        return str(total).encode()
+
+    def put_private(self, stub, coll: bytes, key: bytes):
+        value = stub.transient.get("value")
+        if value is None:
+            return Response(400, message="missing transient value")
+        stub.put_private(coll.decode(), key.decode(), value)
+        return b"ok"
+
+
+class MarblesContract(Contract):
+    """JSON-document chaincode exercising rich state (statecouchdb
+    analog paths: execute_query over JSON values)."""
+
+    def create(self, stub, name: bytes, color: bytes, size: bytes, owner: bytes):
+        doc = {"docType": "marble", "name": name.decode(),
+               "color": color.decode(), "size": int(size), "owner": owner.decode()}
+        stub.put_state(name.decode(), json.dumps(doc).encode())
+        stub.set_event("marble_created", name)
+        return b"ok"
+
+    def transfer(self, stub, name: bytes, new_owner: bytes):
+        raw = stub.get_state(name.decode())
+        if raw is None:
+            return Response(404, message="no such marble")
+        doc = json.loads(raw)
+        doc["owner"] = new_owner.decode()
+        stub.put_state(name.decode(), json.dumps(doc).encode())
+        return b"ok"

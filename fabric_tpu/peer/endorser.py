@@ -1,0 +1,110 @@
+"""Endorser: ProcessProposal — simulate a proposal and sign the result.
+
+Analog of core/endorser/endorser.go:304-476: unpack + auth the signed
+proposal, run the chaincode against a tx simulator, wrap the rwset in
+a ProposalResponsePayload whose hash binds (proposal, results), and
+sign prp‖endorser with the peer's signing identity (the default ESCC,
+core/handlers/endorsement/builtin/default_endorsement.go:35).  The
+signature bytes produced here are EXACTLY what the TPU batch kernel
+verifies at commit (validator_keylevel.go:244-260 SignedData layout —
+see fabric_tpu.peer.txassembly.create_proposal_response)."""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from fabric_tpu import protoutil
+from fabric_tpu.peer.chaincode import ChaincodeError, ChaincodeRuntime
+from fabric_tpu.peer.simulator import TxSimulator
+from fabric_tpu.protos import common_pb2, proposal_pb2
+
+
+@dataclass
+class EndorseResult:
+    response: proposal_pb2.ProposalResponse
+    pvt_cleartext: dict = field(default_factory=dict)
+    tx_id: str = ""
+
+
+class Endorser:
+    def __init__(self, msp_manager, signer, state_db,
+                 runtime: ChaincodeRuntime, acl_check=None):
+        """signer: the peer's SigningIdentity (ESCC key).
+        acl_check(channel, identity) -> bool (writers-policy hook)."""
+        self.msp = msp_manager
+        self.signer = signer
+        self.state = state_db
+        self.runtime = runtime
+        self.acl_check = acl_check
+
+    def process_proposal(self, signed: proposal_pb2.SignedProposal) -> EndorseResult:
+        prop = protoutil.unmarshal(proposal_pb2.Proposal, signed.proposal_bytes)
+        header = protoutil.unmarshal(common_pb2.Header, prop.header)
+        ch = protoutil.unmarshal(common_pb2.ChannelHeader, header.channel_header)
+        sh = protoutil.unmarshal(common_pb2.SignatureHeader, header.signature_header)
+
+        # auth: creator identity valid + signature over proposal bytes
+        # (endorser.go:315-339 preProcess → validateSignedProposal)
+        ident = self.msp.deserialize_identity(sh.creator)
+        if not ident.is_valid:
+            return self._err(500, "invalid creator identity")
+        if not ident.verify(signed.proposal_bytes, signed.signature):
+            return self._err(500, "invalid proposal signature")
+        if ch.tx_id != protoutil.compute_tx_id(sh.nonce, sh.creator):
+            return self._err(500, "tx_id mismatch")
+        if self.acl_check is not None and not self.acl_check(ch.channel_id, ident):
+            return self._err(403, "access denied")
+
+        # what to run
+        cpp = protoutil.unmarshal(
+            proposal_pb2.ChaincodeProposalPayload, prop.payload
+        )
+        spec = protoutil.unmarshal(
+            proposal_pb2.ChaincodeInvocationSpec, cpp.input
+        )
+        cc_name = spec.chaincode_spec.chaincode_id.name
+        args = list(spec.chaincode_spec.input.args)
+        transient = dict(cpp.TransientMap)
+
+        # simulate (endorser.go:379-401 GetTxSimulator + simulateProposal)
+        sim = TxSimulator(self.state)
+        try:
+            resp = self.runtime.execute(
+                sim, cc_name, args, transient=transient, creator=sh.creator
+            )
+        except ChaincodeError as e:
+            return self._err(500, str(e))
+        if resp.status >= 400:
+            # failed simulation is NOT endorsed (no rwset leaves the peer)
+            return self._err(resp.status, resp.message)
+        rwset_bytes, pvt_clear = sim.done()
+
+        events = b""
+        ev_list = getattr(resp, "events", [])
+        if ev_list:
+            name, payload = ev_list[-1]  # one event per tx, like the shim
+            events = proposal_pb2.ChaincodeEvent(
+                chaincode_id=cc_name, tx_id=ch.tx_id,
+                event_name=name, payload=payload,
+            ).SerializeToString()
+
+        # assemble + ESCC-sign
+        from fabric_tpu.peer import txassembly as txa
+
+        pr = txa.create_proposal_response(
+            prop, rwset_bytes, self.signer, cc_name,
+            response_payload=resp.payload, events=events, status=resp.status,
+        )
+        return EndorseResult(response=pr, pvt_cleartext=pvt_clear, tx_id=ch.tx_id)
+
+    @staticmethod
+    def _err(status: int, msg: str) -> EndorseResult:
+        pr = proposal_pb2.ProposalResponse()
+        pr.response.status = status
+        pr.response.message = msg
+        return EndorseResult(response=pr)
+
+
+def proposal_digest(signed: proposal_pb2.SignedProposal) -> bytes:
+    return hashlib.sha256(signed.proposal_bytes).digest()

@@ -1,0 +1,222 @@
+"""Peer node assembly: ledger + validator + endorser + commit driver
++ client services, as one process.
+
+The analog of internal/peer/node/start.go:190-930 `serve()` compressed
+to the components this framework has: a KVLedger per channel, the
+TPU-batched BlockValidator on the commit path, the endorser service,
+and a deliver-client loop that pulls blocks from the ordering service
+and drives StoreBlock (the gossip/privdata coordinator's role,
+coordinator.go:151 — gossip dissemination itself is replaced by every
+peer pulling from the orderer, which the reference also supports via
+useLeaderElection=false + org leaders).
+
+Services exposed over fabric_tpu.comm RPC:
+* ``Endorse``      — SignedProposal → ProposalResponse (unary).
+* ``DeliverBlocks``— committed-block stream with TRANSACTIONS_FILTER
+                     metadata set (client event stream analog).
+* ``Query``        — read-only state access (qscc-style convenience).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from fabric_tpu import protoutil
+from fabric_tpu.comm.rpc import RpcServer
+from fabric_tpu.ledger.kvledger import KVLedger
+from fabric_tpu.ledger.statedb import MemVersionedDB
+from fabric_tpu.ordering.node import DeliverClient
+from fabric_tpu.peer.chaincode import ChaincodeRuntime
+from fabric_tpu.peer.endorser import Endorser
+from fabric_tpu.peer.validator import BlockValidator, PolicyProvider
+from fabric_tpu.protos import common_pb2, proposal_pb2
+
+
+class PeerChannel:
+    """One channel's ledger + validator + commit loop on this peer."""
+
+    def __init__(self, channel_id: str, data_dir: str, msp_manager,
+                 policy_provider: PolicyProvider, state_db=None,
+                 config_processor=None):
+        self.id = channel_id
+        self.ledger = KVLedger(data_dir, state_db=state_db or MemVersionedDB())
+        self.validator = BlockValidator(
+            msp_manager, policy_provider, self.ledger.state,
+            block_store=self.ledger.blocks, config_processor=config_processor,
+        )
+        self.commit_lock = asyncio.Lock()  # endorsement vs commit (txmgr RW lock)
+        self._height_changed = asyncio.Event()
+        self._deliver_task: asyncio.Task | None = None
+
+    @property
+    def height(self) -> int:
+        return self.ledger.blocks.height
+
+    async def commit_block(self, block) -> bytes:
+        """Validate + commit one block (the StoreBlock path).
+
+        The validate call dispatches device kernels (and may compile on
+        first use) — it runs in a worker thread so the node's RPC
+        services stay responsive (the reference's validator pool,
+        v20/validator.go:193)."""
+        loop = asyncio.get_event_loop()
+        async with self.commit_lock:
+            flt, batch, history = await loop.run_in_executor(
+                None, self.validator.validate, block
+            )
+            self.ledger.commit_block(block, flt, batch, history)
+        self._height_changed.set()
+        self._height_changed = asyncio.Event()
+        return flt
+
+    async def run_deliver(self, orderer_addr: tuple[str, int]):
+        """Pull blocks from the orderer starting at our height and
+        commit them in order; reconnects forever (deliver client
+        failover is caller-side: pass a different address)."""
+        import contextlib
+
+        dc = DeliverClient(*orderer_addr)
+        async with contextlib.aclosing(dc.blocks(self.id, start=self.height)) as gen:
+            async for blk in gen:
+                if blk.header.number < self.height:
+                    continue  # replayed
+                await self.commit_block(blk)
+
+    def start_deliver(self, orderer_addrs: list[tuple[str, int]]):
+        """Background commit driver with orderer failover."""
+        import logging
+
+        log = logging.getLogger("fabric_tpu.peer.deliver")
+
+        async def loop():
+            i = 0
+            while True:
+                addr = orderer_addrs[i % len(orderer_addrs)]
+                i += 1
+                try:
+                    await self.run_deliver(addr)
+                except Exception as e:
+                    # a deterministic commit failure re-fails forever;
+                    # it must at least be VISIBLE
+                    log.warning("%s deliver from %s: %s: %s",
+                                self.id, addr, type(e).__name__, e)
+                    await asyncio.sleep(0.2)
+
+        self._deliver_task = asyncio.ensure_future(loop())
+
+    async def wait_height(self, h: int, timeout: float = 30.0):
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        while self.height < h:
+            ev = self._height_changed
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                raise asyncio.TimeoutError(f"height {self.height} < {h}")
+            await asyncio.wait_for(ev.wait(), remaining)
+
+    def stop(self):
+        if self._deliver_task:
+            self._deliver_task.cancel()
+        self.ledger.close()
+
+
+class PeerNode:
+    def __init__(self, node_id: str, data_dir: str, msp_manager, signer,
+                 runtime: ChaincodeRuntime | None = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.id = node_id
+        self.dir = data_dir
+        self.msp = msp_manager
+        self.signer = signer
+        self.runtime = runtime or ChaincodeRuntime()
+        self.channels: dict[str, PeerChannel] = {}
+        self.server = RpcServer(host, port)
+
+    def join_channel(self, channel_id: str, policy_provider: PolicyProvider,
+                     state_db=None, config_processor=None) -> PeerChannel:
+        ch = PeerChannel(
+            channel_id, f"{self.dir}/{channel_id}", self.msp,
+            policy_provider, state_db, config_processor,
+        )
+        self.channels[channel_id] = ch
+        return ch
+
+    # -- services ------------------------------------------------------------
+
+    async def start(self):
+        self.server.register_unary("Endorse", self._on_endorse)
+        self.server.register("DeliverBlocks", self._on_deliver_blocks)
+        self.server.register_unary("Query", self._on_query)
+        self.server.register_unary("Info", self._on_info)
+        await self.server.start()
+        self.port = self.server.port
+        return self
+
+    async def stop(self):
+        for ch in self.channels.values():
+            ch.stop()
+        await self.server.stop()
+
+    async def _on_endorse(self, req: bytes) -> bytes:
+        signed = proposal_pb2.SignedProposal()
+        signed.ParseFromString(req)
+        prop = protoutil.unmarshal(proposal_pb2.Proposal, signed.proposal_bytes)
+        header = protoutil.unmarshal(common_pb2.Header, prop.header)
+        ch_hdr = protoutil.unmarshal(common_pb2.ChannelHeader, header.channel_header)
+        chan = self.channels.get(ch_hdr.channel_id)
+        if chan is None:
+            pr = proposal_pb2.ProposalResponse()
+            pr.response.status = 404
+            pr.response.message = f"not joined to {ch_hdr.channel_id}"
+            return pr.SerializeToString()
+        endorser = Endorser(
+            self.msp, self.signer, chan.ledger.state, self.runtime
+        )
+        loop = asyncio.get_event_loop()
+        async with chan.commit_lock:  # simulate against a stable height
+            # off the event loop: ECDSA verify + chaincode execution
+            # must not stall Deliver/Query/commit service latency
+            result = await loop.run_in_executor(
+                None, endorser.process_proposal, signed
+            )
+        return result.response.SerializeToString()
+
+    async def _on_deliver_blocks(self, stream):
+        req = json.loads(await stream.__anext__())
+        chan = self.channels.get(req["channel"])
+        if chan is None:
+            await stream.error("no such channel")
+            return
+        num = req.get("start", 0)
+        stop = req.get("stop")
+        while stop is None or num <= stop:
+            if num < chan.height:
+                blk = chan.ledger.blocks.get_block(num)
+                await stream.send(blk.SerializeToString())
+                num += 1
+            else:
+                # single event loop: no await between the height check
+                # and grabbing the event, so no wakeup can be missed
+                await chan._height_changed.wait()
+        await stream.end()
+
+    async def _on_query(self, req: bytes) -> bytes:
+        q = json.loads(req)
+        chan = self.channels.get(q["channel"])
+        if chan is None:
+            return json.dumps({"status": 404}).encode()
+        vv = chan.ledger.state.get_state(q["ns"], q["key"])
+        return json.dumps({
+            "status": 200 if vv is not None else 404,
+            # empty bytes is a real committed value, distinct from absent
+            "value": vv.value.hex() if vv is not None and vv.value is not None else None,
+            "version": list(vv.version) if vv is not None else None,
+        }).encode()
+
+    async def _on_info(self, req: bytes) -> bytes:
+        q = json.loads(req)
+        chan = self.channels.get(q["channel"])
+        if chan is None:
+            return json.dumps({"status": 404}).encode()
+        return json.dumps({"status": 200, "height": chan.height}).encode()

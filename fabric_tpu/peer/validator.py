@@ -126,6 +126,17 @@ class BlockValidator:
         self.plugins = {"default": DefaultValidation(), **(plugins or {})}
         self.config_processor = config_processor
 
+    def warmup(self, n_sigs: int = 16) -> None:
+        """Compile (or load from the persistent cache) the signature
+        kernel for the smallest batch bucket before serving traffic —
+        first-block latency must not eat a cold compile."""
+        from fabric_tpu.crypto import ec_ref
+
+        k = ec_ref.SigningKey.generate()
+        e = ec_ref.digest_int(b"warmup")
+        r, s = k.sign_digest(e)
+        p256.verify_host([(e, r, s, *k.public)] * n_sigs)
+
     # -- phase 0: parse + collect -----------------------------------------
 
     def _parse(self, block: common_pb2.Block) -> tuple[list, list]:

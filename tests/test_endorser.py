@@ -1,0 +1,159 @@
+"""Endorser + simulator + chaincode runtime unit tests (reference
+scenarios: core/endorser tests, txmgr simulator tests)."""
+
+import pytest
+
+from fabric_tpu.crypto import cryptogen
+from fabric_tpu.crypto.msp import MSPManager
+from fabric_tpu.ledger.rwset import TxRWSet
+from fabric_tpu.ledger.statedb import MemVersionedDB, UpdateBatch
+from fabric_tpu.peer import txassembly as txa
+from fabric_tpu.peer.chaincode import ChaincodeRuntime, KVContract, MarblesContract
+from fabric_tpu.peer.endorser import Endorser
+from fabric_tpu.peer.simulator import TxSimulator
+from fabric_tpu.protos import proposal_pb2
+
+CHANNEL, CC = "uchan", "kvcc"
+
+
+@pytest.fixture(scope="module")
+def org():
+    return cryptogen.generate_org("Org1MSP", "org1.example.com", peers=1, users=1)
+
+
+@pytest.fixture(scope="module")
+def mgr(org):
+    return MSPManager({"Org1MSP": org.msp()})
+
+
+@pytest.fixture()
+def state():
+    db = MemVersionedDB()
+    b = UpdateBatch()
+    b.put(CC, "seeded", b"42", (3, 7))
+    b.put(CC, "r1", b"1", (3, 8))
+    b.put(CC, "r2", b"2", (3, 9))
+    db.apply_updates(b, (3, 9))
+    return db
+
+
+def _endorser(org, mgr, state):
+    rt = ChaincodeRuntime()
+    rt.register(CC, KVContract())
+    rt.register("marbles", MarblesContract())
+    signer = cryptogen.signing_identity(org, "peer0.org1.example.com")
+    return Endorser(mgr, signer, state, rt)
+
+
+def test_simulator_records_reads_writes_ranges(state):
+    sim = TxSimulator(state)
+    assert sim.get_state(CC, "seeded") == b"42"
+    assert sim.get_state(CC, "ghost") is None
+    sim.set_state(CC, "new", b"x")
+    assert sim.get_state(CC, "new") == b"x"  # read-your-writes
+    out = sim.get_state_range(CC, "r1", "r3")
+    assert [k for k, _ in out] == ["r1", "r2"]
+    rw_bytes, _ = sim.done()
+    rw = TxRWSet.from_bytes(rw_bytes)
+    n = rw.ns[CC]
+    assert n.reads["seeded"] == (3, 7)
+    assert n.reads["ghost"] is None
+    assert "new" not in n.reads  # own write: no spurious read
+    assert n.writes["new"] == b"x"
+    (start, end, results), = n.range_queries
+    assert (start, end) == ("r1", "r3")
+    assert results == [("r1", (3, 8)), ("r2", (3, 9))]
+
+
+def test_simulator_private_data(state):
+    sim = TxSimulator(state)
+    sim.set_private_data(CC, "collA", "secret", b"payload")
+    rw_bytes, clear = sim.done()
+    rw = TxRWSet.from_bytes(rw_bytes)
+    hashed = rw.ns[CC].hashed["collA"]["writes"]
+    assert len(hashed) == 1  # only hashes on the public set
+    assert clear[(CC, "collA")]["secret"] == b"payload"
+
+
+def test_process_proposal_endorses_and_binds_signature(org, mgr, state):
+    e = _endorser(org, mgr, state)
+    client = cryptogen.signing_identity(org, "User1@org1.example.com")
+    signed, tx_id, prop = txa.create_signed_proposal(
+        client, CHANNEL, CC, [b"put", b"k", b"v"]
+    )
+    res = e.process_proposal(signed)
+    assert res.response.response.status == 200
+    assert res.tx_id == tx_id
+    # endorsement signature verifies over prp || endorser
+    prp = res.response.payload
+    endr = res.response.endorsement
+    ident = mgr.deserialize_identity(endr.endorser)
+    assert ident.verify(prp + endr.endorser, endr.signature)
+    # rwset contains the write, no state was applied
+    cca = proposal_pb2.ChaincodeAction()
+    prp_msg = proposal_pb2.ProposalResponsePayload()
+    prp_msg.ParseFromString(prp)
+    cca.ParseFromString(prp_msg.extension)
+    rw = TxRWSet.from_bytes(cca.results)
+    assert rw.ns[CC].writes["k"] == b"v"
+    assert state.get_state(CC, "k") is None
+
+
+def test_process_proposal_rejects_bad_signature(org, mgr, state):
+    e = _endorser(org, mgr, state)
+    client = cryptogen.signing_identity(org, "User1@org1.example.com")
+    signed, _, _ = txa.create_signed_proposal(client, CHANNEL, CC, [b"get", b"seeded"])
+    bad = proposal_pb2.SignedProposal(
+        proposal_bytes=signed.proposal_bytes,
+        signature=signed.signature[:-2] + bytes(2),
+    )
+    assert e.process_proposal(bad).response.response.status == 500
+
+
+def test_process_proposal_rejects_failed_simulation(org, mgr, state):
+    e = _endorser(org, mgr, state)
+    client = cryptogen.signing_identity(org, "User1@org1.example.com")
+    signed, _, _ = txa.create_signed_proposal(
+        client, CHANNEL, CC, [b"get", b"missing-key"]
+    )
+    res = e.process_proposal(signed)
+    assert res.response.response.status == 404
+    assert not res.response.HasField("endorsement")
+    # unknown chaincode
+    signed, _, _ = txa.create_signed_proposal(client, CHANNEL, "nope", [b"x"])
+    assert e.process_proposal(signed).response.response.status == 500
+
+
+def test_cross_chaincode_invocation(org, mgr, state):
+    rt = ChaincodeRuntime()
+    rt.register(CC, KVContract())
+
+    from fabric_tpu.peer.chaincode import Contract, Response
+
+    class Caller(Contract):
+        def relay(self, stub, key: bytes, value: bytes):
+            r = stub.invoke_chaincode(CC, [b"put", key, value])
+            return Response(r.status, r.payload)
+
+    rt.register("caller", Caller())
+    sim = TxSimulator(state)
+    resp = rt.execute(sim, "caller", [b"relay", b"kk", b"vv"])
+    assert resp.status == 200
+    rw_bytes, _ = sim.done()
+    rw = TxRWSet.from_bytes(rw_bytes)
+    # callee's writes land under the CALLEE namespace
+    assert rw.ns[CC].writes["kk"] == b"vv"
+    assert "caller" not in rw.ns or not rw.ns["caller"].writes
+
+
+def test_transient_data_not_in_proposal_response(org, mgr, state):
+    e = _endorser(org, mgr, state)
+    client = cryptogen.signing_identity(org, "User1@org1.example.com")
+    signed, _, _ = txa.create_signed_proposal(
+        client, CHANNEL, CC, [b"put_private", b"collA", b"sec"],
+        transient={"value": b"top-secret"},
+    )
+    res = e.process_proposal(signed)
+    assert res.response.response.status == 200
+    assert b"top-secret" not in res.response.SerializeToString()
+    assert res.pvt_cleartext[(CC, "collA")]["sec"] == b"top-secret"
