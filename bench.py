@@ -58,16 +58,15 @@ def _bench_p256_verify():
         pub.verify(sig, msg, cec.ECDSA(hashes.SHA256()))
     cpu_s = time.perf_counter() - t0
 
-    cols = list(zip(*items))
-    e, r, s, qx, qy = (jnp.asarray(p256.ints_to_limbs(c)) for c in cols)
-    out = p256.verify_batch_jit(e, r, s, qx, qy)  # compile
-    jax.block_until_ready(out)
-    assert bool(np.asarray(out).all()), "TPU verify rejected valid signatures"
+    # verify_host dispatches to the default kernel (v3 RNS/Cox-Rower
+    # unless FABRIC_TPU_P256 selects v2/v1) — measure exactly what the
+    # commit path runs, end to end including host-side preparation.
+    out = p256.verify_host(items)  # compile
+    assert all(out), "TPU verify rejected valid signatures"
     reps = 5
     t0 = time.perf_counter()
     for _ in range(reps):
-        out = p256.verify_batch_jit(e, r, s, qx, qy)
-    jax.block_until_ready(out)
+        out = p256.verify_host(items)
     tpu_s = (time.perf_counter() - t0) / reps
 
     tpu_rate = B / tpu_s

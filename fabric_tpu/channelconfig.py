@@ -1,0 +1,442 @@
+"""Channel configuration: bundle, policy-manager tree, config updates.
+
+The reference represents each channel's consensus-governed configuration
+as a versioned tree of groups/values/policies (common/channelconfig,
+``Bundle`` built at core/peer/peer.go:247), with a named-policy tree
+(``/Channel/Application/Writers`` ..., common/policies/policy.go) whose
+inner nodes may be IMPLICIT_META policies (ANY/ALL/MAJORITY over a
+sub-policy of the child groups, common/policies/implicitmeta.go), and
+validates config-update transactions by (a) read-set version match,
+(b) computing the delta, (c) evaluating each modified element's
+mod_policy against the update's signatures (common/configtx/update.go,
+validator.go).
+
+TPU-native stance: channel config is pure control plane — tiny, rare,
+branchy — so it stays host-side Python; its *outputs* (policy ASTs,
+capability flags, MSP sets) feed the batch compiler
+(crypto/policy.compile_plan) that shapes the device kernels.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+from fabric_tpu import protoutil
+from fabric_tpu.crypto import policy as pol
+from fabric_tpu.crypto.msp import MSP, MSPManager, policy_from_proto, policy_to_proto
+from fabric_tpu.protos import common_pb2, configtx_pb2, policies_pb2
+
+# capability strings (common/capabilities/application.go)
+CAP_V2_0 = "V2_0"
+
+# ---------------------------------------------------------------------------
+# Policy tree
+
+
+@dataclass(frozen=True)
+class ImplicitMeta:
+    """ANY/ALL/MAJORITY over ``sub_policy`` of the child groups."""
+
+    rule: int  # policies_pb2.ImplicitMetaPolicy.ANY / ALL / MAJORITY
+    sub_policy: str
+
+
+def policy_from_config(cp: configtx_pb2.ConfigPolicy):
+    """ConfigPolicy → signature-policy AST or ImplicitMeta."""
+    p = cp.policy
+    if p.type == policies_pb2.Policy.SIGNATURE:
+        env = protoutil.unmarshal(policies_pb2.SignaturePolicyEnvelope, p.value)
+        return policy_from_proto(env)
+    if p.type == policies_pb2.Policy.IMPLICIT_META:
+        im = protoutil.unmarshal(policies_pb2.ImplicitMetaPolicy, p.value)
+        return ImplicitMeta(rule=im.rule, sub_policy=im.sub_policy)
+    raise ValueError(f"unsupported policy type {p.type}")
+
+
+def config_policy(ast_or_meta, mod_policy: str = "Admins") -> configtx_pb2.ConfigPolicy:
+    cp = configtx_pb2.ConfigPolicy(mod_policy=mod_policy)
+    if isinstance(ast_or_meta, ImplicitMeta):
+        im = policies_pb2.ImplicitMetaPolicy(
+            sub_policy=ast_or_meta.sub_policy, rule=ast_or_meta.rule
+        )
+        cp.policy.type = policies_pb2.Policy.IMPLICIT_META
+        cp.policy.value = im.SerializeToString()
+    else:
+        env = policy_to_proto(ast_or_meta)
+        cp.policy.type = policies_pb2.Policy.SIGNATURE
+        cp.policy.value = env.SerializeToString()
+    return cp
+
+
+@dataclass
+class SignedData:
+    """One signature over a config update: (identity, msg, sig) — the
+    protoutil.SignedData shape (protoutil/signeddata.go:25-31)."""
+
+    identity: bytes
+    data: bytes
+    signature: bytes
+
+
+class PolicyManager:
+    """Named-policy tree over the config group hierarchy.
+
+    ``get("/Channel/Application/Writers")`` resolves exactly like the
+    reference's manager (common/policies/policy.go:132): path segments
+    are group names, the leaf is the policy name in that group.
+    """
+
+    def __init__(self, root_group: configtx_pb2.ConfigGroup, msp_manager: MSPManager):
+        self.root = root_group
+        self.msp = msp_manager
+
+    def _group(self, path: list[str]) -> configtx_pb2.ConfigGroup | None:
+        g = self.root
+        for seg in path:
+            if seg not in g.groups:
+                return None
+            g = g.groups[seg]
+        return g
+
+    def get(self, path: str):
+        """path: '/Channel/App.../Name' (leading '/Channel' optional).
+        → (policy AST | ImplicitMeta, group holding it) or None."""
+        segs = [s for s in path.split("/") if s]
+        if segs and segs[0] == "Channel":
+            segs = segs[1:]
+        if not segs:
+            return None
+        *grp_path, name = segs
+        g = self._group(grp_path)
+        if g is None or name not in g.policies:
+            return None
+        return policy_from_config(g.policies[name]), g
+
+    def evaluate(self, path: str, signed: list[SignedData]) -> bool:
+        got = self.get(path)
+        if got is None:
+            return False
+        rule, group = got
+        return self._eval(rule, group, signed)
+
+    def _eval(self, rule, group: configtx_pb2.ConfigGroup,
+              signed: list[SignedData]) -> bool:
+        if isinstance(rule, ImplicitMeta):
+            sub = rule.sub_policy
+            children = [
+                (policy_from_config(cg.policies[sub]), cg)
+                for cg in group.groups.values()
+                if sub in cg.policies
+            ]
+            n = len(children)
+            if n == 0:
+                return False
+            need = {
+                policies_pb2.ImplicitMetaPolicy.ANY: 1,
+                policies_pb2.ImplicitMetaPolicy.ALL: n,
+                policies_pb2.ImplicitMetaPolicy.MAJORITY: n // 2 + 1,
+            }[rule.rule]
+            got_n = sum(1 for r, g in children if self._eval(r, g, signed))
+            return got_n >= need
+        # signature policy: dedup by identity, verify, consume-evaluate
+        # (SignatureSetToValidIdentities, common/policies/policy.go:360)
+        seen: set[bytes] = set()
+        idents, valid = [], []
+        for sd in signed:
+            if sd.identity in seen:
+                continue
+            seen.add(sd.identity)
+            try:
+                ident = self.msp.deserialize_identity(sd.identity)
+            except Exception:
+                continue
+            idents.append(ident)
+            valid.append(ident.is_valid and ident.verify(sd.data, sd.signature))
+        plan = pol.compile_plan(rule)
+        m = pol.match_matrix(idents, plan.principals)
+        if idents:
+            import numpy as np
+
+            m = m & np.asarray(valid, bool)[:, None]
+        return pol.evaluate(rule, m)
+
+
+# ---------------------------------------------------------------------------
+# Bundle
+
+
+class Bundle:
+    """Immutable view over one channel's Config (channelconfig.Bundle).
+
+    Exposes: policy manager, MSP manager, capabilities, orderer batch
+    parameters, application namespaces' endorsement defaults.
+    """
+
+    def __init__(self, channel_id: str, config: configtx_pb2.Config):
+        self.channel_id = channel_id
+        self.config = config
+        self.msp_manager = self._build_msps(config.channel_group)
+        self.policy_manager = PolicyManager(config.channel_group, self.msp_manager)
+
+    @property
+    def sequence(self) -> int:
+        return self.config.sequence
+
+    @staticmethod
+    def _build_msps(root: configtx_pb2.ConfigGroup) -> MSPManager:
+        mgr = MSPManager()
+        def walk(g: configtx_pb2.ConfigGroup):
+            if "MSP" in g.values:
+                cfg = protoutil.unmarshal(configtx_pb2.MSPConfig, g.values["MSP"].value)
+                mgr.add(MSP.from_proto(cfg))
+            for child in g.groups.values():
+                walk(child)
+        walk(root)
+        return mgr
+
+    def _capabilities(self, group: configtx_pb2.ConfigGroup) -> set[str]:
+        if "Capabilities" not in group.values:
+            return set()
+        caps = protoutil.unmarshal(
+            configtx_pb2.Capabilities, group.values["Capabilities"].value
+        )
+        return set(caps.capabilities)
+
+    def channel_capabilities(self) -> set[str]:
+        return self._capabilities(self.config.channel_group)
+
+    def application_capabilities(self) -> set[str]:
+        app = self.config.channel_group.groups.get("Application")
+        return self._capabilities(app) if app is not None else set()
+
+    def application_orgs(self) -> list[str]:
+        app = self.config.channel_group.groups.get("Application")
+        return sorted(app.groups) if app is not None else []
+
+    def orderer_value(self, name: str, msg_type):
+        ord_grp = self.config.channel_group.groups.get("Orderer")
+        if ord_grp is None or name not in ord_grp.values:
+            return None
+        return protoutil.unmarshal(msg_type, ord_grp.values[name].value)
+
+    def application_policy(self, name: str):
+        got = self.policy_manager.get(f"/Channel/Application/{name}")
+        return got[0] if got else None
+
+    def hash(self) -> bytes:
+        return hashlib.sha256(self.config.SerializeToString()).digest()
+
+
+# ---------------------------------------------------------------------------
+# Config updates (common/configtx/update.go + validator.go)
+
+
+class ConfigUpdateError(Exception):
+    pass
+
+
+def _walk_elements(group: configtx_pb2.ConfigGroup, path: str = ""):
+    """Yield (path, kind, name, element) for every group/value/policy."""
+    for name, g in group.groups.items():
+        yield (path, "group", name, g)
+        yield from _walk_elements(g, f"{path}/{name}")
+    for name, v in group.values.items():
+        yield (path, "value", name, v)
+    for name, p in group.policies.items():
+        yield (path, "policy", name, p)
+
+
+def _find(group: configtx_pb2.ConfigGroup, path: str, kind: str, name: str):
+    g = group
+    for seg in [s for s in path.split("/") if s]:
+        if seg not in g.groups:
+            return None
+        g = g.groups[seg]
+    coll = {"group": g.groups, "value": g.values, "policy": g.policies}[kind]
+    return coll[name] if name in coll else None
+
+
+def authorize_update(bundle: Bundle, update_env: configtx_pb2.ConfigUpdateEnvelope):
+    """Authorize + apply a config update against the current bundle.
+
+    Returns the new Config proto.  Raises ConfigUpdateError on version
+    mismatch or unsatisfied mod_policy.  Semantics per
+    common/configtx/update.go: read-set versions must match current;
+    every write-set element whose version is bumped is 'modified' and
+    its (current) mod_policy must be satisfied by the update's
+    signatures; unmodified write-set elements must carry the current
+    version.
+    """
+    update = protoutil.unmarshal(configtx_pb2.ConfigUpdate, update_env.config_update)
+    if update.channel_id and update.channel_id != bundle.channel_id:
+        raise ConfigUpdateError(
+            f"update for channel {update.channel_id!r} applied to {bundle.channel_id!r}"
+        )
+    current = bundle.config.channel_group
+
+    # read-set: every referenced element must exist at the same version
+    for path, kind, name, elem in _walk_elements(update.read_set):
+        cur = _find(current, path, kind, name)
+        if cur is None or cur.version != elem.version:
+            raise ConfigUpdateError(
+                f"read-set version mismatch at {path}/{name} ({kind})"
+            )
+
+    signed = [
+        SignedData(
+            identity=protoutil.unmarshal(
+                common_pb2.SignatureHeader, cs.signature_header
+            ).creator,
+            data=cs.signature_header + update_env.config_update,
+            signature=cs.signature,
+        )
+        for cs in update_env.signatures
+    ]
+
+    # write-set: detect modifications, enforce mod_policy per element
+    for path, kind, name, elem in _walk_elements(update.write_set):
+        cur = _find(current, path, kind, name)
+        if cur is not None and elem.version == cur.version:
+            if kind != "group" and elem.SerializeToString() != cur.SerializeToString():
+                raise ConfigUpdateError(
+                    f"write-set modifies {path}/{name} without version bump"
+                )
+            continue
+        if cur is not None and elem.version != cur.version + 1:
+            raise ConfigUpdateError(
+                f"write-set version jump at {path}/{name}: "
+                f"{cur.version} → {elem.version}"
+            )
+        if cur is None and elem.version != 0:
+            raise ConfigUpdateError(
+                f"new element {path}/{name} must start at version 0"
+            )
+        # mod_policy source: the existing element, else the nearest
+        # existing ancestor group's mod_policy
+        mod_policy = (cur.mod_policy if cur is not None else "") or _ancestor_mod_policy(
+            current, path
+        )
+        # a GROUP's mod_policy resolves relative to the group ITSELF;
+        # values/policies resolve relative to their containing group
+        # (common/configtx policyForItem semantics)
+        base = f"{path}/{name}" if kind == "group" and cur is not None else path
+        if not _eval_mod_policy(bundle, base, mod_policy, signed):
+            raise ConfigUpdateError(
+                f"mod_policy {mod_policy!r} not satisfied for {path}/{name}"
+            )
+
+    new_config = configtx_pb2.Config()
+    new_config.CopyFrom(bundle.config)
+    new_config.sequence = bundle.config.sequence + 1
+    root_bumped = update.write_set.version > bundle.config.channel_group.version
+    new_config.channel_group.version = update.write_set.version
+    _apply_write_set(
+        new_config.channel_group, update.write_set, version_bumped=root_bumped
+    )
+    return new_config
+
+
+def _ancestor_mod_policy(current: configtx_pb2.ConfigGroup, path: str) -> str:
+    g, mp = current, current.mod_policy
+    for seg in [s for s in path.split("/") if s]:
+        if seg not in g.groups:
+            break
+        g = g.groups[seg]
+        mp = g.mod_policy or mp
+    return mp or "Admins"
+
+
+def _eval_mod_policy(bundle: Bundle, path: str, mod_policy: str,
+                     signed: list[SignedData]) -> bool:
+    """Resolve a mod_policy name relative to its group path, walking up
+    toward the channel root like the reference's manager."""
+    if mod_policy.startswith("/"):
+        return bundle.policy_manager.evaluate(mod_policy, signed)
+    segs = [s for s in path.split("/") if s]
+    for i in range(len(segs), -1, -1):
+        p = "/".join(segs[:i] + [mod_policy])
+        if bundle.policy_manager.get("/" + p) is not None:
+            return bundle.policy_manager.evaluate("/" + p, signed)
+    return False
+
+
+def _apply_write_set(target: configtx_pb2.ConfigGroup,
+                     write: configtx_pb2.ConfigGroup,
+                     version_bumped: bool = False) -> None:
+    """Merge a write set into the current group tree.
+
+    Deletion semantics per the reference's configmap (common/configtx/
+    update.go): when a group's version is BUMPED, the write set defines
+    the group's exact membership — current children absent from the
+    write group are removed.  An unbumped group only overlays the
+    elements it names."""
+    if version_bumped:
+        for name in [n for n in target.groups if n not in write.groups]:
+            del target.groups[name]
+        for name in [n for n in target.values if n not in write.values]:
+            del target.values[name]
+        for name in [n for n in target.policies if n not in write.policies]:
+            del target.policies[name]
+    for name, g in write.groups.items():
+        if name not in target.groups:
+            target.groups[name].CopyFrom(g)
+        else:
+            tgt = target.groups[name]
+            bumped = g.version > tgt.version
+            tgt.version = g.version
+            if g.mod_policy:
+                tgt.mod_policy = g.mod_policy
+            _apply_write_set(tgt, g, version_bumped=bumped)
+    for name, v in write.values.items():
+        target.values[name].CopyFrom(v)
+    for name, p in write.policies.items():
+        target.policies[name].CopyFrom(p)
+
+
+# ---------------------------------------------------------------------------
+# Config-tx processing on the commit path (v20/validator.go:397-419)
+
+
+class ConfigTxProcessor:
+    """Holds the live bundle for one channel; validates CONFIG
+    envelopes on the commit path and applies them on commit.
+
+    The validator calls ``validate_config_tx``; the peer channel calls
+    ``apply(cfg_env)`` after the block commits (core/peer/peer.go
+    BundleSource update semantics).
+    """
+
+    def __init__(self, bundle: Bundle):
+        self.bundle = bundle
+        self.listeners: list = []
+
+    def validate_config_tx(self, ptx, cfg_env: configtx_pb2.ConfigEnvelope) -> int:
+        from fabric_tpu.protos import transaction_pb2
+
+        C = transaction_pb2.TxValidationCode
+        try:
+            proposed = self._authorized_config(cfg_env)
+        except (ConfigUpdateError, Exception):
+            return C.INVALID_OTHER_REASON
+        if proposed.SerializeToString() != cfg_env.config.SerializeToString():
+            return C.INVALID_OTHER_REASON
+        return C.VALID
+
+    def _authorized_config(self, cfg_env: configtx_pb2.ConfigEnvelope):
+        if not cfg_env.HasField("last_update"):
+            raise ConfigUpdateError("config envelope missing last_update")
+        payload = protoutil.unmarshal(
+            common_pb2.Payload, cfg_env.last_update.payload
+        )
+        upd_env = protoutil.unmarshal(
+            configtx_pb2.ConfigUpdateEnvelope, payload.data
+        )
+        return authorize_update(self.bundle, upd_env)
+
+    def apply(self, cfg_env: configtx_pb2.ConfigEnvelope) -> Bundle:
+        new = Bundle(self.bundle.channel_id, cfg_env.config)
+        self.bundle = new
+        for fn in self.listeners:
+            fn(new)
+        return new

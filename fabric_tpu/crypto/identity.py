@@ -89,9 +89,20 @@ class Identity:
         return (int.from_bytes(hashlib.sha256(message).digest(), "big"), r, s, qx, qy)
 
     def verify(self, message: bytes, der_sig: bytes) -> bool:
-        """Host fallback verify (exact reference accept set)."""
-        e, r, s, qx, qy = self.verify_item(message, der_sig)
-        return ec_ref.verify_digest((qx, qy), e, r, s)
+        """Host verify via OpenSSL (the reference's SW-BCCSP speed
+        class) with the exact reference accept set: low-S enforced on
+        top of the raw curve check (bccsp/sw/ecdsa.go:41-58)."""
+        try:
+            r, s = decode_dss_signature(der_sig)
+        except Exception:
+            return False
+        if not (0 < r < ec_ref.N and 0 < s <= ec_ref.HALF_N):
+            return False
+        try:
+            self.cert.public_key().verify(der_sig, message, ec.ECDSA(hashes.SHA256()))
+            return True
+        except Exception:
+            return False
 
 
 class SigningIdentity:

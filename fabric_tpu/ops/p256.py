@@ -401,12 +401,12 @@ def digest_words_to_limbs(words):
 
 MIN_BUCKET = 16
 
-# the MXU-first kernel (ops.p256v2: RCB complete formulas over the
-# signed-digit field core) is the default; set FABRIC_TPU_P256=v1 to
-# fall back to this module's Montgomery-limb ladder for comparison
+# kernel selection: v3 (RNS/Cox-Rower, ops.p256v3) is the default;
+# FABRIC_TPU_P256=v2 selects the signed-digit MXU kernel (ops.p256v2),
+# =v1 this module's Montgomery-limb ladder — kept for comparison
 import os as _os
 
-_USE_V2 = _os.environ.get("FABRIC_TPU_P256", "v2") != "v1"
+_KERNEL = _os.environ.get("FABRIC_TPU_P256", "v3")
 
 
 def verify_host(items) -> list[bool]:
@@ -420,10 +420,16 @@ def verify_host(items) -> list[bool]:
     items = list(items)
     if not items:
         return []
-    if _USE_V2:
+    if _KERNEL == "v2":
         from fabric_tpu.ops import p256v2
 
         return p256v2.verify_host(items)
+    if _KERNEL != "v1":
+        # v3 is the default; unknown values must not silently fall
+        # back to the slow comparison ladder
+        from fabric_tpu.ops import p256v3
+
+        return p256v3.verify_host(items)
     n = len(items)
     bsz = max(MIN_BUCKET, next_pow2(n))
     pad = [(0, 0, 0, 0, 0)] * (bsz - n)

@@ -66,9 +66,51 @@ class PeerChannel:
                 None, self.validator.validate, block
             )
             self.ledger.commit_block(block, flt, batch, history)
+            self._post_commit(block, flt, batch)
         self._height_changed.set()
         self._height_changed = asyncio.Event()
         return flt
+
+    def _post_commit(self, block, flt: bytes, batch) -> None:
+        """Post-commit bookkeeping: lifecycle-cache invalidation when
+        the block wrote ``_lifecycle`` (lifecycle.Cache StateListener
+        analog) and channel-config bundle rotation for committed CONFIG
+        txs (BundleSource update, core/peer/peer.go).
+
+        Uses the validator's already-parsed tx records — normal blocks
+        cost zero extra parsing.  A failure to APPLY a committed config
+        is a serious divergence and must be loud, not swallowed."""
+        pol_provider = self.validator.policies
+        if hasattr(pol_provider, "on_block_committed"):
+            pol_provider.on_block_committed(batch)
+        proc = self.validator.config_processor
+        if proc is None or not hasattr(proc, "apply"):
+            return
+        from fabric_tpu.protos import configtx_pb2, transaction_pb2
+
+        for ptx in getattr(self.validator, "last_parsed", ()):
+            if not ptx.is_config or flt[ptx.idx] != transaction_pb2.TxValidationCode.VALID:
+                continue
+            try:
+                env = protoutil.unmarshal(
+                    common_pb2.Envelope, block.data.data[ptx.idx]
+                )
+                payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+                cfg_env = protoutil.unmarshal(
+                    configtx_pb2.ConfigEnvelope, payload.data
+                )
+            except Exception:
+                continue  # malformed yet VALID can only be genesis noise
+            try:
+                proc.apply(cfg_env)
+            except Exception:
+                import logging
+
+                logging.getLogger("fabric_tpu.peer").exception(
+                    "%s: committed CONFIG tx %d of block %d failed to "
+                    "apply — bundle is now STALE relative to the ledger",
+                    self.id, ptx.idx, block.header.number,
+                )
 
     async def run_deliver(self, orderer_addr: tuple[str, int]):
         """Pull blocks from the orderer starting at our height and

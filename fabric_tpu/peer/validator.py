@@ -170,6 +170,8 @@ class BlockValidator:
                 # batch; structure + policy checks happen in
                 # _validate_config after phase 1a.
                 ptx.is_config = True
+                if block.header.number == 0:
+                    continue  # genesis: trust anchor, no creator check
                 try:
                     ident = self.msp.deserialize_identity(sh.creator)
                     if not ident.is_valid:
@@ -252,6 +254,9 @@ class BlockValidator:
 
     def validate(self, block: common_pb2.Block):
         txs, items = self._parse(block)
+        # parsed records for post-commit consumers (config rotation) —
+        # the commit path is serialized per channel, so this is safe
+        self.last_parsed = txs
 
         # phase 1a: one batched ECDSA verify for the whole block
         sig_valid = np.asarray(p256.verify_host(items), bool) if items else np.zeros(0, bool)
@@ -383,6 +388,10 @@ class BlockValidator:
             cfg_env = protoutil.unmarshal(configtx_pb2.ConfigEnvelope, payload.data)
         except Exception:
             return C.BAD_PAYLOAD
+        if block.header.number == 0:
+            # genesis config is the channel's trust anchor — verified
+            # out-of-band by the joining admin, not by prior state
+            return C.VALID
         if self.config_processor is not None:
             try:
                 return self.config_processor.validate_config_tx(ptx, cfg_env)

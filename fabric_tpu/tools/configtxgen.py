@@ -1,0 +1,284 @@
+"""configtxgen analog: profiles → genesis config blocks + channel
+creation / config-update envelopes.
+
+Reference: internal/configtxgen (profiles from configtx.yaml →
+``OutputBlock``), common/configtx (update computation).  Here the
+profile is a Python dataclass rather than YAML — the framework is a
+library first; the CLI wrapper lives in fabric_tpu/cli.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from fabric_tpu import protoutil
+from fabric_tpu.channelconfig import CAP_V2_0, ImplicitMeta, config_policy
+from fabric_tpu.crypto import policy as pol
+from fabric_tpu.protos import common_pb2, configtx_pb2, orderer_pb2, policies_pb2
+
+IM = policies_pb2.ImplicitMetaPolicy
+
+
+@dataclass
+class OrgProfile:
+    msp_id: str
+    msp: object  # crypto.msp.MSP
+    anchor_peers: list = field(default_factory=list)  # (host, port)
+
+
+@dataclass
+class Profile:
+    """One channel's genesis profile (a configtx.yaml profile)."""
+
+    channel_id: str
+    application_orgs: list = field(default_factory=list)  # [OrgProfile]
+    orderer_orgs: list = field(default_factory=list)
+    consensus_type: str = "raft"
+    raft_consenters: list = field(default_factory=list)  # [(host, port)]
+    max_message_count: int = 500
+    preferred_max_bytes: int = 2 * 1024 * 1024
+    absolute_max_bytes: int = 10 * 1024 * 1024
+    batch_timeout_ms: int = 200
+    capabilities: tuple = (CAP_V2_0,)
+
+
+def _org_group(org: OrgProfile) -> configtx_pb2.ConfigGroup:
+    g = configtx_pb2.ConfigGroup(mod_policy="Admins")
+    g.values["MSP"].value = org.msp.to_proto().SerializeToString()
+    g.values["MSP"].mod_policy = "Admins"
+    mid = org.msp_id
+    member = pol.SignedBy(pol.Principal(mid, pol.ROLE_MEMBER))
+    admin = pol.SignedBy(pol.Principal(mid, pol.ROLE_ADMIN))
+    peer = pol.SignedBy(pol.Principal(mid, pol.ROLE_PEER))
+    g.policies["Readers"].CopyFrom(config_policy(member))
+    g.policies["Writers"].CopyFrom(config_policy(member))
+    g.policies["Admins"].CopyFrom(config_policy(admin))
+    g.policies["Endorsement"].CopyFrom(config_policy(peer))
+    if org.anchor_peers:
+        ap = configtx_pb2.AnchorPeers()
+        for host, port in org.anchor_peers:
+            ap.anchor_peers.add(host=host, port=port)
+        g.values["AnchorPeers"].value = ap.SerializeToString()
+        g.values["AnchorPeers"].mod_policy = "Admins"
+    return g
+
+
+def _implicit(rule: int, sub: str) -> configtx_pb2.ConfigPolicy:
+    return config_policy(ImplicitMeta(rule=rule, sub_policy=sub))
+
+
+def genesis_config(profile: Profile) -> configtx_pb2.Config:
+    root = configtx_pb2.ConfigGroup(mod_policy="Admins")
+    caps = configtx_pb2.Capabilities()
+    for c in profile.capabilities:
+        caps.capabilities[c].SetInParent()
+    root.values["Capabilities"].value = caps.SerializeToString()
+    root.values["Capabilities"].mod_policy = "Admins"
+    root.values["HashingAlgorithm"].value = configtx_pb2.HashingAlgorithm(
+        name="SHA256"
+    ).SerializeToString()
+    root.values["BlockDataHashingStructure"].value = (
+        configtx_pb2.BlockDataHashingStructure(width=0xFFFFFFFF).SerializeToString()
+    )
+    for name, rule, sub in (
+        ("Readers", IM.ANY, "Readers"),
+        ("Writers", IM.ANY, "Writers"),
+        ("Admins", IM.MAJORITY, "Admins"),
+    ):
+        root.policies[name].CopyFrom(_implicit(rule, sub))
+
+    app = root.groups["Application"]
+    app.mod_policy = "Admins"
+    app.values["Capabilities"].value = caps.SerializeToString()
+    app.values["Capabilities"].mod_policy = "Admins"
+    for name, rule, sub in (
+        ("Readers", IM.ANY, "Readers"),
+        ("Writers", IM.ANY, "Writers"),
+        ("Admins", IM.MAJORITY, "Admins"),
+        ("Endorsement", IM.MAJORITY, "Endorsement"),
+        ("LifecycleEndorsement", IM.MAJORITY, "Endorsement"),
+    ):
+        app.policies[name].CopyFrom(_implicit(rule, sub))
+    for org in profile.application_orgs:
+        app.groups[org.msp_id].CopyFrom(_org_group(org))
+
+    ordg = root.groups["Orderer"]
+    ordg.mod_policy = "Admins"
+    ordg.values["ConsensusType"].value = orderer_pb2.ConsensusType(
+        type=profile.consensus_type,
+        metadata=orderer_pb2.RaftConfigMetadata(
+            consenters=[
+                orderer_pb2.RaftConsenter(host=h, port=p)
+                for h, p in profile.raft_consenters
+            ]
+        ).SerializeToString(),
+    ).SerializeToString()
+    ordg.values["BatchSize"].value = orderer_pb2.BatchSize(
+        max_message_count=profile.max_message_count,
+        preferred_max_bytes=profile.preferred_max_bytes,
+        absolute_max_bytes=profile.absolute_max_bytes,
+    ).SerializeToString()
+    ordg.values["BatchTimeout"].value = orderer_pb2.BatchTimeout(
+        timeout=f"{profile.batch_timeout_ms}ms"
+    ).SerializeToString()
+    for name, rule, sub in (
+        ("Readers", IM.ANY, "Readers"),
+        ("Writers", IM.ANY, "Writers"),
+        ("Admins", IM.MAJORITY, "Admins"),
+        ("BlockValidation", IM.ANY, "Writers"),
+    ):
+        ordg.policies[name].CopyFrom(_implicit(rule, sub))
+    for org in profile.orderer_orgs:
+        ordg.groups[org.msp_id].CopyFrom(_org_group(org))
+
+    return configtx_pb2.Config(sequence=0, channel_group=root)
+
+
+def genesis_block(profile: Profile) -> common_pb2.Block:
+    """Block 0: a CONFIG envelope holding the genesis ConfigEnvelope."""
+    config = genesis_config(profile)
+    cfg_env = configtx_pb2.ConfigEnvelope(config=config)
+    ch = protoutil.make_channel_header(
+        common_pb2.HeaderType.CONFIG, profile.channel_id, tx_id=""
+    )
+    sh = protoutil.make_signature_header(b"", protoutil.random_nonce())
+    payload = protoutil.make_payload(ch, sh, cfg_env.SerializeToString())
+    env = common_pb2.Envelope(payload=payload.SerializeToString())
+    blk = protoutil.new_block(0, b"")
+    blk.data.data.append(env.SerializeToString())
+    return protoutil.finalize_block(blk)
+
+
+# ---------------------------------------------------------------------------
+# Config updates
+
+
+def compute_update(channel_id: str, current: configtx_pb2.Config,
+                   updated: configtx_pb2.Config) -> configtx_pb2.ConfigUpdate:
+    """Minimal read/write-set delta between two configs (the
+    configtxlator compute-update analog): read_set references every
+    group on the path to a change at its current version; write_set
+    carries changed elements with bumped versions."""
+    upd = configtx_pb2.ConfigUpdate(channel_id=channel_id)
+
+    def diff(cur: configtx_pb2.ConfigGroup, new: configtx_pb2.ConfigGroup,
+             rd: configtx_pb2.ConfigGroup, wr: configtx_pb2.ConfigGroup) -> bool:
+        changed = False
+        rd.version = cur.version
+        wr.version = cur.version
+        wr.mod_policy = new.mod_policy
+        # deletions: a removed child means this group's version bumps
+        # and the write set lists the EXACT surviving membership
+        # (authorize_update applies bumped groups as exact-membership,
+        # common/configtx/update.go configmap semantics)
+        deleted = (
+            (set(cur.groups) - set(new.groups))
+            | (set(cur.values) - set(new.values))
+            | (set(cur.policies) - set(new.policies))
+        )
+        if deleted:
+            changed = True
+            wr.version = cur.version + 1
+            for name, ng in new.groups.items():
+                if name in cur.groups:
+                    wr.groups[name].CopyFrom(ng)
+                    wr.groups[name].version = cur.groups[name].version
+            for name, nv in new.values.items():
+                if name in cur.values:
+                    wr.values[name].CopyFrom(nv)
+                    wr.values[name].version = cur.values[name].version
+            for name, np2 in new.policies.items():
+                if name in cur.policies:
+                    wr.policies[name].CopyFrom(np2)
+                    wr.policies[name].version = cur.policies[name].version
+        for name, ng in new.groups.items():
+            if name in cur.groups:
+                sub_changed = diff(cur.groups[name], ng,
+                                   rd.groups[name], wr.groups[name])
+                if not sub_changed:
+                    del rd.groups[name]
+                    # with deletions, unchanged siblings stay in the
+                    # write set — bumped groups are exact-membership
+                    if not deleted:
+                        del wr.groups[name]
+                changed |= sub_changed
+            else:
+                wr.groups[name].CopyFrom(ng)
+                wr.groups[name].version = 0
+                changed = True
+        for name, nv in new.values.items():
+            cv = cur.values.get(name)
+            if cv is None:
+                wr.values[name].CopyFrom(nv)
+                wr.values[name].version = 0
+                changed = True
+            elif cv.value != nv.value or cv.mod_policy != nv.mod_policy:
+                wr.values[name].CopyFrom(nv)
+                wr.values[name].version = cv.version + 1
+                changed = True
+        for name, np_ in new.policies.items():
+            cp = cur.policies.get(name)
+            if cp is None:
+                wr.policies[name].CopyFrom(np_)
+                wr.policies[name].version = 0
+                changed = True
+            elif cp.SerializeToString() != np_.SerializeToString():
+                wr.policies[name].CopyFrom(np_)
+                wr.policies[name].version = cp.version + 1
+                changed = True
+        return changed
+
+    diff(current.channel_group, updated.channel_group,
+         upd.read_set, upd.write_set)
+    return upd
+
+
+def sign_update(update: configtx_pb2.ConfigUpdate,
+                signers) -> configtx_pb2.ConfigUpdateEnvelope:
+    """Wrap + sign: each signer adds a ConfigSignature over
+    signature_header ‖ config_update."""
+    env = configtx_pb2.ConfigUpdateEnvelope(
+        config_update=update.SerializeToString()
+    )
+    for signer in signers:
+        sh = protoutil.make_signature_header(
+            signer.serialized, protoutil.random_nonce()
+        ).SerializeToString()
+        env.signatures.add(
+            signature_header=sh,
+            signature=signer.sign(sh + env.config_update),
+        )
+    return env
+
+
+def config_tx(channel_id: str, new_config: configtx_pb2.Config,
+              update_env: configtx_pb2.ConfigUpdateEnvelope,
+              signer=None) -> common_pb2.Envelope:
+    """A CONFIG envelope carrying ConfigEnvelope{config, last_update}
+    — what the orderer emits after processing a config update."""
+    upd_payload = protoutil.make_payload(
+        protoutil.make_channel_header(
+            common_pb2.HeaderType.CONFIG_UPDATE, channel_id, tx_id=""
+        ),
+        protoutil.make_signature_header(
+            signer.serialized if signer else b"",
+            protoutil.random_nonce(),
+        ),
+        update_env.SerializeToString(),
+    )
+    last_update = common_pb2.Envelope(payload=upd_payload.SerializeToString())
+    if signer is not None:
+        last_update.signature = signer.sign(last_update.payload)
+    cfg_env = configtx_pb2.ConfigEnvelope(config=new_config, last_update=last_update)
+
+    nonce = protoutil.random_nonce()
+    creator = signer.serialized if signer else b""
+    ch = protoutil.make_channel_header(
+        common_pb2.HeaderType.CONFIG, channel_id,
+        tx_id=protoutil.compute_tx_id(nonce, creator),
+    )
+    sh = protoutil.make_signature_header(creator, nonce)
+    payload = protoutil.make_payload(ch, sh, cfg_env.SerializeToString())
+    if signer is not None:
+        return protoutil.sign_envelope(payload, signer)
+    return common_pb2.Envelope(payload=payload.SerializeToString())

@@ -1,0 +1,178 @@
+"""Channel configuration tests: bundle construction, implicit-meta
+policy evaluation, config-update authorization, and config-tx
+validation on the commit path (reference: common/channelconfig,
+common/policies/implicitmeta.go, common/configtx/update.go,
+v20/validator.go:397-419)."""
+
+import pytest
+
+from fabric_tpu import channelconfig as cc
+from fabric_tpu import protoutil as pu
+from fabric_tpu.crypto import cryptogen
+from fabric_tpu.crypto import policy as pol
+from fabric_tpu.protos import common_pb2, configtx_pb2, policies_pb2, transaction_pb2
+from fabric_tpu.tools import configtxgen as cg
+
+C = transaction_pb2.TxValidationCode
+CHANNEL = "confchan"
+
+
+@pytest.fixture(scope="module")
+def orgs():
+    return [
+        cryptogen.generate_org(f"Org{i}MSP", f"org{i}.example.com", peers=1)
+        for i in (1, 2, 3)
+    ]
+
+
+@pytest.fixture(scope="module")
+def profile(orgs):
+    return cg.Profile(
+        CHANNEL,
+        application_orgs=[cg.OrgProfile(o.msp_id, o.msp()) for o in orgs],
+    )
+
+
+@pytest.fixture(scope="module")
+def bundle(profile):
+    return cc.Bundle(CHANNEL, cg.genesis_config(profile))
+
+
+def _admin(org):
+    return cryptogen.signing_identity(org, f"Admin@{org.domain}")
+
+
+def _signed(signer, msg: bytes) -> cc.SignedData:
+    return cc.SignedData(
+        identity=signer.serialized, data=msg, signature=signer.sign(msg)
+    )
+
+
+def test_bundle_surface(bundle, orgs):
+    assert bundle.application_orgs() == ["Org1MSP", "Org2MSP", "Org3MSP"]
+    assert cc.CAP_V2_0 in bundle.application_capabilities()
+    assert cc.CAP_V2_0 in bundle.channel_capabilities()
+    endorsement = bundle.application_policy("Endorsement")
+    assert isinstance(endorsement, cc.ImplicitMeta)
+    # the MSPs inside the bundle can deserialize + validate org identities
+    ident = bundle.msp_manager.deserialize_identity(_admin(orgs[0]).serialized)
+    assert ident.is_valid and ident.role == "admin"
+
+
+def test_implicit_meta_majority(bundle, orgs):
+    msg = b"payload-to-sign"
+    admins = [_admin(o) for o in orgs]
+    two = [_signed(s, msg) for s in admins[:2]]
+    one = [_signed(admins[0], msg)]
+    three = [_signed(s, msg) for s in admins]
+    # /Channel/Application/Admins is MAJORITY(Admins) over 3 orgs → need 2
+    assert bundle.policy_manager.evaluate("/Channel/Application/Admins", two)
+    assert bundle.policy_manager.evaluate("/Channel/Application/Admins", three)
+    assert not bundle.policy_manager.evaluate("/Channel/Application/Admins", one)
+    # ANY(Writers): one member suffices
+    assert bundle.policy_manager.evaluate("/Channel/Application/Writers", one)
+    # a repeated signature does not double-count toward MAJORITY
+    dup = [two[0], two[0]]
+    assert not bundle.policy_manager.evaluate("/Channel/Application/Admins", dup)
+
+
+def test_implicit_meta_rejects_bad_signature(bundle, orgs):
+    msg = b"payload"
+    sd = _signed(_admin(orgs[0]), msg)
+    bad = cc.SignedData(sd.identity, msg, sd.signature[:-2] + b"\x00\x00")
+    assert not bundle.policy_manager.evaluate("/Channel/Application/Writers", [bad])
+
+
+def _updated_config(profile, bundle):
+    """Flip Org1's Endorsement policy to admin-only (a realistic
+    policy-rotation update)."""
+    new = configtx_pb2.Config()
+    new.CopyFrom(bundle.config)
+    org1 = new.channel_group.groups["Application"].groups["Org1MSP"]
+    org1.policies["Endorsement"].CopyFrom(
+        cc.config_policy(pol.SignedBy(pol.Principal("Org1MSP", pol.ROLE_ADMIN)))
+    )
+    return new
+
+
+def test_config_update_flow(profile, bundle, orgs):
+    new = _updated_config(profile, bundle)
+    upd = cg.compute_update(CHANNEL, bundle.config, new)
+    # modified element: Org1MSP Endorsement policy (mod_policy Admins →
+    # Org1 admin alone controls its own org group)
+    signed = cg.sign_update(upd, [_admin(orgs[0])])
+    got = cc.authorize_update(bundle, signed)
+    assert got.sequence == bundle.sequence + 1
+    after = cc.Bundle(CHANNEL, got)
+    assert isinstance(
+        after.policy_manager.get("/Channel/Application/Org1MSP/Endorsement")[0],
+        pol.SignedBy,
+    )
+
+    # unsigned: rejected
+    unsigned = cg.sign_update(upd, [])
+    with pytest.raises(cc.ConfigUpdateError):
+        cc.authorize_update(bundle, unsigned)
+
+    # wrong org's admin: rejected (mod_policy resolves to Org1 Admins)
+    wrong = cg.sign_update(upd, [_admin(orgs[1])])
+    with pytest.raises(cc.ConfigUpdateError):
+        cc.authorize_update(bundle, wrong)
+
+
+def test_config_update_version_discipline(bundle, orgs):
+    new = _updated_config(None, bundle)
+    upd = cg.compute_update(CHANNEL, bundle.config, new)
+    # tamper: claim a version jump
+    wr = upd.write_set.groups["Application"].groups["Org1MSP"]
+    wr.policies["Endorsement"].version = 7
+    signed = cg.sign_update(upd, [_admin(orgs[0])])
+    with pytest.raises(cc.ConfigUpdateError):
+        cc.authorize_update(bundle, signed)
+
+
+def test_config_tx_processor(bundle, orgs):
+    proc = cc.ConfigTxProcessor(bundle)
+    new = _updated_config(None, bundle)
+    upd = cg.compute_update(CHANNEL, bundle.config, new)
+    new_applied = cc.authorize_update(bundle, cg.sign_update(upd, [_admin(orgs[0])]))
+    env = cg.config_tx(
+        CHANNEL, new_applied, cg.sign_update(upd, [_admin(orgs[0])]),
+        signer=_admin(orgs[0]),
+    )
+    payload = pu.unmarshal(common_pb2.Payload, env.payload)
+    cfg_env = pu.unmarshal(configtx_pb2.ConfigEnvelope, payload.data)
+    assert proc.validate_config_tx(None, cfg_env) == C.VALID
+
+    # a config whose content does not match its authorized update: rejected
+    forged = configtx_pb2.ConfigEnvelope()
+    forged.CopyFrom(cfg_env)
+    forged.config.channel_group.values["Capabilities"].value = b"\x01"
+    assert proc.validate_config_tx(None, forged) != C.VALID
+
+    # apply rotates the bundle and bumps the sequence
+    seen = []
+    proc.listeners.append(lambda b: seen.append(b.sequence))
+    proc.apply(cfg_env)
+    assert proc.bundle.sequence == 1 and seen == [1]
+
+
+def test_config_update_deletion(profile, bundle, orgs):
+    """Removing an org: the write set bumps the parent group and lists
+    exact surviving membership; apply deletes the org and the deletion
+    is gated on the parent's mod_policy (MAJORITY Admins)."""
+    new = configtx_pb2.Config()
+    new.CopyFrom(bundle.config)
+    del new.channel_group.groups["Application"].groups["Org3MSP"]
+    upd = cg.compute_update(CHANNEL, bundle.config, new)
+    admins = [_admin(o) for o in orgs]
+
+    # one admin is not a majority of /Channel/Application/Admins
+    with pytest.raises(cc.ConfigUpdateError):
+        cc.authorize_update(bundle, cg.sign_update(upd, [admins[0]]))
+
+    got = cc.authorize_update(bundle, cg.sign_update(upd, admins[:2]))
+    after = cc.Bundle(CHANNEL, got)
+    assert after.application_orgs() == ["Org1MSP", "Org2MSP"]
+    # surviving orgs' policies still resolve
+    assert after.policy_manager.get("/Channel/Application/Org1MSP/Admins")

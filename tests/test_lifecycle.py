@@ -1,0 +1,209 @@
+"""Chaincode lifecycle tests: approve/commit state machine, the
+state-backed policy provider, and the VERDICT gate — changing a
+chaincode's policy via a committed transaction changes validation
+behavior (reference: core/chaincode/lifecycle,
+plugindispatcher/dispatcher.go:266 GetInfoForValidate)."""
+
+import pytest
+
+from fabric_tpu import protoutil as pu
+from fabric_tpu.crypto import cryptogen
+from fabric_tpu.crypto import policy as pol
+from fabric_tpu.crypto.msp import MSPManager
+from fabric_tpu.ledger.rwset import TxRWSet
+from fabric_tpu.ledger.statedb import MemVersionedDB, UpdateBatch
+from fabric_tpu.peer import lifecycle as lc
+from fabric_tpu.peer import txassembly as txa
+from fabric_tpu.peer.chaincode import ChaincodeRuntime
+from fabric_tpu.peer.simulator import TxSimulator
+from fabric_tpu.peer.validator import BlockValidator, NamespaceInfo
+from fabric_tpu.protos import transaction_pb2
+
+C = transaction_pb2.TxValidationCode
+CHANNEL = "lcchan"
+CC = "mycc"
+ORGS = ["Org1MSP", "Org2MSP", "Org3MSP"]
+
+
+@pytest.fixture(scope="module")
+def net():
+    orgs = {
+        f"Org{i}MSP": cryptogen.generate_org(
+            f"Org{i}MSP", f"org{i}.example.com", peers=1, users=1
+        )
+        for i in (1, 2, 3)
+    }
+    mgr = MSPManager({k: o.msp() for k, o in orgs.items()})
+    return {
+        "orgs": orgs,
+        "mgr": mgr,
+        "client": cryptogen.signing_identity(
+            orgs["Org1MSP"], "User1@org1.example.com"
+        ),
+        "peers": {
+            k: cryptogen.signing_identity(o, f"peer0.org{i}.example.com")
+            for i, (k, o) in enumerate(orgs.items(), start=1)
+        },
+    }
+
+
+def _runtime():
+    rt = ChaincodeRuntime()
+    rt.register(lc.LIFECYCLE_NS, lc.LifecycleContract(org_lister=lambda: ORGS))
+    return rt
+
+
+def _invoke(rt, state, args, creator=b""):
+    sim = TxSimulator(state)
+    resp = rt.execute(sim, lc.LIFECYCLE_NS, args, creator=creator)
+    return resp, sim
+
+
+def _creator(net, org):
+    return net["peers"][org].serialized
+
+
+def _apply(state, sim, height):
+    rw, _ = sim.done()
+    tx = TxRWSet.from_bytes(rw)
+    batch = UpdateBatch()
+    for ns_name, n in tx.ns.items():
+        for k, v in n.writes.items():
+            batch.put(ns_name, k, v, (height, 0))
+    state.apply_updates(batch, (height, 0))
+    return batch
+
+
+def test_approve_then_commit(net):
+    state = MemVersionedDB()
+    rt = _runtime()
+    spec = b'{"policy": {"ref": "Endorsement"}}'
+
+    # commit without approvals: fails
+    resp, _ = _invoke(rt, state, [b"commit", CC.encode(), b"1", spec],
+                      creator=_creator(net, "Org1MSP"))
+    assert resp.status == 500 and "insufficient" in resp.message
+
+    # two of three orgs approve → committable
+    for h, org in enumerate(("Org1MSP", "Org2MSP"), start=1):
+        resp, sim = _invoke(rt, state, [b"approve", CC.encode(), b"1", spec],
+                            creator=_creator(net, org))
+        assert resp.status == 200, resp.message
+        _apply(state, sim, h)
+
+    resp, _ = _invoke(rt, state, [b"checkcommitreadiness", CC.encode(), b"1", spec])
+    import json
+    ready = json.loads(resp.payload)
+    assert ready == {"Org1MSP": True, "Org2MSP": True, "Org3MSP": False}
+
+    resp, sim = _invoke(rt, state, [b"commit", CC.encode(), b"1", spec],
+                        creator=_creator(net, "Org1MSP"))
+    assert resp.status == 200, resp.message
+    _apply(state, sim, 3)
+
+    resp, _ = _invoke(rt, state, [b"querydef", CC.encode()])
+    cd = lc.ChaincodeDefinition.from_bytes(resp.payload)
+    assert cd.sequence == 1 and cd.policy == {"ref": "Endorsement"}
+
+    # sequence discipline: re-commit of seq 1 and skip to 3 both fail
+    for seq in (b"1", b"3"):
+        resp, _ = _invoke(rt, state, [b"commit", CC.encode(), seq, spec],
+                          creator=_creator(net, "Org1MSP"))
+        assert resp.status == 500
+
+    # approval at a mismatched spec does not count
+    other = b'{"policy": {"ref": "Admins"}}'
+    resp, sim = _invoke(rt, state, [b"approve", CC.encode(), b"2", other],
+                        creator=_creator(net, "Org3MSP"))
+    _apply(state, sim, 4)
+    resp, _ = _invoke(rt, state, [b"commit", CC.encode(), b"2", spec],
+                      creator=_creator(net, "Org1MSP"))
+    assert resp.status == 500
+
+
+def _committed_def_state(policy_ast, plugin="default", seq=1):
+    """State DB holding one committed definition for CC."""
+    state = MemVersionedDB()
+    cd = lc.ChaincodeDefinition(
+        name=CC, sequence=seq, plugin=plugin,
+        policy=lc.policy_spec_from_ast(policy_ast),
+    )
+    b = UpdateBatch()
+    b.put(lc.LIFECYCLE_NS, lc.definition_key(CC), cd.to_bytes(), (1, 0))
+    state.apply_updates(b, (1, 0))
+    return state
+
+
+def test_provider_reads_committed_state(net):
+    ast = pol.from_dsl("AND('Org1MSP.peer', 'Org2MSP.peer')")
+    state = _committed_def_state(ast)
+    prov = lc.LifecyclePolicyProvider(state)
+    info = prov.info(CC)
+    assert info is not None and info.policy == ast
+    assert prov.info("unknown-ns") is None
+
+    # ref resolution through a channel-config-backed resolver
+    refs = {"Endorsement": pol.from_dsl("OutOf(2,'Org1MSP.peer','Org2MSP.peer','Org3MSP.peer')")}
+    prov2 = lc.LifecyclePolicyProvider(state, ref_resolver=refs.get)
+    assert prov2.info(lc.LIFECYCLE_NS) is None  # no LifecycleEndorsement ref
+    refs["LifecycleEndorsement"] = refs["Endorsement"]
+    prov3 = lc.LifecyclePolicyProvider(state, ref_resolver=refs.get)
+    assert prov3.info(lc.LIFECYCLE_NS).policy == refs["Endorsement"]
+
+
+def _tx(net, endorsers, writes, ns=CC, signer=None):
+    signer = signer or net["client"]
+    signed, tx_id, prop = txa.create_signed_proposal(signer, CHANNEL, ns, [b"invoke"])
+    tx = TxRWSet()
+    n = tx.ns_rwset(ns)
+    for k, v in writes:
+        n.writes[k] = v
+    rw = tx.to_proto().SerializeToString()
+    responses = [txa.create_proposal_response(prop, rw, e, ns) for e in endorsers]
+    return txa.assemble_transaction(prop, responses, signer)
+
+
+def _block(envs, num):
+    blk = pu.new_block(num, b"prev")
+    for env in envs:
+        blk.data.data.append(env.SerializeToString())
+    return pu.finalize_block(blk)
+
+
+def test_committed_policy_change_changes_validation(net):
+    """The VERDICT gate: rotating CC's policy via a committed
+    ``_lifecycle`` write flips a previously-valid endorsement set to
+    ENDORSEMENT_POLICY_FAILURE on the very next block."""
+    org1_only = pol.from_dsl("AND('Org1MSP.peer')")
+    both = pol.from_dsl("AND('Org1MSP.peer', 'Org2MSP.peer')")
+    lifecycle_pol = pol.from_dsl("OutOf(1,'Org1MSP.peer','Org2MSP.peer')")
+
+    state = _committed_def_state(org1_only)
+    prov = lc.LifecyclePolicyProvider(state, lifecycle_policy=lifecycle_pol)
+    v = BlockValidator(net["mgr"], prov, state)
+
+    p1, p2 = net["peers"]["Org1MSP"], net["peers"]["Org2MSP"]
+
+    # block 2: Org1-only endorsement is VALID under the current policy
+    env1 = _tx(net, [p1], [("k", b"v1")])
+    flt, batch, _ = v.validate(_block([env1], 2))
+    assert list(flt) == [C.VALID]
+    state.apply_updates(batch, (2, 0))
+    prov.on_block_committed(batch)
+
+    # block 3: a _lifecycle tx rotates the policy to AND(Org1, Org2)
+    cd = lc.ChaincodeDefinition(
+        name=CC, sequence=2, policy=lc.policy_spec_from_ast(both)
+    )
+    env_lc = _tx(net, [p1], [(lc.definition_key(CC), cd.to_bytes())],
+                 ns=lc.LIFECYCLE_NS)
+    flt, batch, _ = v.validate(_block([env_lc], 3))
+    assert list(flt) == [C.VALID]
+    state.apply_updates(batch, (3, 0))
+    prov.on_block_committed(batch)
+
+    # block 4: the same Org1-only endorsement now FAILS policy
+    env2 = _tx(net, [p1], [("k", b"v2")])
+    env3 = _tx(net, [p1, p2], [("k2", b"v3")])
+    flt, batch, _ = v.validate(_block([env2, env3], 4))
+    assert list(flt) == [C.ENDORSEMENT_POLICY_FAILURE, C.VALID]

@@ -1,0 +1,154 @@
+"""RNS ECDSA kernel (ops.rns + ops.p256v3) verification tests.
+
+Oracle layers mirror tests/test_p256v2.py:
+1. field core — tests/test_rns.py;
+2. RCB complete point formulas over RNS vs crypto.ec_ref point ops,
+   including the degenerate lanes (doubling, inverses, infinity);
+3. full verify_batch vs the reference accept set
+   (bccsp/sw/ecdsa.go:41-58 semantics: low-S, ranges, on-curve).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from fabric_tpu.crypto import ec_ref
+from fabric_tpu.ops import p256v3 as v3
+from fabric_tpu.ops import rns
+
+P = ec_ref.P
+
+
+def _pt_rv(points):
+    """affine points (or None for ∞) → Montgomery projective RV triple."""
+    xs, ys, zs = [], [], []
+    for pt in points:
+        if pt is None:
+            xs.append(0); ys.append(rns.M_A % P); zs.append(0)
+        else:
+            xs.append(pt[0] * rns.M_A % P)
+            ys.append(pt[1] * rns.M_A % P)
+            zs.append(rns.M_A % P)
+    return tuple(
+        rns.RV(jnp.asarray(rns.ints_to_rns(v)), v3._BND_STATE)
+        for v in (xs, ys, zs)
+    )
+
+
+def _affine(rv_triple):
+    """RV projective triple → affine ints (or None for ∞) via CRT."""
+    ctx = rns.ctx_for(P)
+    out = []
+    coords = [
+        [v % P for v in rns.rv_to_ints(rns.from_mont(c, ctx).arr)]
+        for c in rv_triple
+    ]
+    for x, y, z in zip(*coords):
+        if z == 0:
+            out.append(None)
+        else:
+            zi = pow(z, -1, P)
+            out.append((x * zi % P, y * zi % P))
+    return out
+
+
+def test_rcb_complete_add_and_double(rng):
+    """Complete addition handles: generic, doubling (P=Q), inverse
+    (P=-Q → ∞), ∞ operands — all in one batch, no branches."""
+    ctx = rns.ctx_for(P)
+    b_m = v3._const_rv(v3.B_COEF * rns.M_A % P)
+    G = (v3.GX, v3.GY)
+    k2G = ec_ref.pt_mul(2, G)
+    k3G = ec_ref.pt_mul(3, G)
+    negG = (v3.GX, P - v3.GY)
+    p1 = [G, G, G, None, k2G]
+    p2 = [k2G, G, negG, k3G, None]
+    want = [k3G, k2G, None, k3G, k2G]
+    out = v3.pt_add(_pt_rv(p1), _pt_rv(p2), b_m, ctx)
+    assert _affine(out) == want
+
+    dbl = v3.pt_double(_pt_rv([G, k2G, None, k3G]), b_m, ctx)
+    assert _affine(dbl) == [k2G, ec_ref.pt_mul(4, G), None, ec_ref.pt_mul(6, G)]
+
+
+def test_rcb_mixed_add(rng):
+    ctx = rns.ctx_for(P)
+    b_m = v3._const_rv(v3.B_COEF * rns.M_A % P)
+    G = (v3.GX, v3.GY)
+    k2G = ec_ref.pt_mul(2, G)
+    p1 = _pt_rv([k2G, None, G])
+    # affine P2 = G for every lane (Montgomery residues)
+    gx = rns.RV(jnp.asarray(rns.ints_to_rns([v3.GX * rns.M_A % P] * 3)), P)
+    gy = rns.RV(jnp.asarray(rns.ints_to_rns([v3.GY * rns.M_A % P] * 3)), P)
+    out = v3.pt_add_mixed(p1, gx, gy, b_m, ctx)
+    assert _affine(out) == [ec_ref.pt_mul(3, G), G, k2G]
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return [ec_ref.SigningKey.generate() for _ in range(3)]
+
+
+def test_verify_accepts_valid_and_rejects_adversarial(keys, rng):
+    items, want = [], []
+    for i in range(12):
+        k = keys[i % 3]
+        e = ec_ref.digest_int(b"payload-%d" % i)
+        r, s = k.sign_digest(e)
+        items.append((e, r, s, *k.public))
+        want.append(True)
+    e = ec_ref.digest_int(b"hs")
+    r, s = keys[0].sign_digest(e)
+    adversarial = [
+        (ec_ref.digest_int(b"other"), r, s, *keys[0].public),  # wrong digest
+        (e, r, ec_ref.N - s, *keys[0].public),                 # high-S
+        (e, 0, s, *keys[0].public),                            # r = 0
+        (e, r, 0, *keys[0].public),                            # s = 0
+        (e, ec_ref.N, s, *keys[0].public),                     # r = n
+        (e, s, r, *keys[0].public),                            # swapped
+        (e, r, s, keys[0].public[0] + 1, keys[0].public[1]),   # off-curve Q
+        (e, r, s, *keys[1].public),                            # wrong key
+        (e, r, s, 0, 0),                                       # Q = ∞ encoding
+    ]
+    items += adversarial
+    want += [False] * len(adversarial)
+    got = v3.verify_host(items)
+    assert got == want
+    for (ei, ri, si, xi, yi), g in zip(items, got):
+        assert g == ec_ref.verify_digest((xi, yi), ei, ri, si)
+
+
+def test_verify_matches_oracle_randomized(keys, rng):
+    items = []
+    for i in range(48):
+        k = keys[i % 3]
+        e = ec_ref.digest_int(rng.bytes(16))
+        r, s = k.sign_digest(e)
+        kind = i % 6
+        if kind == 1:
+            r = (r + int(rng.integers(0, 3))) % ec_ref.N
+        elif kind == 2:
+            s = (s + int(rng.integers(0, 3))) % ec_ref.N
+        elif kind == 3:
+            e = (e + int(rng.integers(0, 2))) % (1 << 256)
+        items.append((e, r, s, *k.public))
+    got = v3.verify_host(items)
+    want = [ec_ref.verify_digest((x, y), e, r, s) for (e, r, s, x, y) in items]
+    assert got == want
+    assert any(want) and not all(want)
+
+
+def test_batch_inv_and_windows(rng):
+    ss = [int.from_bytes(rng.bytes(32), "big") % ec_ref.N or 1 for _ in range(33)]
+    inv = v3._batch_inv_mod_n(ss)
+    for s, si in zip(ss, inv):
+        assert s * si % ec_ref.N == 1
+    us = [0, 1, 15, 16, (1 << 256) - 1] + [
+        int.from_bytes(rng.bytes(32), "big") for _ in range(5)
+    ]
+    w = v3._windows(us)
+    for u, row in zip(us, w):
+        back = 0
+        for d in row:
+            back = (back << 4) | int(d)
+        assert back == u

@@ -1,0 +1,155 @@
+"""RNS (Cox-Rower) field-core tests: Montgomery multiplication, base
+extension, add/sub bound discipline — all bit-exact against Python
+ints via CRT reconstruction of every device result (the oracle the
+module's docstring promises)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fabric_tpu.crypto import ec_ref
+from fabric_tpu.ops import rns
+
+P = ec_ref.P
+N = ec_ref.N
+
+
+def _rv(ints, bound):
+    return rns.RV(jnp.asarray(rns.ints_to_rns(ints)), bound)
+
+
+def _ints(rv):
+    return rns.rv_to_ints(rv.arr)
+
+
+def test_base_construction():
+    assert len(set(rns.BASE_A) | set(rns.BASE_B)) == 2 * rns.N_CH
+    assert all(m < (1 << 12) for m in rns.BASE_A + rns.BASE_B)
+    assert rns.M_A > (1 << 270) and rns.M_B > (1 << 270)
+    # every prime odd and coprime to both moduli
+    for m in rns.BASE_A + rns.BASE_B:
+        assert P % m and N % m
+
+
+def test_residue_roundtrip(rng):
+    xs = [int.from_bytes(rng.bytes(32), "big") for _ in range(16)]
+    xs += [0, 1, P - 1, P, rns.M_A - 1]
+    arr = rns.ints_to_rns(xs)
+    back = rns.rv_to_ints(arr)
+    for x, b in zip(xs, back):
+        assert b == x % (rns.M_A * rns.M_B)
+        assert b == x  # all inputs < M_A·M_B
+
+
+@pytest.mark.parametrize("mod", [P, N], ids=["p", "n"])
+def test_mont_mul_chain_exact(mod, rng):
+    """300 chained Montgomery muls, bit-exact vs Python ints; output
+    bound invariants hold on every step."""
+    ctx = rns.ctx_for(mod)
+    Minv = pow(rns.M_A, -1, mod)
+    B = 8
+    a_int = [int.from_bytes(rng.bytes(32), "big") % mod for _ in range(B)]
+    b_int = [mod - 1, 1, 0, 2] + [
+        int.from_bytes(rng.bytes(32), "big") % mod for _ in range(B - 4)
+    ]
+    mul = jax.jit(lambda x, y: rns.mont_mul(
+        rns.RV(x, 3 * mod), rns.RV(y, mod), ctx).arr)
+    a = jnp.asarray(rns.ints_to_rns(a_int))
+    b = jnp.asarray(rns.ints_to_rns(b_int))
+    want = list(a_int)
+    for it in range(300):
+        a = mul(a, b)
+        for lane in range(B):
+            want[lane] = want[lane] * b_int[lane] * Minv % mod
+        if it % 59 == 0 or it == 299:
+            got = rns.rv_to_ints(a)
+            for lane in range(B):
+                assert got[lane] < 3 * mod, (it, lane)
+                assert got[lane] % mod == want[lane], (it, lane)
+
+
+def test_add_sub_exact(rng):
+    ctx = rns.ctx_for(P)
+    xs = [int.from_bytes(rng.bytes(32), "big") % P for _ in range(8)]
+    ys = [int.from_bytes(rng.bytes(32), "big") % P for _ in range(8)]
+    x, y = _rv(xs, P), _rv(ys, P)
+    s = x + y
+    for g, a, b in zip(_ints(s), xs, ys):
+        assert g == a + b and g <= s.bound
+    d = rns.rv_sub(x, y, ctx)
+    for g, a, b in zip(_ints(d), xs, ys):
+        assert g % P == (a - b) % P and g <= d.bound
+
+
+def test_extension_rank_edges():
+    """Exact-rank extension at the dangerous corners: v = 0, v = 1,
+    v near the bound — the α = ⌊s + ¼⌋ path must never be off by one."""
+    vals = [0, 1, 2, P - 1, P, 2 * P, 3 * P - 1]
+    arrB = rns.ints_to_rns(vals)[:, rns.N_CH:]  # base-B residues
+    out = rns._extend(jnp.asarray(arrB), rns.EXT_BA, rns.MOD_A, exact=True)
+    primes = rns.BASE_A
+    got = np.asarray(out)
+    for row, v in zip(got, vals):
+        for r, m in zip(row, primes):
+            assert int(r) == v % m, (v, m)
+
+
+def test_down_biased_extension_slack():
+    """Inexact extension may add exactly one source-M — never more,
+    never subtract."""
+    vals = [0, 1, rns.M_A - 1, rns.M_A // 2, 12345678901234567890]
+    arrA = rns.ints_to_rns(vals)[:, :rns.N_CH]
+    out = rns._extend(jnp.asarray(arrA), rns.EXT_AB, rns.MOD_B, exact=False)
+    got = np.asarray(out)
+    primes = rns.BASE_B
+    for row, v in zip(got, vals):
+        ok0 = all(int(r) == v % m for r, m in zip(row, primes))
+        ok1 = all(int(r) == (v + rns.M_A) % m for r, m in zip(row, primes))
+        assert ok0 or ok1, v
+
+
+def test_rem_helpers_exhaustive_edges(rng):
+    """Float-reciprocal remainders at boundary magnitudes."""
+    for mod_obj, primes in ((rns.MOD_A, rns.BASE_A), (rns.MOD_B, rns.BASE_B)):
+        edge = []
+        for m in primes:
+            edge.append([0, m - 1, m, m + 1, (1 << 24) - 1,
+                         ((1 << 24) - 1) // m * m])
+        t = jnp.asarray(np.array(edge, np.int32).T)  # [6, n]
+        out = np.asarray(mod_obj.rem24(t))
+        for i, m in enumerate(primes):
+            for j in range(t.shape[0]):
+                assert int(out[j, i]) == int(t[j, i]) % m
+        t30 = jnp.asarray(
+            np.array([[(1 << 30) - 1] * len(primes),
+                      [(1 << 30) - (1 << 20)] * len(primes),
+                      [0] * len(primes)], np.int32)
+        )
+        out30 = np.asarray(mod_obj.rem30(t30))
+        for i, m in enumerate(primes):
+            for j in range(3):
+                assert int(out30[j, i]) == int(t30[j, i]) % m
+
+
+def test_mont_roundtrip(rng):
+    ctx = rns.ctx_for(P)
+    xs = [int.from_bytes(rng.bytes(32), "big") % P for _ in range(8)]
+    x = _rv(xs, P)
+    xm = rns.to_mont(x, ctx)
+    for g, a in zip(_ints(xm), xs):
+        assert g % P == a * rns.M_A % P
+    back = rns.from_mont(xm, ctx)
+    for g, a in zip(_ints(back), xs):
+        assert g % P == a
+
+
+def test_eq_const_mod_p(rng):
+    ctx = rns.ctx_for(P)
+    # values ≡ 0 mod p in Montgomery domain: 0, p·M, 2p·M …
+    vals = [0, P * rns.M_A % (1 << 520), 7, P - 1, 2 * P]
+    ints = [0, P, 2 * P, 7, P + 3]
+    x = _rv(ints, 3 * P)
+    hits = np.asarray(rns.eq_const_mod_p(rns.RV(x.arr, 3 * P), ctx))
+    # from_mont multiplies by M⁻¹ — ≡0-ness mod p is preserved
+    assert list(hits) == [True, True, True, False, False]

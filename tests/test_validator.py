@@ -248,12 +248,14 @@ def test_config_tx_garbage_rejected(net, validator):
     payloads and bad signatures are rejected."""
     ch = pu.make_channel_header(common_pb2.HeaderType.CONFIG, CHANNEL)
     sh = pu.make_signature_header(net["client"].serialized, b"n")
+    # block 1, not 0: genesis blocks are the admin-verified trust
+    # anchor and bypass config validation (kvledger bootstrap)
     payload = pu.make_payload(ch, sh, b"\x01\x02\x03garbage-not-a-config")
     env = pu.sign_envelope(payload, net["client"])
-    flt, _, _ = validator.validate(_block([env]))
+    flt, _, _ = validator.validate(_block([env], num=1))
     assert list(flt) == [C.BAD_PAYLOAD]
 
     env2 = pu.sign_envelope(pu.make_payload(ch, sh, b""), net["client"])
     env2.signature = bytes(len(env2.signature))
-    flt, _, _ = validator.validate(_block([env2]))
+    flt, _, _ = validator.validate(_block([env2], num=1))
     assert list(flt) == [C.BAD_CREATOR_SIGNATURE]
