@@ -224,6 +224,39 @@ class Bundle:
         got = self.policy_manager.get(f"/Channel/Application/{name}")
         return got[0] if got else None
 
+    def application_policy_ast(self, name: str):
+        """Application policy as a pure signature-policy AST suitable
+        for the batch-plan compiler: IMPLICIT_META nodes flatten into
+        NOutOf over the child groups' sub-policies (ANY→1, ALL→n,
+        MAJORITY→⌊n/2⌋+1).  Exact vs the manager's independent
+        per-child evaluation whenever org principal sets are disjoint —
+        the invariant of org-scoped endorsement policies."""
+        got = self.policy_manager.get(f"/Channel/Application/{name}")
+        if got is None:
+            return None
+        return self._flatten(got[0], got[1])
+
+    def _flatten(self, rule, group: configtx_pb2.ConfigGroup):
+        if not isinstance(rule, ImplicitMeta):
+            return rule
+        children = [
+            (policy_from_config(cg.policies[rule.sub_policy]), cg)
+            for cg in group.groups.values()
+            if rule.sub_policy in cg.policies
+        ]
+        if not children:
+            return None
+        n = len(children)
+        need = {
+            policies_pb2.ImplicitMetaPolicy.ANY: 1,
+            policies_pb2.ImplicitMetaPolicy.ALL: n,
+            policies_pb2.ImplicitMetaPolicy.MAJORITY: n // 2 + 1,
+        }[rule.rule]
+        subs = tuple(self._flatten(r, g) for r, g in children)
+        if any(s is None for s in subs):
+            return None
+        return pol.NOutOf(need, subs)
+
     def hash(self) -> bytes:
         return hashlib.sha256(self.config.SerializeToString()).digest()
 
@@ -293,6 +326,22 @@ def authorize_update(bundle: Bundle, update_env: configtx_pb2.ConfigUpdateEnvelo
         )
         for cs in update_env.signatures
     ]
+
+    # root group version: _walk_elements yields children only, so the
+    # channel group itself is checked here — a root bump gates on the
+    # root mod_policy and is what authorizes root-level deletions
+    root_cur = current
+    root_new = update.write_set
+    if root_new.version not in (root_cur.version, root_cur.version + 1):
+        raise ConfigUpdateError(
+            f"root group version jump: {root_cur.version} → {root_new.version}"
+        )
+    if root_new.version == root_cur.version + 1:
+        mp = root_cur.mod_policy or "Admins"
+        if not _eval_mod_policy(bundle, "", mp, signed):
+            raise ConfigUpdateError(
+                f"mod_policy {mp!r} not satisfied for the channel group"
+            )
 
     # write-set: detect modifications, enforce mod_policy per element
     for path, kind, name, elem in _walk_elements(update.write_set):

@@ -231,15 +231,41 @@ class MSP:
 
 
 class MSPManager:
-    """Channel-wide registry: msp_id → MSP (analog msp/mspmgrimpl.go)."""
+    """Channel-wide registry: msp_id → MSP (analog msp/mspmgrimpl.go).
+
+    Deserialization is memoized by the serialized-identity bytes — the
+    reference's msp/cache layer: a 1000-tx block re-presents the same
+    handful of certs ~4000 times, and an x509 parse + chain validation
+    per presentation would dominate the host side of the commit path.
+    Membership changes invalidate by REPLACEMENT: a committed config
+    update builds a fresh Bundle (fresh MSPManager, empty cache) and
+    the peer swaps the validator onto it (peer/node.py _post_commit);
+    direct mutation via ``add()`` also clears the cache."""
+
+    CACHE_MAX = 4096
 
     def __init__(self, msps: dict[str, MSP] | None = None):
         self.msps = dict(msps or {})
+        self._ident_cache: dict[bytes, Identity] = {}
 
     def add(self, msp: MSP) -> None:
         self.msps[msp.msp_id] = msp
+        self.invalidate_cache()
+
+    def invalidate_cache(self) -> None:
+        self._ident_cache.clear()
 
     def deserialize_identity(self, serialized: bytes) -> Identity:
+        got = self._ident_cache.get(serialized)
+        if got is not None:
+            return got
+        ident = self._deserialize_uncached(serialized)
+        if len(self._ident_cache) >= self.CACHE_MAX:
+            self._ident_cache.clear()
+        self._ident_cache[serialized] = ident
+        return ident
+
+    def _deserialize_uncached(self, serialized: bytes) -> Identity:
         from fabric_tpu.protos import common_pb2
 
         pb = common_pb2.SerializedIdentity()

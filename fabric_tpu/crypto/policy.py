@@ -177,22 +177,44 @@ class BatchPlan:
         ``consumption_safe``)."""
         import numpy as np
 
+        return self.leaf_sat_batch(np.asarray(match)[None])[0]
+
+    def leaf_sat_batch(self, m3):
+        """[T, S, P] bool → [T, n_leaves] bool — the single source of
+        truth for count-based leaf semantics (the scalar APIs and the
+        device kernel in peer/device_block mirror THIS; a cross-check
+        test pins them together)."""
+        import numpy as np
+
+        m3 = np.asarray(m3, bool)
+        T = m3.shape[0]
         if self.n_leaves == 0:
-            return np.zeros(0, bool)
-        m = np.asarray(match)
-        if m.size == 0:
-            return np.zeros(self.n_leaves, bool)
-        counts = m.sum(axis=0)  # [P] distinct sigs matching each column
+            return np.zeros((T, 0), bool)
+        counts = m3.sum(axis=1)  # [T, P] distinct sigs per column
         cols = np.asarray(self.leaf_principal, int)
         ranks = np.asarray(self.leaf_rank, int)
-        return ranks < counts[cols]
+        return ranks[None, :] < counts[:, cols]
 
     def evaluate_counts(self, match) -> bool:
         """Count-based evaluation: exact when ``consumption_safe``."""
-        vals = list(self.leaf_sat(match))
+        import numpy as np
+
+        return bool(self.evaluate_counts_batch(np.asarray(match)[None])[0])
+
+    def evaluate_counts_batch(self, m3):
+        """[T, S, P] → [T] bool, vectorized gate walk."""
+        import numpy as np
+
+        m3 = np.asarray(m3, bool)
+        T = m3.shape[0]
+        leaf = self.leaf_sat_batch(m3)
+        vals = [leaf[:, i] for i in range(self.n_leaves)]
         for n, children in self.gates:
-            vals.append(sum(bool(vals[c]) for c in children) >= n)
-        return bool(vals[-1])
+            acc = np.zeros(T, int)
+            for c in children:
+                acc += vals[c].astype(int)
+            vals.append(acc >= n)
+        return vals[-1]
 
     def consumption_safe(self, match) -> bool:
         """True if no signature satisfies two distinct leaf principals
@@ -200,11 +222,17 @@ class BatchPlan:
         semantics)."""
         import numpy as np
 
-        m = np.asarray(match)
-        if m.size == 0:
-            return True
+        return bool(self.consumption_safe_batch(np.asarray(match)[None])[0])
+
+    def consumption_safe_batch(self, m3):
+        """[T, S, P] → [T] bool."""
+        import numpy as np
+
+        m3 = np.asarray(m3, bool)
+        if m3.size == 0:
+            return np.ones(m3.shape[0], bool)
         cols = np.asarray(sorted(set(self.leaf_principal)), int)
-        return bool((m[:, cols].sum(axis=1) <= 1).all())
+        return (m3[:, :, cols].sum(axis=2) <= 1).all(axis=1)
 
 
 def compile_plan(rule) -> BatchPlan:

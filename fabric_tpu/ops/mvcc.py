@@ -139,7 +139,7 @@ class TxRWSet:
     range_reads: list
 
 
-def prepare_block(txs: list[TxRWSet], committed: dict):
+def prepare_block(txs: list[TxRWSet], committed: dict, bucketed: bool = False):
     """Build device arrays for `mvcc_validate`.
 
     committed: dict key → (block, txnum) version for present keys
@@ -150,7 +150,13 @@ def prepare_block(txs: list[TxRWSet], committed: dict):
     to id intervals over the block's key universe (sufficient for
     in-block phantom detection: only in-block writes can phantom a
     range within a block).
+
+    bucketed: round T/R/W/Q up to powers of two so consecutive blocks
+    of similar shape share one compiled executable (padding rows carry
+    key id −1 and are inert).
     """
+    from fabric_tpu.utils.batching import next_pow2
+
     universe = set()
     for tx in txs:
         universe.update(k for k, _ in tx.reads)
@@ -167,6 +173,9 @@ def prepare_block(txs: list[TxRWSet], committed: dict):
     R = max(1, max((len(t.reads) for t in txs), default=1))
     W = max(1, max((len(t.writes) for t in txs), default=1))
     Q = max(1, max((len(t.range_reads) for t in txs), default=1))
+    if bucketed:
+        T = max(16, next_pow2(T))
+        R, W, Q = next_pow2(R), next_pow2(W), next_pow2(Q)
 
     read_keys = np.full((T, R), -1, np.int32)
     read_present = np.zeros((T, R), bool)
@@ -205,7 +214,11 @@ def mvcc_validate_block(txs: list[TxRWSet], committed: dict, pre_ok=None):
     arrays = prepare_block(txs, committed)
     if pre_ok is None:
         pre_ok = np.ones(len(txs), bool)
-    valid, conflict, phantom = mvcc_validate_jit(*arrays, jnp.asarray(pre_ok))
+    outs = mvcc_validate_jit(*arrays, jnp.asarray(pre_ok))
+    for o in outs:
+        if hasattr(o, "copy_to_host_async"):
+            o.copy_to_host_async()  # overlap readback latency
+    valid, conflict, phantom = outs
     return np.asarray(valid), np.asarray(conflict), np.asarray(phantom)
 
 

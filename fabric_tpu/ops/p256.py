@@ -412,9 +412,9 @@ _KERNEL = _os.environ.get("FABRIC_TPU_P256", "v3")
 def verify_host(items) -> list[bool]:
     """items: iterable of (digest_int, r, s, qx, qy) Python ints.
 
-    Dispatches to the v2 MXU kernel by default.  The v1 path pads the
-    batch to a power of two, floored at MIN_BUCKET (one compile per
-    bucket — small blocks share one cached compile), and runs the
+    Dispatches to the default kernel (v3 RNS/Cox-Rower) unless
+    FABRIC_TPU_P256 selects a comparison kernel.  The v1 path pads the
+    batch to a power of two, floored at MIN_BUCKET, and runs the
     jitted limb kernel.
     """
     items = list(items)
@@ -430,6 +430,22 @@ def verify_host(items) -> list[bool]:
         from fabric_tpu.ops import p256v3
 
         return p256v3.verify_host(items)
+    return _verify_host_v1(items)
+
+
+def verify_launch(items):
+    """Async launch + fetch() (see p256v3.verify_launch); the v1/v2
+    comparison kernels evaluate eagerly (no device handle — the fused
+    device pipeline requires the v3 kernel)."""
+    if _KERNEL not in ("v1", "v2"):
+        from fabric_tpu.ops import p256v3
+
+        return p256v3.verify_launch(items)
+    result = verify_host(items)
+    return lambda: result
+
+
+def _verify_host_v1(items) -> list[bool]:
     n = len(items)
     bsz = max(MIN_BUCKET, next_pow2(n))
     pad = [(0, 0, 0, 0, 0)] * (bsz - n)

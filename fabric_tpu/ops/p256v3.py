@@ -66,140 +66,92 @@ def _const_rv(x: int) -> rns.RV:
 
 
 def pt_add(p1, p2, b_rv, ctx):
+    """RCB16 algorithm 4 restaged into 3 stacked-mul dispatches:
+    6 independent muls, then the 2 b-muls, then the 6 output muls —
+    identical mathematics to the sequential schedule (the staging is
+    checked mul-for-mul against it in tests)."""
     X1, Y1, Z1 = p1
     X2, Y2, Z2 = p2
-    mul = lambda a, b: rns.mont_mul(a, b, ctx)
     sub = lambda a, b: rns.rv_sub(a, b, ctx)
-    t0 = mul(X1, X2)
-    t1 = mul(Y1, Y2)
-    t2 = mul(Z1, Z2)
-    t3 = X1 + Y1
-    t4 = X2 + Y2
-    t3 = mul(t3, t4)
-    t4 = t0 + t1
-    t3 = sub(t3, t4)
-    t4 = Y1 + Z1
-    X3 = Y2 + Z2
-    t4 = mul(t4, X3)
-    X3 = t1 + t2
-    t4 = sub(t4, X3)
-    X3 = X1 + Z1
-    Y3 = X2 + Z2
-    X3 = mul(X3, Y3)
-    Y3 = t0 + t2
-    Y3 = sub(X3, Y3)
-    Z3 = mul(b_rv, t2)
-    X3 = sub(Y3, Z3)
-    Z3 = X3 + X3
-    X3 = X3 + Z3
-    Z3 = sub(t1, X3)
-    X3 = t1 + X3
-    Y3 = mul(b_rv, Y3)
-    t1 = t2 + t2
-    t2 = t1 + t2
-    Y3 = sub(Y3, t2)
-    Y3 = sub(Y3, t0)
-    t1 = Y3 + Y3
-    Y3 = t1 + Y3
-    t1 = t0 + t0
-    t0 = t1 + t0
-    t0 = sub(t0, t2)
-    t1 = mul(t4, Y3)
-    t2 = mul(t0, Y3)
-    Y3 = mul(X3, Z3)
-    Y3 = Y3 + t2
-    X3 = mul(t3, X3)
-    X3 = sub(X3, t1)
-    Z3 = mul(t4, Z3)
-    t1 = mul(t3, t0)
-    Z3 = Z3 + t1
-    return (X3, Y3, Z3)
+    t0, t1, t2, s1, s2, s3 = rns.mont_mul_many(
+        [(X1, X2), (Y1, Y2), (Z1, Z2),
+         (X1 + Y1, X2 + Y2), (Y1 + Z1, Y2 + Z2), (X1 + Z1, X2 + Z2)],
+        ctx,
+    )
+    t3 = sub(s1, t0 + t1)
+    t4 = sub(s2, t1 + t2)
+    y3a = sub(s3, t0 + t2)
+    bz, by = rns.mont_mul_many([(b_rv, t2), (b_rv, y3a)], ctx)
+    x3a = sub(y3a, bz)
+    x3b = x3a + x3a + x3a
+    z3a = sub(t1, x3b)
+    x3c = t1 + x3b
+    t2b = t2 + t2 + t2
+    y3b = sub(sub(by, t2b), t0)
+    y3c = y3b + y3b + y3b
+    t0c = sub(t0 + t0 + t0, t2b)
+    m1, m2, m3, m4, m5, m6 = rns.mont_mul_many(
+        [(t4, y3c), (t0c, y3c), (x3c, z3a), (t3, x3c), (t4, z3a), (t3, t0c)],
+        ctx,
+    )
+    return (sub(m4, m1), m3 + m2, m5 + m6)
 
 
 def pt_add_mixed(p1, x2, y2, b_rv, ctx):
-    """RCB16 algorithm 5 (Z2 = 1): P2 affine, must not be ∞."""
+    """RCB16 algorithm 5 (Z2 = 1): P2 affine, must not be ∞.
+    Staged: 6 + 1 + 6 muls in 3 stacked dispatches."""
     X1, Y1, Z1 = p1
-    X2, Y2 = x2, y2
-    mul = lambda a, b: rns.mont_mul(a, b, ctx)
     sub = lambda a, b: rns.rv_sub(a, b, ctx)
-    t0 = mul(X1, X2)
-    t1 = mul(Y1, Y2)
-    t3 = X2 + Y2
-    t4 = X1 + Y1
-    t3 = mul(t3, t4)
-    t4 = t0 + t1
-    t3 = sub(t3, t4)
-    t4 = mul(Y2, Z1)
-    t4 = t4 + Y1
-    Y3 = mul(X2, Z1)
-    Y3 = Y3 + X1
-    Z3 = mul(b_rv, Z1)
-    X3 = sub(Y3, Z3)
-    Z3 = X3 + X3
-    X3 = X3 + Z3
-    Z3 = sub(t1, X3)
-    X3 = t1 + X3
-    Y3 = mul(b_rv, Y3)
-    t1 = Z1 + Z1
-    t2 = t1 + Z1
-    Y3 = sub(Y3, t2)
-    Y3 = sub(Y3, t0)
-    t1 = Y3 + Y3
-    Y3 = t1 + Y3
-    t1 = t0 + t0
-    t0 = t1 + t0
-    t0 = sub(t0, t2)
-    t1 = mul(t4, Y3)
-    t2 = mul(t0, Y3)
-    Y3 = mul(X3, Z3)
-    Y3 = Y3 + t2
-    X3 = mul(t3, X3)
-    X3 = sub(X3, t1)
-    Z3 = mul(t4, Z3)
-    t1 = mul(t3, t0)
-    Z3 = Z3 + t1
-    return (X3, Y3, Z3)
+    t0, t1, s1, myz, mxz, bz1 = rns.mont_mul_many(
+        [(X1, x2), (Y1, y2), (x2 + y2, X1 + Y1),
+         (y2, Z1), (x2, Z1), (b_rv, Z1)],
+        ctx,
+    )
+    t3 = sub(s1, t0 + t1)
+    t4 = myz + Y1
+    y3a = mxz + X1
+    x3a = sub(y3a, bz1)
+    x3b = x3a + x3a + x3a
+    z3a = sub(t1, x3b)
+    x3c = t1 + x3b
+    (by,) = rns.mont_mul_many([(b_rv, y3a)], ctx)
+    t2b = Z1 + Z1 + Z1
+    y3b = sub(sub(by, t2b), t0)
+    y3c = y3b + y3b + y3b
+    t0c = sub(t0 + t0 + t0, t2b)
+    m1, m2, m3, m4, m5, m6 = rns.mont_mul_many(
+        [(t4, y3c), (t0c, y3c), (x3c, z3a), (t3, x3c), (t4, z3a), (t3, t0c)],
+        ctx,
+    )
+    return (sub(m4, m1), m3 + m2, m5 + m6)
 
 
 def pt_double(p, b_rv, ctx):
+    """RCB16 algorithm 6 (a = −3) restaged: 6 + 2 + 2 + 3 muls in 4
+    stacked dispatches."""
     X, Y, Z = p
-    mul = lambda a, b: rns.mont_mul(a, b, ctx)
     sub = lambda a, b: rns.rv_sub(a, b, ctx)
-    t0 = mul(X, X)
-    t1 = mul(Y, Y)
-    t2 = mul(Z, Z)
-    t3 = mul(X, Y)
-    t3 = t3 + t3
-    Z3 = mul(X, Z)
-    Z3 = Z3 + Z3
-    Y3 = mul(b_rv, t2)
-    Y3 = sub(Y3, Z3)
-    X3 = Y3 + Y3
-    Y3 = X3 + Y3
-    X3 = sub(t1, Y3)
-    Y3 = t1 + Y3
-    Y3 = mul(X3, Y3)
-    X3 = mul(X3, t3)
-    t3 = t2 + t2
-    t2 = t2 + t3
-    Z3 = mul(b_rv, Z3)
-    Z3 = sub(Z3, t2)
-    Z3 = sub(Z3, t0)
-    t3 = Z3 + Z3
-    Z3 = Z3 + t3
-    t3 = t0 + t0
-    t0 = t3 + t0
-    t0 = sub(t0, t2)
-    t0 = mul(t0, Z3)
-    Y3 = Y3 + t0
-    t0 = mul(Y, Z)
-    t0 = t0 + t0
-    Z3 = mul(t0, Z3)
-    X3 = sub(X3, Z3)
-    Z3 = mul(t0, t1)
-    Z3 = Z3 + Z3
-    Z3 = Z3 + Z3
-    return (X3, Y3, Z3)
+    t0, t1, t2, xy, xz, yz = rns.mont_mul_many(
+        [(X, X), (Y, Y), (Z, Z), (X, Y), (X, Z), (Y, Z)], ctx
+    )
+    t3 = xy + xy
+    zz2 = xz + xz
+    bt2, bz = rns.mont_mul_many([(b_rv, t2), (b_rv, zz2)], ctx)
+    y3a = sub(bt2, zz2)
+    y3b = y3a + y3a + y3a
+    x3a = sub(t1, y3b)
+    y3c = t1 + y3b
+    y3m, x3m = rns.mont_mul_many([(x3a, y3c), (x3a, t3)], ctx)
+    t2b = t2 + t2 + t2
+    z3a = sub(sub(bz, t2b), t0)
+    z3b = z3a + z3a + z3a
+    t0c = sub(t0 + t0 + t0, t2b)
+    yz2 = yz + yz
+    a1, a2, a3 = rns.mont_mul_many(
+        [(t0c, z3b), (yz2, z3b), (yz2, t1)], ctx
+    )
+    Z3 = a3 + a3
+    return (sub(x3m, a2), y3m + a1, Z3 + Z3)
 
 
 # ---------------------------------------------------------------------------
@@ -390,13 +342,46 @@ def prepare(items, pad_to: int | None = None):
     )
 
 
+class VerifyHandle:
+    """An in-flight verify batch: the device-resident validity vector
+    plus a fetch() that syncs to host.  Downstream device stages
+    (policy + MVCC fusion) consume ``device_out`` directly so the
+    signature bits never cross the device boundary on the critical
+    path."""
+
+    __slots__ = ("device_out", "n_real")
+
+    def __init__(self, device_out, n_real: int):
+        self.device_out = device_out
+        self.n_real = n_real
+
+    def fetch(self) -> list[bool]:
+        return [bool(v) for v in np.asarray(self.device_out)[: self.n_real]]
+
+    def __call__(self) -> list[bool]:
+        return self.fetch()
+
+
+def verify_launch(items) -> VerifyHandle:
+    """Asynchronously dispatch a verify batch; returns a VerifyHandle
+    (callable as a zero-arg fetch for list[bool]).  The jax dispatch is
+    non-blocking, so the device crunches while the caller's host thread
+    moves on — the pipeline primitive the block validator builds on."""
+    items = list(items)
+    if not items:
+        return VerifyHandle(jnp.zeros((0,), bool), 0)
+    n_real = len(items)
+    args = prepare(items, pad_to=max(MIN_BUCKET, next_pow2(n_real)))
+    out = verify_batch_jit(*args)  # async under jax's deferred execution
+    if hasattr(out, "copy_to_host_async"):
+        # start the D2H as soon as compute finishes: device→host
+        # readback latency is substantial on tunneled devices and must
+        # overlap the caller's host work, not serialize behind it
+        out.copy_to_host_async()
+    return VerifyHandle(out, n_real)
+
+
 def verify_host(items) -> list[bool]:
     """items: iterable of (digest_int, r, s, qx, qy) Python ints —
     same interface and accept set as ops.p256.verify_host."""
-    items = list(items)
-    if not items:
-        return []
-    n_real = len(items)
-    args = prepare(items, pad_to=max(MIN_BUCKET, next_pow2(n_real)))
-    out = verify_batch_jit(*args)
-    return [bool(v) for v in np.asarray(out)[:n_real]]
+    return verify_launch(items)()

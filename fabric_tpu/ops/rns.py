@@ -279,16 +279,10 @@ def rv_sub(x: RV, y: RV, ctx: MontCtx) -> RV:
     return RV(t, x.bound + lam * ctx.p)
 
 
-def mont_mul(x: RV, y: RV, ctx: MontCtx) -> RV:
-    """x·y·M_A⁻¹ mod p (Montgomery step); output bound
-    x.b·y.b/M_A + 2p + 1 < 3p for all sane inputs."""
-    T = x.bound * y.bound
-    out_bound = T // M_A + 2 * ctx.p + 1
-    # extension-margin preconditions (trace-time)
-    assert T // M_A + ctx.p < M_B // 4, "r-extension margin violated"
-    assert T < M_A * M_B // 8, "product overflows the RNS range"
-
-    t = MOD_ALL.rem24(x.arr * y.arr)
+def _mont_mul_arr(xa, ya, ctx: MontCtx):
+    """Array-level Montgomery pipeline on [..., 2n] canonical residues
+    (leading dims arbitrary — the stacked-mul path rides them)."""
+    t = MOD_ALL.rem24(xa * ya)
     n = N_CH
     tA, tB = t[..., :n], t[..., n:]
     q = MOD_A.mulmod_const(tA, ctx.neg_p_inv_A)
@@ -297,7 +291,40 @@ def mont_mul(x: RV, y: RV, ctx: MontCtx) -> RV:
     num = MOD_B.rem24(tB + u)
     rB = MOD_B.mulmod_const(num, ctx.invMA_B)
     rA = _extend(rB, EXT_BA, MOD_A, exact=True)
-    return RV(jnp.concatenate([rA, rB], axis=-1), out_bound)
+    return jnp.concatenate([rA, rB], axis=-1)
+
+
+def _mul_bound(x: RV, y: RV, ctx: MontCtx) -> int:
+    T = x.bound * y.bound
+    # extension-margin preconditions (trace-time)
+    assert T // M_A + ctx.p < M_B // 4, "r-extension margin violated"
+    assert T < M_A * M_B // 8, "product overflows the RNS range"
+    return T // M_A + 2 * ctx.p + 1
+
+
+def mont_mul(x: RV, y: RV, ctx: MontCtx) -> RV:
+    """x·y·M_A⁻¹ mod p (Montgomery step); output bound
+    x.b·y.b/M_A + 2p + 1 < 3p for all sane inputs."""
+    out_bound = _mul_bound(x, y, ctx)
+    return RV(_mont_mul_arr(x.arr, y.arr, ctx), out_bound)
+
+
+def mont_mul_many(pairs, ctx: MontCtx) -> list:
+    """k independent Montgomery muls as ONE stacked pipeline.
+
+    The point formulas have 2-6 independent muls per stage; stacking
+    them turns k tiny [B,46]@[46,72] matmuls into one [k·B,46]@[46,72]
+    — same flops, ~k× fewer dispatches and better MXU occupancy.
+    Operands are broadcast to a common shape before stacking
+    (constants ride along as [2n] rows)."""
+    bounds = [_mul_bound(x, y, ctx) for x, y in pairs]
+    shape = np.broadcast_shapes(*(
+        np.shape(v.arr) for pair in pairs for v in pair
+    ))
+    xs = jnp.stack([jnp.broadcast_to(x.arr, shape) for x, _ in pairs])
+    ys = jnp.stack([jnp.broadcast_to(y.arr, shape) for _, y in pairs])
+    out = _mont_mul_arr(xs, ys, ctx)
+    return [RV(out[i], b) for i, b in enumerate(bounds)]
 
 
 def to_mont(x: RV, ctx: MontCtx) -> RV:
@@ -324,31 +351,34 @@ def eq_const_mod_p(x: RV, ctx: MontCtx):
 # ---------------------------------------------------------------------------
 # Host conversions (numpy, vectorized — no per-digit Python loops)
 
-_POW16 = None
+_POW8 = None
 
 
-def _pow16_table() -> np.ndarray:
-    """[17, 2n] int64: 2^(16k) mod m for limb-matmul conversion."""
-    global _POW16
-    if _POW16 is None:
+def _pow8_table() -> np.ndarray:
+    """[40, 2n] float64: 2^(8k) mod m for the limb contraction."""
+    global _POW8
+    if _POW8 is None:
         primes = BASE_A + BASE_B
-        _POW16 = np.array(
-            [[pow(2, 16 * k, m) for m in primes] for k in range(20)], np.int64
+        _POW8 = np.array(
+            [[pow(2, 8 * k, m) for m in primes] for k in range(40)], np.float64
         )
-    return _POW16
+    return _POW8
 
 
 def ints_to_rns(xs) -> np.ndarray:
-    """[B] Python ints (< 2^320) → [B, 2n] canonical residues."""
+    """[B] Python ints (< 2^320) → [B, 2n] canonical residues.
+
+    The limb contraction runs in float64 (BLAS dgemm — numpy's int64
+    matmul is a scalar loop): 8-bit limbs × 12-bit table entries summed
+    over 40 limbs stay < 2^43, exact in f64's 53-bit mantissa."""
     if not len(xs):
         return np.zeros((0, 2 * N_CH), np.int32)
-    raw = np.frombuffer(
+    limbs = np.frombuffer(
         b"".join(int(x).to_bytes(40, "little") for x in xs), np.uint8
-    ).reshape(len(xs), 40).astype(np.int64)
-    limbs = raw[:, 0::2] + (raw[:, 1::2] << 8)  # [B, 20] 16-bit limbs
+    ).reshape(len(xs), 40).astype(np.float64)
+    acc = limbs @ _pow8_table()  # [B, 2n] exact in f64
     primes = np.array(BASE_A + BASE_B, np.int64)
-    acc = (limbs @ _pow16_table()) % primes  # [B, 2n]
-    return acc.astype(np.int32)
+    return (acc.astype(np.int64) % primes).astype(np.int32)
 
 
 def to_rns(x: int) -> RV:

@@ -1,0 +1,180 @@
+"""Fused device stage for block validation: policy reduction + MVCC in
+ONE dispatch consuming the verify batch's device-resident output.
+
+Why fusion is the TPU-shaped design: the naive pipeline syncs the
+device twice per block (signature bits → host policy walk → MVCC
+dispatch → results).  Each sync pays a full device round trip — painful
+on PCIe, brutal over a tunneled device.  Here the boolean signature
+vector NEVER leaves the device: stage 2 gathers it per endorsement,
+runs the batch-plan policy reduction (fabric_tpu.crypto.policy
+compile_plan semantics — counts vs leaf ranks, the vectorized
+formulation of cauthdsl's consumption walk), AND-reduces per tx across
+namespaces, feeds the result into the MVCC fixpoint as pre_ok, and
+returns one packed int8 vector.  One dispatch, one readback, per block.
+
+Exactness: the count-based policy path is exact iff no signature
+matches two distinct principal columns (policy.py consumption_safe).
+The device computes that predicate per entry and the host REDOES the
+rare unsafe blocks on the exact interpreter path (validator fallback) —
+fast path stays exact, slow path stays correct.
+
+Reference anchors: plugin dispatch plugindispatcher/dispatcher.go:102,
+policy evaluation common/cauthdsl/cauthdsl.go:24-110, MVCC
+validation/validator.go:81-118, per-tx fan-out v20/validator.go:193.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from fabric_tpu.crypto import policy as pol
+from fabric_tpu.ops import mvcc as mvcc_ops
+from fabric_tpu.utils.batching import next_pow2
+
+
+@dataclass(frozen=True)
+class PlanSig:
+    """Static (hashable) shape of one policy group inside the fused
+    program — the jit cache key component."""
+
+    leaf_principal: tuple
+    leaf_rank: tuple
+    gates: tuple  # ((n, (child_slots...)), ...)
+    n_principals: int
+    e_bucket: int
+    s_bucket: int
+
+
+def plan_sig(plan: pol.BatchPlan, e_bucket: int, s_bucket: int) -> PlanSig:
+    return PlanSig(
+        leaf_principal=tuple(plan.leaf_principal),
+        leaf_rank=tuple(plan.leaf_rank),
+        gates=tuple((n, tuple(c)) for n, c in plan.gates),
+        n_principals=len(plan.principals),
+        e_bucket=e_bucket,
+        s_bucket=s_bucket,
+    )
+
+
+def _policy_reduce(sig_padded, match, endo_idx, sig: PlanSig):
+    """[Eb] (ok, safe) for one policy group.
+
+    sig_padded: [n_sig + 1] bool with a trailing False — endo_idx −1
+    (padding) gathers the False lane."""
+    n_sig = sig_padded.shape[0] - 1
+    idx = jnp.where(endo_idx >= 0, endo_idx, n_sig)
+    ev = sig_padded[idx]  # [Eb, S]
+    M = match & ev[:, :, None]  # [Eb, S, P]
+    counts = M.sum(axis=1)  # [Eb, P]
+    cols = jnp.asarray(sorted(set(sig.leaf_principal)), jnp.int32)
+    safe = (M[:, :, cols].sum(axis=2) <= 1).all(axis=1)
+    leaf_p = jnp.asarray(sig.leaf_principal, jnp.int32)
+    ranks = jnp.asarray(sig.leaf_rank, jnp.int32)
+    vals = list((ranks[None, :] < counts[:, leaf_p]).T)  # n_leaves × [Eb]
+    for n, children in sig.gates:
+        acc = jnp.zeros(match.shape[0], jnp.int32)
+        for c in children:
+            acc = acc + vals[c].astype(jnp.int32)
+        vals.append(acc >= n)
+    return vals[-1], safe
+
+
+def build_stage2(t_bucket: int, n_sig: int, group_sigs: tuple,
+                 mvcc_shapes: tuple):
+    """→ jitted stage2(sig_valid, creator_idx, structural_ok,
+    *per-group (match, endo_idx, tx_of), *mvcc_arrays, ) → packed int8.
+
+    Packed layout (host unpacks by static offsets):
+      [0:T]    valid        [T:2T]  conflict      [2T:3T] phantom
+      [3T:4T]  creator_ok   [4T:5T] policy_ok
+      [5T:5T+n_sig] sig_valid
+      then per group: [Eb] safe bits.
+    """
+
+    def stage2(sig_valid, creator_idx, structural_ok, *rest):
+        g = len(group_sigs)
+        groups = rest[: 3 * g]
+        mvcc_arrays = rest[3 * g :]
+        svF = jnp.concatenate([sig_valid, jnp.zeros((1,), bool)])
+        creator_ok = svF[jnp.where(creator_idx >= 0, creator_idx, sig_valid.shape[0])]
+
+        policy_ok = jnp.ones(t_bucket + 1, jnp.int8)
+        safes = []
+        for gi, sig in enumerate(group_sigs):
+            match, endo_idx, tx_of = groups[3 * gi : 3 * gi + 3]
+            ok_g, safe_g = _policy_reduce(svF, match, endo_idx, sig)
+            safes.append(safe_g)
+            t = jnp.where(tx_of >= 0, tx_of, t_bucket)
+            contrib = jnp.where(tx_of >= 0, ok_g, True).astype(jnp.int8)
+            policy_ok = policy_ok.at[t].min(contrib)
+        policy_ok = policy_ok[:t_bucket].astype(bool)
+
+        pre_ok = structural_ok & creator_ok & policy_ok
+        valid, conflict, phantom = mvcc_ops.mvcc_validate(*mvcc_arrays, pre_ok)
+
+        parts = [valid, conflict, phantom, creator_ok, policy_ok, sig_valid]
+        parts.extend(safes)
+        return jnp.concatenate([p.astype(jnp.int8) for p in parts])
+
+    return jax.jit(stage2)
+
+
+class DeviceBlockPipeline:
+    """Caches compiled stage-2 programs keyed by static block shape +
+    the set of policy plans in play."""
+
+    def __init__(self):
+        self._cache: dict = {}
+
+    def run(self, handle, creator_idx, structural_ok, groups, mvcc_arrays,
+            pre_ok_pad_len):
+        """handle: p256v3.VerifyHandle; groups: list of
+        (plan, match np[Eb,S,P], endo_idx np[Eb,S], tx_of np[Eb]).
+        Returns a zero-arg fetch → dict of numpy arrays."""
+        t_bucket = pre_ok_pad_len
+        n_sig = int(handle.device_out.shape[0])
+        gsigs = tuple(
+            plan_sig(plan, match.shape[0], match.shape[1])
+            for plan, match, _, _ in groups
+        )
+        mshapes = tuple(tuple(a.shape) for a in mvcc_arrays)
+        key = (t_bucket, n_sig, gsigs, mshapes)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._cache[key] = build_stage2(
+                t_bucket, n_sig, gsigs, mshapes
+            )
+        args = [handle.device_out, jnp.asarray(creator_idx),
+                jnp.asarray(structural_ok)]
+        for _, match, endo_idx, tx_of in groups:
+            args += [jnp.asarray(match), jnp.asarray(endo_idx),
+                     jnp.asarray(tx_of)]
+        args += [jnp.asarray(a) for a in mvcc_arrays]
+        packed = fn(*args)
+        if hasattr(packed, "copy_to_host_async"):
+            packed.copy_to_host_async()
+
+        def fetch():
+            flat = np.asarray(packed).astype(bool)
+            T = t_bucket
+            out = {
+                "valid": flat[0:T],
+                "conflict": flat[T:2 * T],
+                "phantom": flat[2 * T:3 * T],
+                "creator_ok": flat[3 * T:4 * T],
+                "policy_ok": flat[4 * T:5 * T],
+                "sig_valid": flat[5 * T:5 * T + n_sig],
+            }
+            off = 5 * T + n_sig
+            safes = []
+            for sig in gsigs:
+                safes.append(flat[off:off + sig.e_bucket])
+                off += sig.e_bucket
+            out["safe"] = safes
+            return out
+
+        return fetch

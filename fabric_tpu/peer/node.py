@@ -34,13 +34,61 @@ from fabric_tpu.protos import common_pb2, proposal_pb2
 
 
 class PeerChannel:
-    """One channel's ledger + validator + commit loop on this peer."""
+    """One channel's ledger + validator + commit loop on this peer.
 
-    def __init__(self, channel_id: str, data_dir: str, msp_manager,
-                 policy_provider: PolicyProvider, state_db=None,
-                 config_processor=None):
+    With ``genesis_block`` (the production path — the reference
+    requires the channel's genesis/config block at join,
+    core/peer/peer.go:235), the channel derives its trust anchor from
+    it: channelconfig Bundle → MSPs + policy tree, a lifecycle-backed
+    policy provider over the channel's OWN state, and a config-tx
+    processor.  The genesis block commits locally WITHOUT validation
+    (the admin vouches for it out-of-band) and the deliver loop then
+    starts at height 1, so a malicious orderer can never substitute a
+    different block 0.  Without a genesis block (dev mode) the caller
+    wires msp/provider explicitly and the first delivered block is
+    trusted — test-network semantics only."""
+
+    def __init__(self, channel_id: str, data_dir: str, msp_manager=None,
+                 policy_provider: PolicyProvider | None = None, state_db=None,
+                 config_processor=None, genesis_block=None):
         self.id = channel_id
         self.ledger = KVLedger(data_dir, state_db=state_db or MemVersionedDB())
+        if genesis_block is not None:
+            from fabric_tpu import channelconfig as chancfg
+            from fabric_tpu.peer.lifecycle import LifecyclePolicyProvider
+            from fabric_tpu.protos import configtx_pb2
+
+            env = protoutil.unmarshal(
+                common_pb2.Envelope, genesis_block.data.data[0]
+            )
+            payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+            cfg_env = protoutil.unmarshal(configtx_pb2.ConfigEnvelope, payload.data)
+            bundle = chancfg.Bundle(channel_id, cfg_env.config)
+            config_processor = config_processor or chancfg.ConfigTxProcessor(bundle)
+            self.processor = config_processor
+            msp_manager = bundle.msp_manager
+            if policy_provider is None:
+                policy_provider = LifecyclePolicyProvider(
+                    self.ledger.state,
+                    ref_resolver=lambda name: (
+                        self.processor.bundle.application_policy_ast(name)
+                    ),
+                )
+            if self.ledger.blocks.height == 0:
+                from fabric_tpu.ledger.statedb import UpdateBatch
+
+                gb = common_pb2.Block()
+                gb.CopyFrom(genesis_block)
+                self.ledger.commit_block(
+                    gb, bytes([0]), UpdateBatch(), []
+                )
+        else:
+            self.processor = config_processor
+        if msp_manager is None or policy_provider is None:
+            raise ValueError(
+                "join without genesis_block requires explicit "
+                "msp_manager and policy_provider"
+            )
         self.validator = BlockValidator(
             msp_manager, policy_provider, self.ledger.state,
             block_store=self.ledger.blocks, config_processor=config_processor,
@@ -102,7 +150,13 @@ class PeerChannel:
             except Exception:
                 continue  # malformed yet VALID can only be genesis noise
             try:
-                proc.apply(cfg_env)
+                new_bundle = proc.apply(cfg_env)
+                # rotate the validator onto the new membership: stale
+                # cached identities (revoked certs, rotated CAs) must
+                # not keep validating (reference: bundle update
+                # propagation, core/peer/peer.go BundleSource)
+                if hasattr(new_bundle, "msp_manager"):
+                    self.validator.msp = new_bundle.msp_manager
             except Exception:
                 import logging
 
@@ -175,11 +229,14 @@ class PeerNode:
         self.channels: dict[str, PeerChannel] = {}
         self.server = RpcServer(host, port)
 
-    def join_channel(self, channel_id: str, policy_provider: PolicyProvider,
-                     state_db=None, config_processor=None) -> PeerChannel:
+    def join_channel(self, channel_id: str, policy_provider: PolicyProvider | None = None,
+                     state_db=None, config_processor=None,
+                     genesis_block=None) -> PeerChannel:
         ch = PeerChannel(
-            channel_id, f"{self.dir}/{channel_id}", self.msp,
+            channel_id, f"{self.dir}/{channel_id}",
+            None if genesis_block is not None else self.msp,
             policy_provider, state_db, config_processor,
+            genesis_block=genesis_block,
         )
         self.channels[channel_id] = ch
         return ch

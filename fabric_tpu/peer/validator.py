@@ -125,6 +125,7 @@ class BlockValidator:
         self.blocks = block_store
         self.plugins = {"default": DefaultValidation(), **(plugins or {})}
         self.config_processor = config_processor
+        self._device_pipeline = None
 
     def warmup(self, n_sigs: int = 16) -> None:
         """Compile (or load from the persistent cache) the signature
@@ -194,10 +195,10 @@ class BlockValidator:
             ):
                 ptx.code = C.BAD_PROPOSAL_TXID
                 continue
-            # dup txid: in-block + vs ledger (v20/validator.go:460-481)
-            if ch.tx_id in seen_txids or (
-                self.blocks is not None and self.blocks.tx_exists(ch.tx_id)
-            ):
+            # dup txid in-block (v20/validator.go:460-481); the
+            # vs-ledger check happens in validate() — preprocess() must
+            # be runnable BEFORE the previous block commits (pipeline)
+            if ch.tx_id in seen_txids:
                 ptx.code = C.DUPLICATE_TXID
                 continue
             seen_txids[ch.tx_id] = i
@@ -221,7 +222,9 @@ class BlockValidator:
 
             # endorsements + rwset
             try:
-                _, _, cap, prp, cca = protoutil.extract_action(env)
+                _, _, cap, prp, cca = protoutil.extract_action(
+                    env, parsed=(payload, ch, sh)
+                )
                 ptx.rwset = TxRWSet.from_bytes(cca.results)
                 ptx.namespaces = tuple(sorted(ptx.rwset.ns))
                 prp_bytes = cap.action.proposal_response_payload
@@ -252,14 +255,50 @@ class BlockValidator:
 
     # -- the pipeline ------------------------------------------------------
 
-    def validate(self, block: common_pb2.Block):
+    def preprocess(self, block: common_pb2.Block):
+        """Host parse + ASYNC device-verify launch for one block.
+
+        Safe to run for block n+1 while block n is still committing
+        (touches no ledger state): the peer's deliver loop and the
+        bench overlap the host phase of the next block with the device
+        phase of the current one — the TPU-shaped analog of the
+        reference's deliver prefetch + validator pool overlap
+        (gossip/state/state.go:540, v20/validator.go:193)."""
         txs, items = self._parse(block)
+        fetch = p256.verify_launch(items)
+        return txs, items, fetch
+
+    def validate(self, block: common_pb2.Block, pre=None):
+        if pre is None:
+            pre = self.preprocess(block)
+        txs, items, fetch = pre
         # parsed records for post-commit consumers (config rotation) —
         # the commit path is serialized per channel, so this is safe
         self.last_parsed = txs
 
+        # dup txid vs committed ledger (deferred from preprocess)
+        if self.blocks is not None:
+            for ptx in txs:
+                if (
+                    ptx.undetermined and not ptx.is_config
+                    and self.blocks.tx_exists(ptx.txid)
+                ):
+                    ptx.code = C.DUPLICATE_TXID
+
+        # fused single-sync device path: policy + MVCC consume the
+        # verify output ON DEVICE (one dispatch + one readback per
+        # block); falls back to the host path for custom plugins,
+        # non-v3 kernels, or consumption-unsafe blocks
+        if getattr(fetch, "device_out", None) is not None and txs:
+            result = self._validate_device(block, txs, items, fetch)
+            if result is not None:
+                return result
+
+        return self._validate_host(block, txs, items, fetch)
+
+    def _validate_host(self, block, txs, items, fetch):
         # phase 1a: one batched ECDSA verify for the whole block
-        sig_valid = np.asarray(p256.verify_host(items), bool) if items else np.zeros(0, bool)
+        sig_valid = np.asarray(fetch(), bool) if items else np.zeros(0, bool)
 
         for ptx in txs:
             if ptx.undetermined and ptx.creator_item_idx >= 0:
@@ -323,6 +362,126 @@ class BlockValidator:
                     ptx.code = C.PHANTOM_READ_CONFLICT if ph else C.MVCC_READ_CONFLICT
 
         # phase 3: filter + update batch + history
+        tx_filter = bytes(ptx.code for ptx in txs)
+        batch, history = self._build_updates(block.header.number, txs)
+        return tx_filter, batch, history
+
+    # -- fused single-sync device path ------------------------------------
+
+    def _validate_device(self, block, txs, items, handle):
+        """One-dispatch-one-readback validation (device_block): returns
+        (filter, batch, history) or None to fall back."""
+        from fabric_tpu.ops import mvcc as mvcc_ops
+        from fabric_tpu.peer.device_block import DeviceBlockPipeline
+        from fabric_tpu.utils.batching import next_pow2
+
+        default = self.plugins.get("default")
+        if type(default).__name__ != "DefaultValidation":
+            return None
+
+        # structural phase (host, deterministic — shared with fallback)
+        entries = []  # (ptx, ns, info)
+        for ptx in txs:
+            if not ptx.undetermined or ptx.is_config:
+                continue
+            infos = [self.policies.info(ns) for ns in ptx.namespaces]
+            if not ptx.namespaces or any(i is None for i in infos):
+                ptx.code = C.INVALID_CHAINCODE
+                continue
+            if any((i.plugin or "default") != "default" for i in infos):
+                return None  # custom plugin in play → host dispatch path
+            for ns, info in zip(ptx.namespaces, infos):
+                entries.append((ptx, ns, info))
+
+        # committed-range phantom re-execution (host state reads)
+        mvcc_txs, committed = self._mvcc_inputs(txs)
+
+        T = len(txs)
+        t_bucket = max(16, next_pow2(T))
+        structural = np.zeros(t_bucket, bool)
+        creator_idx = np.full(t_bucket, -1, np.int32)
+        for ptx in txs:
+            if ptx.undetermined and not ptx.is_config:
+                structural[ptx.idx] = True
+                creator_idx[ptx.idx] = ptx.creator_item_idx
+
+        # policy groups (by policy object), padded to buckets
+        by_policy: dict[int, list] = {}
+        plans: dict[int, object] = {}
+        for ptx, ns, info in entries:
+            key = id(info.policy)
+            if key not in plans:
+                plans[key] = default._plan(info.policy)
+            by_policy.setdefault(key, []).append((ptx, info))
+        groups = []
+        group_entries = []
+        for key, ents in by_policy.items():
+            plan = plans[key]
+            P = len(plan.principals)
+            S = max(4, next_pow2(max(
+                (len(p.endorsements) for p, _ in ents), default=1) or 1))
+            E = max(16, next_pow2(len(ents)))
+            match = np.zeros((E, S, P), bool)
+            endo_idx = np.full((E, S), -1, np.int32)
+            tx_of = np.full(E, -1, np.int32)
+            for e, (ptx, info) in enumerate(ents):
+                tx_of[e] = ptx.idx
+                for s, (ser, ident) in enumerate(ptx.endorsements):
+                    match[e, s] = default._match_row(plan, ser, ident)
+                    endo_idx[e, s] = ptx.endo_item_idx[s]
+            groups.append((plan, match, endo_idx, tx_of))
+            group_entries.append(ents)
+
+        mvcc_arrays = mvcc_ops.prepare_block(mvcc_txs, committed, bucketed=True)
+        tb_actual = int(mvcc_arrays[0].shape[0])
+        if tb_actual != t_bucket:
+            # mvcc bucket and tx bucket must agree (they both round T)
+            t_bucket = tb_actual
+            structural = np.resize(structural, t_bucket)
+            structural[T:] = False
+            creator_idx = np.resize(creator_idx, t_bucket)
+            creator_idx[T:] = -1
+
+        if self._device_pipeline is None:
+            self._device_pipeline = DeviceBlockPipeline()
+        fetch2 = self._device_pipeline.run(
+            handle, creator_idx, structural, groups, mvcc_arrays, t_bucket
+        )
+        out = fetch2()
+
+        # consumption-unsafe rows → exact host interpreter path
+        for safe_bits, ents in zip(out["safe"], group_entries):
+            if not np.all(safe_bits[: len(ents)]):
+                return None
+
+        sig_valid = out["sig_valid"]
+        for ptx in txs:
+            if ptx.undetermined and ptx.creator_item_idx >= 0:
+                if not (
+                    ptx.creator_item_idx < len(sig_valid)
+                    and sig_valid[ptx.creator_item_idx]
+                ):
+                    ptx.code = C.BAD_CREATOR_SIGNATURE
+        for ptx in txs:
+            if ptx.is_config and ptx.undetermined:
+                ptx.code = self._validate_config(block, ptx)
+        for ptx in txs:
+            if not ptx.undetermined or ptx.is_config:
+                continue
+            if not out["policy_ok"][ptx.idx]:
+                ptx.code = C.ENDORSEMENT_POLICY_FAILURE
+        for ptx in txs:
+            if not ptx.undetermined:
+                continue
+            if ptx.is_config or out["valid"][ptx.idx]:
+                ptx.code = C.VALID
+            else:
+                ptx.code = (
+                    C.PHANTOM_READ_CONFLICT
+                    if out["phantom"][ptx.idx]
+                    else C.MVCC_READ_CONFLICT
+                )
+
         tx_filter = bytes(ptx.code for ptx in txs)
         batch, history = self._build_updates(block.header.number, txs)
         return tx_filter, batch, history
@@ -445,22 +604,55 @@ class DefaultValidation(ValidationPlugin):
             self._plan_cache[policy] = plan
         return plan
 
+    def _match_row(self, plan: pol.BatchPlan, serialized: bytes, ident):
+        """Memoized principal-match row for one endorser identity —
+        a block re-presents the same few certs thousands of times."""
+        cache = getattr(plan, "_row_cache", None)
+        if cache is None:
+            cache = plan._row_cache = {}
+        hit = cache.get(serialized)
+        if hit is not None and hit[0] is ident:
+            return hit[1]
+        # pin the Identity object in the entry: a hit requires the SAME
+        # object, so an MSP-cache invalidation (new Identity instances)
+        # can never be served a stale principal-match row
+        row = np.array([p.matched_by(ident) for p in plan.principals], bool)
+        cache[serialized] = (ident, row)
+        return row
+
     def validate_batch_group(self, ctx: BlockValidationCtx, group):
-        out = []
-        for ptx, ns in group:
+        """ONE vectorized policy reduction per distinct policy over all
+        its (tx, namespace) entries — the per-tx closure walk of the
+        reference (cauthdsl.go:39) becomes a [T, S, P] count reduction;
+        the exact consumption interpreter only runs for the rare rows
+        where a signature matches two distinct principals."""
+        out = [False] * len(group)
+        by_policy: dict[int, list] = {}
+        policies: dict[int, object] = {}
+        for idx, (ptx, ns) in enumerate(group):
             info = ctx.policy_provider.info(ns)
-            plan = self._plan(info.policy)
-            idents = [ident for (_, ident) in ptx.endorsements]
-            m = pol.match_matrix(idents, plan.principals)
-            valid = np.array(
-                [ctx.sig_valid[i] for i in ptx.endo_item_idx], bool
-            )
-            m = m & valid[:, None] if len(idents) else m
-            if plan.consumption_safe(m):
-                ok = plan.evaluate_counts(m)
-            else:
-                ok = pol.evaluate(info.policy, m)
-            out.append(bool(ok))
+            key = id(info.policy)
+            policies[key] = info.policy
+            by_policy.setdefault(key, []).append((idx, ptx))
+        for key, entries in by_policy.items():
+            policy = policies[key]
+            plan = self._plan(policy)
+            P = len(plan.principals)
+            T = len(entries)
+            S = max((len(p.endorsements) for _, p in entries), default=0) or 1
+            M = np.zeros((T, S, P), bool)
+            for t, (_, ptx) in enumerate(entries):
+                for s, (ser, ident) in enumerate(ptx.endorsements):
+                    if ctx.sig_valid[ptx.endo_item_idx[s]]:
+                        M[t, s] = self._match_row(plan, ser, ident)
+            safe = plan.consumption_safe_batch(M)
+            ok = plan.evaluate_counts_batch(M)
+            for t, (idx, ptx) in enumerate(entries):
+                if safe[t]:
+                    out[idx] = bool(ok[t])
+                else:
+                    m = M[t, : len(ptx.endorsements)]
+                    out[idx] = bool(pol.evaluate(policy, m))
         return out
 
 

@@ -187,9 +187,13 @@ def extract_envelope(block: common_pb2.Block, idx: int) -> common_pb2.Envelope:
     return unmarshal(common_pb2.Envelope, block.data.data[idx])
 
 
-def extract_action(env: common_pb2.Envelope):
+def extract_action(env: common_pb2.Envelope, parsed=None):
     """Envelope → (channel_header, signature_header, ChaincodeActionPayload,
     ProposalResponsePayload, ChaincodeAction) for an endorser tx.
+
+    ``parsed``: optional already-decoded (payload, ch, sh) triple — the
+    validator's parse phase decodes them once for the signature batch
+    and must not pay the unmarshal again per tx.
 
     Raises TxParseError with the matching TxValidationCode on malformed
     structures (reference: core/common/validation/msgvalidation.go:248).
@@ -198,9 +202,12 @@ def extract_action(env: common_pb2.Envelope):
     if not env.payload:
         raise TxParseError(C.NIL_ENVELOPE, "empty payload")
     try:
-        payload = unmarshal(common_pb2.Payload, env.payload)
-        ch = unmarshal(common_pb2.ChannelHeader, payload.header.channel_header)
-        sh = unmarshal(common_pb2.SignatureHeader, payload.header.signature_header)
+        if parsed is not None:
+            payload, ch, sh = parsed
+        else:
+            payload = unmarshal(common_pb2.Payload, env.payload)
+            ch = unmarshal(common_pb2.ChannelHeader, payload.header.channel_header)
+            sh = unmarshal(common_pb2.SignatureHeader, payload.header.signature_header)
     except Exception as e:
         raise TxParseError(C.BAD_PAYLOAD, f"bad payload: {e}") from e
     if ch.type != common_pb2.HeaderType.ENDORSER_TRANSACTION:

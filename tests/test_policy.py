@@ -189,3 +189,50 @@ def test_nested_repeated_principals_across_gates(rng):
     assert plan.evaluate_counts(m) == pol.evaluate(rule, m) == False  # noqa: E712
     plan, m = _sat(rule, [FakeIdentity("A"), FakeIdentity("A")])
     assert plan.evaluate_counts(m) == pol.evaluate(rule, m) == True  # noqa: E712
+
+
+def test_three_policy_implementations_agree(rng):
+    """The consumption-count semantics exist in three places — the
+    BatchPlan numpy batch path (the source of truth), the scalar
+    wrappers, and the device kernel in peer/device_block._policy_reduce.
+    Pin them together on randomized policies and match matrices."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from fabric_tpu.crypto import policy as pol
+    from fabric_tpu.peer import device_block as db
+
+    def random_policy(depth=0):
+        if depth >= 2 or rng.random() < 0.4:
+            org = f"Org{int(rng.integers(1, 4))}MSP"
+            role = ["member", "peer", "admin"][int(rng.integers(0, 3))]
+            return pol.SignedBy(pol.Principal(org, role))
+        k = int(rng.integers(2, 4))
+        rules = tuple(random_policy(depth + 1) for _ in range(k))
+        return pol.NOutOf(int(rng.integers(1, k + 1)), rules)
+
+    for trial in range(25):
+        rule = random_policy()
+        plan = pol.compile_plan(rule)
+        P = len(plan.principals)
+        T, S = 5, 4
+        M = rng.random((T, S, P)) < 0.45
+
+        ok_batch = plan.evaluate_counts_batch(M)
+        safe_batch = plan.consumption_safe_batch(M)
+        # scalar wrappers
+        for t in range(T):
+            assert plan.evaluate_counts(M[t]) == bool(ok_batch[t])
+            assert plan.consumption_safe(M[t]) == bool(safe_batch[t])
+            # exact interpreter agrees whenever safe
+            if safe_batch[t]:
+                assert pol.evaluate(rule, M[t]) == bool(ok_batch[t])
+        # device kernel: identity gather wired to an all-valid sig batch
+        sig = db.plan_sig(plan, T, S)
+        sig_padded = jnp.asarray(np.append(np.ones(T * S, bool), False))
+        endo_idx = jnp.asarray(np.arange(T * S, dtype=np.int32).reshape(T, S))
+        ok_dev, safe_dev = db._policy_reduce(
+            sig_padded, jnp.asarray(M), endo_idx, sig
+        )
+        assert [bool(v) for v in np.asarray(ok_dev)] == [bool(v) for v in ok_batch]
+        assert [bool(v) for v in np.asarray(safe_dev)] == [bool(v) for v in safe_batch]
