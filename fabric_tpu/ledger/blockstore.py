@@ -42,6 +42,11 @@ class BlockStore:
         self._idx.execute(
             "CREATE INDEX IF NOT EXISTS blocks_hash ON blocks(hash)"
         )
+        self._idx.execute(
+            "CREATE TABLE IF NOT EXISTS bootstrap ("
+            " id INTEGER PRIMARY KEY CHECK (id = 0),"
+            " first_block INTEGER, prev_hash BLOB, commit_hash BLOB)"
+        )
         self._recover()
 
     # -- segment file plumbing --------------------------------------------
@@ -134,12 +139,75 @@ class BlockStore:
     @property
     def height(self) -> int:
         row = self._idx.execute("SELECT MAX(num) FROM blocks").fetchone()
-        return (row[0] + 1) if row[0] is not None else 0
+        if row[0] is not None:
+            return row[0] + 1
+        boot = self._idx.execute(
+            "SELECT first_block FROM bootstrap WHERE id=0"
+        ).fetchone()
+        return boot[0] if boot else 0
+
+    def bootstrap_from_snapshot(self, first_block: int, prev_hash: bytes,
+                                txid_codes, commit_hash: bytes = b"") -> None:
+        """Position an EMPTY store at a snapshot boundary: height
+        becomes ``first_block``, the snapshot's committed txids (WITH
+        their original validation codes) join the dup-check index, and
+        the chain/commit-hash anchors persist for reopen + continuity
+        checks (blkstorage bootstrapping snapshot,
+        kvledger/snapshot.go:222 CreateFromSnapshot)."""
+        if self.height != 0:
+            raise ValueError("bootstrap requires an empty block store")
+        self._idx.execute(
+            "INSERT OR REPLACE INTO bootstrap VALUES (0, ?, ?, ?)",
+            (first_block, prev_hash, commit_hash),
+        )
+        self._idx.executemany(
+            "INSERT OR IGNORE INTO txids VALUES (?,?,?,?)",
+            ((t, -1, -1, c) for t, c in txid_codes),
+        )
+        self._idx.commit()
+
+    def bootstrap_info(self):
+        """→ (first_block, prev_hash, commit_hash) or None."""
+        boot = self._idx.execute(
+            "SELECT first_block, prev_hash, commit_hash FROM bootstrap WHERE id=0"
+        ).fetchone()
+        return tuple(boot) if boot else None
+
+    def iter_txids(self):
+        """All committed txids in sorted order (snapshot export)."""
+        for (t,) in self._idx.execute("SELECT txid FROM txids ORDER BY txid"):
+            yield t
+
+    def iter_txid_codes(self):
+        """(txid, validation_code) in sorted order — codes survive the
+        snapshot so a joined peer's tx-status queries stay truthful."""
+        for t, c in self._idx.execute(
+            "SELECT txid, code FROM txids ORDER BY txid"
+        ):
+            yield t, int(c)
+
+    def expected_prev_hash(self) -> bytes | None:
+        """Hash the next block's previous_hash must carry, when known
+        (last stored block, or the snapshot anchor)."""
+        h = self.height
+        row = self._idx.execute("SELECT MAX(num) FROM blocks").fetchone()
+        if row[0] is not None:
+            return self._idx.execute(
+                "SELECT hash FROM blocks WHERE num=?", (row[0],)
+            ).fetchone()[0]
+        boot = self.bootstrap_info()
+        return boot[1] if boot else None
 
     def add_block(self, block: common_pb2.Block) -> None:
         if block.header.number != self.height:
             raise ValueError(
                 f"block number {block.header.number} != height {self.height}"
+            )
+        want_prev = self.expected_prev_hash()
+        if want_prev and block.header.previous_hash != want_prev:
+            raise ValueError(
+                f"block {block.header.number} previous_hash does not "
+                "extend this chain"
             )
         data = block.SerializeToString()
         if self._fh.tell() + len(data) > _SEGMENT_MAX and self._fh.tell() > 0:

@@ -59,6 +59,11 @@ class KVLedger:
         if h == 0:
             return None
         blk = self.blocks.get_block(h - 1)
+        if blk is None:
+            # snapshot-bootstrapped store with no post-snapshot blocks
+            # yet: the chain anchor persists in the bootstrap record
+            boot = self.blocks.bootstrap_info()
+            return (boot[2] or None) if boot else None
         idx = common_pb2.BlockMetadataIndex.COMMIT_HASH
         if len(blk.metadata.metadata) > idx and blk.metadata.metadata[idx]:
             return blk.metadata.metadata[idx]
@@ -127,6 +132,11 @@ class KVLedger:
     @property
     def commit_hash(self) -> bytes | None:
         return self._commit_hash
+
+    def bootstrap_commit_hash(self, h: bytes | None) -> None:
+        """Seed the commit-hash chain when joining from a snapshot
+        (the chain continues from the snapshot's last commit hash)."""
+        self._commit_hash = h
 
     def close(self):
         self.blocks.close()

@@ -75,6 +75,12 @@ class VersionedDB:
                 out[(ns, key)] = v
         return out
 
+    def iter_all(self):
+        """Yield ((ns, key), VersionedValue) over the WHOLE state in
+        (ns, key) order — deterministic for snapshot hashing
+        (kvledger/snapshot.go export ordering)."""
+        raise NotImplementedError
+
     def get_state_range(self, ns: str, start: str, end: str, limit: int = 0):
         """Yield (key, VersionedValue) for start <= key < end in key
         order ('' end = unbounded)."""
@@ -105,6 +111,10 @@ class MemVersionedDB(VersionedDB):
             keys = sorted(k for (n, k) in self._data if n == ns)
             self._sorted_cache[ns] = keys
         return keys
+
+    def iter_all(self):
+        for k in sorted(self._data):
+            yield k, self._data[k]
 
     def get_state_range(self, ns, start, end, limit=0):
         keys = self._sorted_keys(ns)
@@ -197,6 +207,12 @@ class SqliteVersionedDB(VersionedDB):
             if row:
                 out[(ns, key)] = (row[0], row[1])
         return out
+
+    def iter_all(self):
+        q = ("SELECT ns, key, value, metadata, block, txnum FROM state "
+             "ORDER BY ns, key")
+        for ns, key, value, md, blk, txn in self._conn.execute(q):
+            yield (ns, key), VersionedValue(value, md, (blk, txn))
 
     def get_state_range(self, ns, start, end, limit=0):
         q = "SELECT key, value, metadata, block, txnum FROM state WHERE ns=? AND key>=?"

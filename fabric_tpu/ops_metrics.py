@@ -1,0 +1,173 @@
+"""Metrics SPI + registry (the vendored fabric-lib-go metrics analog).
+
+Reference: metrics.Provider → Counter/Gauge/Histogram with
+``With(label pairs...)`` (fabric-lib-go/common/metrics/provider.go),
+~80 documented metrics (docs/source/metrics_reference.rst), exposed by
+the operations server at /metrics (core/operations/system.go:89-209).
+
+Design: one process-wide registry of typed instruments; label variants
+materialize lazily.  Rendering follows the Prometheus text exposition
+format, so any Prometheus scraper works against the operations server
+(fabric_tpu.opsserver).  No external client library — the framework is
+dependency-free here by design.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, registry: "Registry"):
+        self.name, self.help = name, help_
+        self._values: dict[tuple, float] = {}
+        self._lock = registry._lock
+
+    def add(self, delta: float = 1.0, **labels) -> None:
+        k = _label_key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + delta
+
+    def value(self, **labels) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str, registry: "Registry"):
+        self.name, self.help = name, help_
+        self._values: dict[tuple, float] = {}
+        self._lock = registry._lock
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def add(self, delta: float = 1.0, **labels) -> None:
+        k = _label_key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + delta
+
+    def value(self, **labels) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0, math.inf,
+)
+
+
+@dataclass
+class _Hist:
+    counts: list = field(default_factory=lambda: [0] * len(_DEFAULT_BUCKETS))
+    total: float = 0.0
+    n: int = 0
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, registry: "Registry",
+                 buckets=_DEFAULT_BUCKETS):
+        self.name, self.help = name, help_
+        self.buckets = tuple(buckets)
+        self._values: dict[tuple, _Hist] = {}
+        self._lock = registry._lock
+
+    def observe(self, value: float, **labels) -> None:
+        k = _label_key(labels)
+        with self._lock:
+            h = self._values.get(k)
+            if h is None:
+                h = self._values[k] = _Hist(counts=[0] * len(self.buckets))
+            h.total += value
+            h.n += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    h.counts[i] += 1
+
+    def time(self, **labels):
+        """Context manager observing elapsed seconds."""
+        hist = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                hist.observe(time.perf_counter() - self.t0, **labels)
+                return False
+
+        return _Timer()
+
+
+class Registry:
+    """Process-local metric registry; render() emits Prometheus text."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(name, help_, Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(name, help_, Gauge)
+
+    def histogram(self, name: str, help_: str = "") -> Histogram:
+        return self._get(name, help_, Histogram)
+
+    def _get(self, name, help_, cls):
+        m = self._metrics.get(name)
+        if m is None:
+            m = self._metrics[name] = cls(name, help_, self)
+        if not isinstance(m, cls):
+            raise TypeError(f"metric {name} already registered as {type(m).__name__}")
+        return m
+
+    @staticmethod
+    def _fmt_labels(key: tuple, extra: str = "") -> str:
+        parts = [f'{k}="{v}"' for k, v in key]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def render(self) -> str:
+        out = []
+        with self._lock:
+            for name, m in sorted(self._metrics.items()):
+                if m.help:
+                    out.append(f"# HELP {name} {m.help}")
+                if isinstance(m, Counter):
+                    out.append(f"# TYPE {name} counter")
+                    for k, v in sorted(m._values.items()):
+                        out.append(f"{name}{self._fmt_labels(k)} {v}")
+                elif isinstance(m, Gauge):
+                    out.append(f"# TYPE {name} gauge")
+                    for k, v in sorted(m._values.items()):
+                        out.append(f"{name}{self._fmt_labels(k)} {v}")
+                elif isinstance(m, Histogram):
+                    out.append(f"# TYPE {name} histogram")
+                    for k, h in sorted(m._values.items()):
+                        for b, c in zip(m.buckets, h.counts):
+                            le = "+Inf" if math.isinf(b) else repr(b)
+                            out.append(
+                                f"{name}_bucket"
+                                f"{self._fmt_labels(k, f'le=\"{le}\"')} {c}"
+                            )
+                        out.append(f"{name}_sum{self._fmt_labels(k)} {h.total}")
+                        out.append(f"{name}_count{self._fmt_labels(k)} {h.n}")
+        return "\n".join(out) + "\n"
+
+
+_global = Registry()
+
+
+def global_registry() -> Registry:
+    return _global

@@ -1,0 +1,144 @@
+"""Operations HTTP server: /metrics, /healthz, /logspec, /version.
+
+Reference: core/operations/system.go:89-209 — every peer and orderer
+process runs one (internal/peer/node/start.go:232-241,
+orderer/common/server/main.go:94-101).  Health checkers register by
+name and are polled on /healthz (docker/couchdb register themselves in
+the reference; here ledgers, raft chains and the RPC server do).
+/logspec GET/PUT adjusts live logging levels (flogging's
+FABRIC_LOGGING_SPEC semantics over python logging)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+from fabric_tpu.ops_metrics import Registry, global_registry
+
+VERSION = "fabric-tpu 0.3.0"
+
+
+class HealthRegistry:
+    def __init__(self):
+        self._checkers: dict[str, object] = {}
+
+    def register(self, name: str, checker) -> None:
+        """checker: zero-arg callable → None/True if healthy, raises or
+        returns a failure reason string otherwise."""
+        self._checkers[name] = checker
+
+    def check(self) -> tuple[bool, dict]:
+        failures = {}
+        for name, fn in self._checkers.items():
+            try:
+                res = fn()
+                if res not in (None, True):
+                    failures[name] = str(res)
+            except Exception as e:
+                failures[name] = f"{type(e).__name__}: {e}"
+        return (not failures), failures
+
+
+class OperationsServer:
+    """Minimal asyncio HTTP/1.1 server (stdlib-only on purpose: the
+    control plane must not drag in web frameworks)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 registry: Registry | None = None,
+                 health: HealthRegistry | None = None):
+        self.host, self.port = host, port
+        self.registry = registry or global_registry()
+        self.health = health or HealthRegistry()
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._on_conn, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter):
+        try:
+            req = await reader.readline()
+            parts = req.decode("latin1").split()
+            if len(parts) < 2:
+                return
+            method, path = parts[0], parts[1]
+            headers = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode("latin1").partition(":")
+                headers[k.strip().lower()] = v.strip()
+            body = b""
+            n = int(headers.get("content-length", "0") or "0")
+            if n:
+                body = await reader.readexactly(n)
+            status, ctype, payload = self._route(method, path, body)
+            writer.write(
+                b"HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+                b"Content-Length: %d\r\nConnection: close\r\n\r\n"
+                % (status, b"OK" if status == 200 else b"ERR",
+                   ctype.encode(), len(payload))
+            )
+            writer.write(payload)
+            await writer.drain()
+        except Exception:
+            pass
+        finally:
+            writer.close()
+
+    def _route(self, method: str, path: str, body: bytes):
+        if path == "/metrics":
+            return 200, "text/plain; version=0.0.4", self.registry.render().encode()
+        if path == "/healthz":
+            ok, failures = self.health.check()
+            payload = json.dumps(
+                {"status": "OK" if ok else "Service Unavailable",
+                 "failed_checks": [
+                     {"component": k, "reason": v} for k, v in failures.items()
+                 ]}
+            ).encode()
+            return (200 if ok else 503), "application/json", payload
+        if path == "/version":
+            return 200, "application/json", json.dumps(
+                {"Version": VERSION}
+            ).encode()
+        if path == "/logspec":
+            if method == "GET":
+                root = logging.getLogger("fabric_tpu")
+                return 200, "application/json", json.dumps(
+                    {"spec": logging.getLevelName(
+                        root.level or logging.WARNING)}
+                ).encode()
+            if method == "PUT":
+                try:
+                    spec = json.loads(body)["spec"]
+                    apply_logspec(spec)
+                    return 204, "application/json", b""
+                except Exception as e:
+                    return 400, "application/json", json.dumps(
+                        {"error": str(e)}
+                    ).encode()
+        return 404, "application/json", b'{"error": "not found"}'
+
+
+def apply_logspec(spec: str) -> None:
+    """FABRIC_LOGGING_SPEC-style: 'info' or
+    'warning:fabric_tpu.peer=debug:fabric_tpu.ordering=error'."""
+    parts = [p for p in spec.split(":") if p]
+    for p in parts:
+        if "=" in p:
+            name, _, level = p.partition("=")
+            logging.getLogger(name).setLevel(level.upper())
+        else:
+            logging.getLogger("fabric_tpu").setLevel(p.upper())

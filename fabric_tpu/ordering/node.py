@@ -114,7 +114,7 @@ class OrdererNode:
 
     # -- services -----------------------------------------------------------------
 
-    async def start(self):
+    async def start(self, operations_port: int | None = None):
         self.server.register_unary("Broadcast", self._on_broadcast)
         self.server.register("Deliver", self._on_deliver)
         self.server.register("Step", self._on_step)
@@ -122,9 +122,28 @@ class OrdererNode:
         self.server.register_unary("Info", self._on_info)
         await self.server.start()
         self.port = self.server.port
+        self.operations = None
+        if operations_port is not None:
+            from fabric_tpu.opsserver import HealthRegistry, OperationsServer
+
+            health = HealthRegistry()
+            for cid, chain in self.chains.items():
+                health.register(
+                    f"consensus:{cid}",
+                    (lambda c: (
+                        lambda: None if c.raft.state in ("leader", "follower",
+                                                         "candidate")
+                        else "stopped"
+                    ))(chain),
+                )
+            self.operations = await OperationsServer(
+                port=operations_port, health=health
+            ).start()
         return self
 
     async def stop(self):
+        if getattr(self, "operations", None) is not None:
+            await self.operations.stop()
         for chain in self.chains.values():
             chain.stop()
         for task in self._peer_clients.values():

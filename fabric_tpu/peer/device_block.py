@@ -33,7 +33,6 @@ import jax.numpy as jnp
 
 from fabric_tpu.crypto import policy as pol
 from fabric_tpu.ops import mvcc as mvcc_ops
-from fabric_tpu.utils.batching import next_pow2
 
 
 @dataclass(frozen=True)
@@ -83,8 +82,7 @@ def _policy_reduce(sig_padded, match, endo_idx, sig: PlanSig):
     return vals[-1], safe
 
 
-def build_stage2(t_bucket: int, n_sig: int, group_sigs: tuple,
-                 mvcc_shapes: tuple):
+def build_stage2(t_bucket: int, n_sig: int, group_sigs: tuple):
     """→ jitted stage2(sig_valid, creator_idx, structural_ok,
     *per-group (match, endo_idx, tx_of), *mvcc_arrays, ) → packed int8.
 
@@ -145,9 +143,7 @@ class DeviceBlockPipeline:
         key = (t_bucket, n_sig, gsigs, mshapes)
         fn = self._cache.get(key)
         if fn is None:
-            fn = self._cache[key] = build_stage2(
-                t_bucket, n_sig, gsigs, mshapes
-            )
+            fn = self._cache[key] = build_stage2(t_bucket, n_sig, gsigs)
         args = [handle.device_out, jnp.asarray(creator_idx),
                 jnp.asarray(structural_ok)]
         for _, match, endo_idx, tx_of in groups:

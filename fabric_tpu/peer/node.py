@@ -50,12 +50,20 @@ class PeerChannel:
 
     def __init__(self, channel_id: str, data_dir: str, msp_manager=None,
                  policy_provider: PolicyProvider | None = None, state_db=None,
-                 config_processor=None, genesis_block=None):
+                 config_processor=None, genesis_block=None,
+                 snapshot_dir: str | None = None):
         self.id = channel_id
-        self.ledger = KVLedger(data_dir, state_db=state_db or MemVersionedDB())
+        snap_meta = None
+        if snapshot_dir is not None:
+            from fabric_tpu.ledger.snapshot import create_from_snapshot
+
+            self.ledger, snap_meta = create_from_snapshot(
+                snapshot_dir, data_dir, state_db=state_db or MemVersionedDB()
+            )
+        else:
+            self.ledger = KVLedger(data_dir, state_db=state_db or MemVersionedDB())
+        config = None
         if genesis_block is not None:
-            from fabric_tpu import channelconfig as chancfg
-            from fabric_tpu.peer.lifecycle import LifecyclePolicyProvider
             from fabric_tpu.protos import configtx_pb2
 
             env = protoutil.unmarshal(
@@ -63,7 +71,18 @@ class PeerChannel:
             )
             payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
             cfg_env = protoutil.unmarshal(configtx_pb2.ConfigEnvelope, payload.data)
-            bundle = chancfg.Bundle(channel_id, cfg_env.config)
+            config = cfg_env.config
+        elif snap_meta is not None and snap_meta.get("config"):
+            from fabric_tpu.protos import configtx_pb2
+
+            config = protoutil.unmarshal(
+                configtx_pb2.Config, bytes.fromhex(snap_meta["config"])
+            )
+        if config is not None:
+            from fabric_tpu import channelconfig as chancfg
+            from fabric_tpu.peer.lifecycle import LifecyclePolicyProvider
+
+            bundle = chancfg.Bundle(channel_id, config)
             config_processor = config_processor or chancfg.ConfigTxProcessor(bundle)
             self.processor = config_processor
             msp_manager = bundle.msp_manager
@@ -74,7 +93,7 @@ class PeerChannel:
                         self.processor.bundle.application_policy_ast(name)
                     ),
                 )
-            if self.ledger.blocks.height == 0:
+            if genesis_block is not None and self.ledger.blocks.height == 0:
                 from fabric_tpu.ledger.statedb import UpdateBatch
 
                 gb = common_pb2.Block()
@@ -86,7 +105,7 @@ class PeerChannel:
             self.processor = config_processor
         if msp_manager is None or policy_provider is None:
             raise ValueError(
-                "join without genesis_block requires explicit "
+                "join without genesis_block/snapshot requires explicit "
                 "msp_manager and policy_provider"
             )
         self.validator = BlockValidator(
@@ -108,13 +127,42 @@ class PeerChannel:
         first use) — it runs in a worker thread so the node's RPC
         services stay responsive (the reference's validator pool,
         v20/validator.go:193)."""
+        import time as _time
+
+        from fabric_tpu.ops_metrics import global_registry
+
+        reg = global_registry()
         loop = asyncio.get_event_loop()
         async with self.commit_lock:
+            t0 = _time.perf_counter()
             flt, batch, history = await loop.run_in_executor(
                 None, self.validator.validate, block
             )
+            t1 = _time.perf_counter()
             self.ledger.commit_block(block, flt, batch, history)
+            t2 = _time.perf_counter()
             self._post_commit(block, flt, batch)
+        # the reference's commit-path breakdown (kv_ledger.go:712-727)
+        reg.histogram(
+            "ledger_block_processing_time",
+            "full StoreBlock wall clock per block (s)",
+        ).observe(t2 - t0, channel=self.id)
+        reg.histogram(
+            "validation_duration", "validate phase per block (s)"
+        ).observe(t1 - t0, channel=self.id)
+        reg.histogram(
+            "ledger_statedb_commit_time", "storage commit per block (s)"
+        ).observe(t2 - t1, channel=self.id)
+        reg.gauge(
+            "ledger_blockchain_height", "committed block height"
+        ).set(self.height, channel=self.id)
+        n_valid = sum(1 for c in flt if c == 0)
+        reg.counter(
+            "ledger_transaction_count", "committed txs by validity"
+        ).add(n_valid, channel=self.id, status="valid")
+        reg.counter(
+            "ledger_transaction_count", "committed txs by validity"
+        ).add(len(flt) - n_valid, channel=self.id, status="invalid")
         self._height_changed.set()
         self._height_changed = asyncio.Event()
         return flt
@@ -183,6 +231,8 @@ class PeerChannel:
         """Background commit driver with orderer failover."""
         import logging
 
+        self.orderer_addrs = list(orderer_addrs)  # gateway Submit uses these
+
         log = logging.getLogger("fabric_tpu.peer.deliver")
 
         async def loop():
@@ -200,6 +250,26 @@ class PeerChannel:
                     await asyncio.sleep(0.2)
 
         self._deliver_task = asyncio.ensure_future(loop())
+
+    async def snapshot(self, out_dir: str) -> dict:
+        """Export a ledger snapshot at the current height, serialized
+        against commits (snapshot_mgmt.go commitStart/commitDone)."""
+        from fabric_tpu.ledger.snapshot import generate_snapshot
+
+        cfg = b""
+        proc = getattr(self, "processor", None)
+        if proc is not None and hasattr(proc, "bundle"):
+            cfg = proc.bundle.config.SerializeToString()
+        loop = asyncio.get_event_loop()
+        async with self.commit_lock:
+            # worker thread: a large state export must not freeze the
+            # node's RPC services for its duration
+            return await loop.run_in_executor(
+                None,
+                lambda: generate_snapshot(
+                    self.ledger, out_dir, channel_id=self.id, config_bytes=cfg
+                ),
+            )
 
     async def wait_height(self, h: int, timeout: float = 30.0):
         loop = asyncio.get_event_loop()
@@ -228,33 +298,57 @@ class PeerNode:
         self.runtime = runtime or ChaincodeRuntime()
         self.channels: dict[str, PeerChannel] = {}
         self.server = RpcServer(host, port)
+        from fabric_tpu.discovery import PeerRegistry
+
+        self.registry = PeerRegistry()  # org → endorsing peers (gateway/discovery)
 
     def join_channel(self, channel_id: str, policy_provider: PolicyProvider | None = None,
                      state_db=None, config_processor=None,
-                     genesis_block=None) -> PeerChannel:
+                     genesis_block=None, snapshot_dir=None) -> PeerChannel:
+        anchored = genesis_block is not None or snapshot_dir is not None
         ch = PeerChannel(
             channel_id, f"{self.dir}/{channel_id}",
-            None if genesis_block is not None else self.msp,
+            None if anchored else self.msp,
             policy_provider, state_db, config_processor,
-            genesis_block=genesis_block,
+            genesis_block=genesis_block, snapshot_dir=snapshot_dir,
         )
         self.channels[channel_id] = ch
         return ch
 
     # -- services ------------------------------------------------------------
 
-    async def start(self):
+    async def start(self, operations_port: int | None = None):
         self.server.register_unary("Endorse", self._on_endorse)
         self.server.register("DeliverBlocks", self._on_deliver_blocks)
         self.server.register_unary("Query", self._on_query)
         self.server.register_unary("Info", self._on_info)
+        self.server.register_unary("Discover", self._on_discover)
+        from fabric_tpu.peer import gateway as gw
+
+        self.gateway = gw.register(self)
         await self.server.start()
         self.port = self.server.port
+        self.operations = None
+        if operations_port is not None:
+            from fabric_tpu.opsserver import HealthRegistry, OperationsServer
+
+            health = HealthRegistry()
+            health.register("rpc_server", lambda: None if self.server._server else "down")
+            for cid, ch in self.channels.items():
+                health.register(
+                    f"ledger:{cid}",
+                    (lambda c: (lambda: None if c.height >= 0 else "bad"))(ch),
+                )
+            self.operations = await OperationsServer(
+                port=operations_port, health=health
+            ).start()
         return self
 
     async def stop(self):
         for ch in self.channels.values():
             ch.stop()
+        if getattr(self, "operations", None) is not None:
+            await self.operations.stop()
         await self.server.stop()
 
     async def _on_endorse(self, req: bytes) -> bytes:
@@ -319,3 +413,40 @@ class PeerNode:
         if chan is None:
             return json.dumps({"status": 404}).encode()
         return json.dumps({"status": 200, "height": chan.height}).encode()
+
+    async def _on_discover(self, req: bytes) -> bytes:
+        """Discovery queries: peers / config / endorsers per channel
+        (discovery/service.go analog over the node's registry +
+        channel bundles)."""
+        from fabric_tpu.discovery import DiscoveryService
+
+        q = json.loads(req)
+        channel = q.get("channel", "")
+
+        def bundle_for(ch_id):
+            ch = self.channels.get(ch_id)
+            proc = getattr(ch, "processor", None) if ch else None
+            return getattr(proc, "bundle", None)
+
+        def policy_for(ch_id, cc):
+            ch = self.channels.get(ch_id)
+            if ch is None:
+                return None
+            info = ch.validator.policies.info(cc)
+            return info.policy if info else None
+
+        svc = DiscoveryService(self.registry, bundle_for, policy_for)
+        kind = q.get("query", "peers")
+        if kind == "peers":
+            return json.dumps({"status": 200, "peers": svc.peers(channel)}).encode()
+        if kind == "config":
+            cfg = svc.config(channel)
+            if cfg is None:
+                return json.dumps({"status": 404}).encode()
+            return json.dumps({"status": 200, "config": cfg}).encode()
+        if kind == "endorsers":
+            desc = svc.endorsement_descriptor(channel, q["chaincode"])
+            if desc is None:
+                return json.dumps({"status": 404}).encode()
+            return json.dumps({"status": 200, "descriptor": desc}).encode()
+        return json.dumps({"status": 400, "error": f"unknown query {kind}"}).encode()

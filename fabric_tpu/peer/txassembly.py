@@ -80,13 +80,13 @@ def create_proposal_response(
     return resp
 
 
-def assemble_transaction(
+def prepare_transaction(
     prop: proposal_pb2.Proposal,
     responses: list[proposal_pb2.ProposalResponse],
-    creator_signer,
-) -> common_pb2.Envelope:
-    """Signed tx envelope from matching proposal responses
-    (protoutil CreateSignedTx semantics: all payloads must agree)."""
+) -> common_pb2.Payload:
+    """Unsigned tx payload from matching proposal responses — what the
+    gateway's Endorse returns for the CLIENT to sign (the gateway never
+    holds client keys; gateway/endorse.go prepared-transaction flow)."""
     if not responses:
         raise ValueError("no proposal responses")
     payloads = {r.payload for r in responses}
@@ -102,8 +102,17 @@ def assemble_transaction(
         cap.action.endorsements.add(
             endorser=r.endorsement.endorser, signature=r.endorsement.signature
         )
-    sh = protoutil.unmarshal(common_pb2.SignatureHeader, header.signature_header)
     tx = transaction_pb2.Transaction()
     tx.actions.add(header=header.signature_header, payload=cap.SerializeToString())
-    payload = common_pb2.Payload(header=header, data=tx.SerializeToString())
+    return common_pb2.Payload(header=header, data=tx.SerializeToString())
+
+
+def assemble_transaction(
+    prop: proposal_pb2.Proposal,
+    responses: list[proposal_pb2.ProposalResponse],
+    creator_signer,
+) -> common_pb2.Envelope:
+    """Signed tx envelope from matching proposal responses
+    (protoutil CreateSignedTx semantics: all payloads must agree)."""
+    payload = prepare_transaction(prop, responses)
     return protoutil.sign_envelope(payload, creator_signer)

@@ -266,12 +266,20 @@ class BlockValidator:
         (gossip/state/state.go:540, v20/validator.go:193)."""
         txs, items = self._parse(block)
         fetch = p256.verify_launch(items)
-        return txs, items, fetch
+        # the MSP manager the identities were validated against: a
+        # config tx in the PREVIOUS block may rotate membership between
+        # preprocess and validate — validate() detects and re-parses
+        return txs, items, fetch, self.msp
 
     def validate(self, block: common_pb2.Block, pre=None):
         if pre is None:
             pre = self.preprocess(block)
-        txs, items, fetch = pre
+        if pre[3] is not self.msp:
+            # membership rotated after this block was preprocessed
+            # (committed config tx): stale identity validations must
+            # not leak into endorsement decisions — redo the parse
+            pre = self.preprocess(block)
+        txs, items, fetch, _ = pre
         # parsed records for post-commit consumers (config rotation) —
         # the commit path is serialized per channel, so this is safe
         self.last_parsed = txs

@@ -1,0 +1,83 @@
+"""Operations surface tests: /metrics (Prometheus text), /healthz
+aggregation, /logspec live level changes, /version — and the commit
+path's metric emission (reference: core/operations/system.go:89-209,
+kv_ledger.go:712 commit breakdown)."""
+
+import asyncio
+import json
+import logging
+import urllib.request
+
+import pytest
+
+from fabric_tpu.ops_metrics import Registry
+from fabric_tpu.opsserver import HealthRegistry, OperationsServer, apply_logspec
+
+
+def run(coro, timeout=30):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+    finally:
+        loop.close()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return r.status, r.read()
+
+
+def test_registry_render():
+    reg = Registry()
+    c = reg.counter("endorse_total", "endorsements")
+    c.add(3, channel="ch1")
+    c.add(1, channel="ch2")
+    g = reg.gauge("height")
+    g.set(7, channel="ch1")
+    h = reg.histogram("commit_seconds")
+    h.observe(0.004, channel="ch1")
+    h.observe(2.0, channel="ch1")
+    text = reg.render()
+    assert 'endorse_total{channel="ch1"} 3.0' in text
+    assert '# TYPE endorse_total counter' in text
+    assert 'height{channel="ch1"} 7.0' in text
+    assert 'commit_seconds_count{channel="ch1"} 2' in text
+    assert 'commit_seconds_bucket{channel="ch1",le="0.005"} 1' in text
+    assert 'commit_seconds_bucket{channel="ch1",le="+Inf"} 2' in text
+
+
+def test_ops_endpoints():
+    async def scenario():
+        reg = Registry()
+        reg.counter("x_total").add(5)
+        health = HealthRegistry()
+        health.register("good", lambda: None)
+        srv = await OperationsServer(port=0, registry=reg, health=health).start()
+        loop = asyncio.get_event_loop()
+        st, body = await loop.run_in_executor(None, _get, srv.port, "/metrics")
+        assert st == 200 and b"x_total 5.0" in body
+        st, body = await loop.run_in_executor(None, _get, srv.port, "/healthz")
+        assert st == 200 and json.loads(body)["status"] == "OK"
+        st, body = await loop.run_in_executor(None, _get, srv.port, "/version")
+        assert st == 200 and "fabric-tpu" in json.loads(body)["Version"]
+
+        # a failing checker flips /healthz to 503
+        health.register("bad", lambda: "on fire")
+        try:
+            await loop.run_in_executor(None, _get, srv.port, "/healthz")
+            raise AssertionError("expected 503")
+        except Exception as e:
+            assert "503" in str(e)
+        await srv.stop()
+
+    run(scenario())
+
+
+def test_logspec():
+    apply_logspec("warning:fabric_tpu.peer=debug")
+    assert logging.getLogger("fabric_tpu").level == logging.WARNING
+    assert logging.getLogger("fabric_tpu.peer").level == logging.DEBUG
+    apply_logspec("error")
+    assert logging.getLogger("fabric_tpu").level == logging.ERROR
+    logging.getLogger("fabric_tpu.peer").setLevel(logging.NOTSET)
+    logging.getLogger("fabric_tpu").setLevel(logging.NOTSET)
