@@ -1,0 +1,344 @@
+"""fabric-tpu operator CLI (the cmd/{peer,orderer,configtxgen,
+cryptogen,osnadmin,discover,ledgerutil} surface in one binary).
+
+Usage: python -m fabric_tpu.cli <command> ...
+
+Commands:
+  cryptogen     generate org crypto material onto disk
+  configtxgen   genesis block from a JSON profile
+  orderer       run an ordering node (JSON config)
+  peer          run a peer node (JSON config)
+  osnadmin      orderer channel participation (join)
+  invoke/query  gateway client round trips
+  snapshot      request a ledger snapshot from a peer
+  ledgerutil    verify / compare ledger directories offline
+  discover      discovery queries against a peer
+
+Configs are JSON (the reference's YAML surface maps 1:1; no external
+YAML dependency)."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+def _cmd_cryptogen(args):
+    from fabric_tpu.crypto import cryptogen as cg
+
+    for spec in args.org:
+        msp_id, _, domain = spec.partition(":")
+        org = cg.generate_org(
+            msp_id, domain or f"{msp_id.lower()}.example.com",
+            peers=args.peers, orderers=args.orderers, users=args.users,
+        )
+        out = cg.write_org(org, args.output)
+        print(f"wrote {msp_id} material to {out}")
+
+
+def _cmd_configtxgen(args):
+    from fabric_tpu.crypto import cryptogen as cg
+    from fabric_tpu.tools import configtxgen as ctg
+
+    with open(args.profile) as f:
+        prof = json.load(f)
+    app_orgs = [
+        ctg.OrgProfile(o["msp_id"], cg.load_org_msp(o["dir"]),
+                       [tuple(a) for a in o.get("anchor_peers", [])])
+        for o in prof.get("application_orgs", [])
+    ]
+    profile = ctg.Profile(
+        prof["channel"], application_orgs=app_orgs,
+        consensus_type=prof.get("consensus", "raft"),
+        raft_consenters=[tuple(c) for c in prof.get("consenters", [])],
+        max_message_count=prof.get("max_message_count", 500),
+        batch_timeout_ms=prof.get("batch_timeout_ms", 200),
+    )
+    blk = ctg.genesis_block(profile)
+    with open(args.output, "wb") as f:
+        f.write(blk.SerializeToString())
+    print(f"wrote genesis block for {prof['channel']} to {args.output}")
+
+
+async def _run_orderer(cfg: dict):
+    from fabric_tpu.ordering.blockcutter import BatchConfig
+    from fabric_tpu.ordering.node import OrdererNode
+    from fabric_tpu.protos import common_pb2
+
+    node = OrdererNode(
+        cfg["id"], cfg["data_dir"],
+        {k: tuple(v) for k, v in cfg.get("cluster", {}).items()},
+        host=cfg.get("host", "127.0.0.1"), port=cfg.get("port", 0),
+        batch_config=BatchConfig(
+            max_message_count=cfg.get("max_message_count", 500),
+            batch_timeout_s=cfg.get("batch_timeout_s", 0.2),
+        ),
+    )
+    await node.start(operations_port=cfg.get("operations_port"))
+    print(f"orderer {node.id} serving on :{node.port}", flush=True)
+    for ch in cfg.get("channels", []):
+        genesis = None
+        if isinstance(ch, dict) and ch.get("genesis"):
+            genesis = common_pb2.Block()
+            with open(ch["genesis"], "rb") as f:
+                genesis.ParseFromString(f.read())
+            node.join_channel(ch["name"], genesis)
+        else:
+            node.join_channel(ch if isinstance(ch, str) else ch["name"])
+    await asyncio.Event().wait()
+
+
+async def _run_peer(cfg: dict):
+    from fabric_tpu.crypto import cryptogen as cg
+    from fabric_tpu.crypto.msp import MSPManager
+    from fabric_tpu.discovery import PeerInfo
+    from fabric_tpu.peer.ccaas import CCaaSProxy
+    from fabric_tpu.peer.chaincode import ChaincodeRuntime
+    from fabric_tpu.peer.node import PeerNode
+    from fabric_tpu.protos import common_pb2
+
+    signer = cg.load_signing_identity(cfg["msp_dir"], cfg["msp_id"])
+    mgr = MSPManager()
+    for org_dir in cfg.get("org_msps", []):
+        mgr.add(cg.load_org_msp(org_dir))
+    runtime = ChaincodeRuntime()
+    for cc in cfg.get("chaincodes", []):
+        runtime.register(
+            cc["name"], CCaaSProxy(cc["name"], cc["host"], cc["port"])
+        )
+    node = PeerNode(
+        cfg["id"], cfg["data_dir"], mgr, signer, runtime,
+        host=cfg.get("host", "127.0.0.1"), port=cfg.get("port", 0),
+    )
+    await node.start(operations_port=cfg.get("operations_port"))
+    print(f"peer {node.id} serving on :{node.port}", flush=True)
+    for p in cfg.get("peers", []):
+        node.registry.add(PeerInfo(p["msp_id"], p["host"], p["port"]))
+    for ch in cfg.get("channels", []):
+        genesis = None
+        if ch.get("genesis"):
+            genesis = common_pb2.Block()
+            with open(ch["genesis"], "rb") as f:
+                genesis.ParseFromString(f.read())
+        chan = node.join_channel(
+            ch["name"], genesis_block=genesis,
+            snapshot_dir=ch.get("snapshot_dir"),
+        )
+        orderers = [tuple(o) for o in ch.get("orderers", [])]
+        if orderers:
+            chan.start_deliver(orderers)
+        if ch.get("anti_entropy"):
+            node.gossip_service.start_anti_entropy(ch["name"])
+        node.gossip_service.start_reconciler(ch["name"])
+    await asyncio.Event().wait()
+
+
+def _cmd_node(args, runner):
+    with open(args.config) as f:
+        cfg = json.load(f)
+    try:
+        asyncio.run(runner(cfg))
+    except KeyboardInterrupt:
+        pass
+
+
+async def _run_chaincode(args):
+    from fabric_tpu.peer.ccaas import ChaincodeServer
+    from fabric_tpu.peer.chaincode import KVContract, MarblesContract
+
+    server = ChaincodeServer(port=args.port)
+    await server.start()
+    contract = {"kv": KVContract, "marbles": MarblesContract}[args.contract]()
+    server.register(args.name, contract)
+    print(f"chaincode {args.name} ({args.contract}) serving on :{server.port}",
+          flush=True)
+    await asyncio.Event().wait()
+
+
+def _cmd_osnadmin(args):
+    from fabric_tpu.comm.rpc import RpcClient
+    from fabric_tpu.protos import common_pb2
+
+    async def go():
+        cli = RpcClient(args.host, args.port)
+        await cli.connect()
+        blk = b""
+        if args.genesis:
+            with open(args.genesis, "rb") as f:
+                blk = f.read()
+        hdr = json.dumps({"channel": args.channel}).encode()
+        raw = await cli.unary(
+            "Join", len(hdr).to_bytes(4, "big") + hdr + blk
+        )
+        await cli.close()
+        print(raw.decode())
+
+    asyncio.run(go())
+
+
+def _cmd_invoke(args, evaluate=False):
+    from fabric_tpu.crypto import cryptogen as cg
+    from fabric_tpu.peer.gateway import GatewayClient
+
+    signer = cg.load_signing_identity(args.msp_dir, args.msp_id)
+
+    async def go():
+        gw = GatewayClient(args.host, args.port, signer)
+        try:
+            cc_args = [a.encode() for a in args.args]
+            if evaluate:
+                resp = await gw.evaluate(args.channel, args.chaincode, cc_args)
+                print(json.dumps({
+                    "status": resp.status,
+                    "payload": resp.payload.decode("utf-8", "replace"),
+                }))
+            else:
+                tx_id, status = await gw.submit_transaction(
+                    args.channel, args.chaincode, cc_args
+                )
+                print(json.dumps({"tx_id": tx_id, **(status or {})}))
+        finally:
+            await gw.close()
+
+    asyncio.run(go())
+
+
+def _cmd_ledgerutil(args):
+    from fabric_tpu.tools import ledgerutil as lu
+
+    if args.action == "verify":
+        res = lu.verify_ledger(args.dirs[0])
+        print(json.dumps({"height": res.height, "ok": res.ok,
+                          "errors": res.errors}))
+        sys.exit(0 if res.ok else 1)
+    res = lu.compare_ledgers(args.dirs[0], args.dirs[1])
+    print(json.dumps(res))
+    sys.exit(0 if res["identical"] else 1)
+
+
+def _cmd_snapshot(args):
+    from fabric_tpu.comm.rpc import RpcClient
+
+    async def go():
+        cli = RpcClient(args.host, args.port)
+        await cli.connect()
+        raw = await cli.unary("Snapshot", json.dumps(
+            {"channel": args.channel, "out_dir": args.output}
+        ).encode(), timeout=600.0)
+        await cli.close()
+        print(raw.decode())
+
+    asyncio.run(go())
+
+
+def _cmd_discover(args):
+    from fabric_tpu.comm.rpc import RpcClient
+
+    async def go():
+        cli = RpcClient(args.host, args.port)
+        await cli.connect()
+        q = {"query": args.query, "channel": args.channel}
+        if args.chaincode:
+            q["chaincode"] = args.chaincode
+        raw = await cli.unary("Discover", json.dumps(q).encode())
+        await cli.close()
+        print(raw.decode())
+
+    asyncio.run(go())
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="fabric-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("cryptogen", help="generate org crypto material")
+    c.add_argument("--org", action="append", required=True,
+                   metavar="MSPID:domain")
+    c.add_argument("--peers", type=int, default=1)
+    c.add_argument("--orderers", type=int, default=0)
+    c.add_argument("--users", type=int, default=1)
+    c.add_argument("--output", default="crypto-config")
+
+    c = sub.add_parser("configtxgen", help="genesis block from profile")
+    c.add_argument("--profile", required=True)
+    c.add_argument("--output", required=True)
+
+    c = sub.add_parser("orderer", help="run an ordering node")
+    c.add_argument("--config", required=True)
+
+    c = sub.add_parser("peer", help="run a peer node")
+    c.add_argument("--config", required=True)
+
+    c = sub.add_parser("chaincode", help="run a sample ccaas chaincode server")
+    c.add_argument("--name", required=True)
+    c.add_argument("--port", type=int, default=0)
+    c.add_argument("--contract", default="kv", choices=["kv", "marbles"])
+
+    c = sub.add_parser("osnadmin", help="orderer channel participation")
+    c.add_argument("--host", default="127.0.0.1")
+    c.add_argument("--port", type=int, required=True)
+    c.add_argument("--channel", required=True)
+    c.add_argument("--genesis")
+
+    for name in ("invoke", "query"):
+        c = sub.add_parser(name, help=f"gateway {name}")
+        c.add_argument("--host", default="127.0.0.1")
+        c.add_argument("--port", type=int, required=True)
+        c.add_argument("--channel", required=True)
+        c.add_argument("--chaincode", required=True)
+        c.add_argument("--msp-dir", required=True)
+        c.add_argument("--msp-id", required=True)
+        c.add_argument("args", nargs="+")
+
+    c = sub.add_parser("ledgerutil", help="offline ledger forensics")
+    c.add_argument("action", choices=["verify", "compare"])
+    c.add_argument("dirs", nargs="+")
+
+    c = sub.add_parser("snapshot", help="request a ledger snapshot")
+    c.add_argument("--host", default="127.0.0.1")
+    c.add_argument("--port", type=int, required=True)
+    c.add_argument("--channel", required=True)
+    c.add_argument("--output", required=True)
+
+    c = sub.add_parser("discover", help="discovery queries")
+    c.add_argument("--host", default="127.0.0.1")
+    c.add_argument("--port", type=int, required=True)
+    c.add_argument("--channel", required=True)
+    c.add_argument("--query", default="peers",
+                   choices=["peers", "config", "endorsers"])
+    c.add_argument("--chaincode")
+
+    args = p.parse_args(argv)
+    if args.cmd == "cryptogen":
+        _cmd_cryptogen(args)
+    elif args.cmd == "configtxgen":
+        _cmd_configtxgen(args)
+    elif args.cmd == "orderer":
+        from fabric_tpu import cli as _self  # noqa: F401
+
+        _cmd_node(args, _run_orderer)
+    elif args.cmd == "peer":
+        _cmd_node(args, _run_peer)
+    elif args.cmd == "chaincode":
+        try:
+            asyncio.run(_run_chaincode(args))
+        except KeyboardInterrupt:
+            pass
+    elif args.cmd == "osnadmin":
+        _cmd_osnadmin(args)
+    elif args.cmd == "invoke":
+        _cmd_invoke(args)
+    elif args.cmd == "query":
+        _cmd_invoke(args, evaluate=True)
+    elif args.cmd == "ledgerutil":
+        _cmd_ledgerutil(args)
+    elif args.cmd == "snapshot":
+        _cmd_snapshot(args)
+    elif args.cmd == "discover":
+        _cmd_discover(args)
+
+
+if __name__ == "__main__":
+    main()

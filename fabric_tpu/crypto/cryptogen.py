@@ -209,3 +209,52 @@ def write_msp_dir(base: str, enr: Enrollment, ca_pem: bytes) -> None:
         f.write(enr.key_pem)
     with open(os.path.join(base, "signcerts", "cert.pem"), "wb") as f:
         f.write(enr.cert_pem)
+
+
+def write_org(org: OrgMaterial, base: str) -> str:
+    """Full cryptogen output layout for one org:
+    <base>/<domain>/{ca/, msp/cacerts/, peers|orderers|users/<name>/msp/}.
+    Returns the org directory."""
+    root = os.path.join(base, org.domain)
+    os.makedirs(os.path.join(root, "ca"), exist_ok=True)
+    with open(os.path.join(root, "ca", "ca-cert.pem"), "wb") as f:
+        f.write(org.ca.cert_pem)
+    with open(os.path.join(root, "ca", "ca-key.pem"), "wb") as f:
+        f.write(_pem_key(org.ca.key))
+    os.makedirs(os.path.join(root, "msp", "cacerts"), exist_ok=True)
+    with open(os.path.join(root, "msp", "cacerts", "ca.pem"), "wb") as f:
+        f.write(org.ca.cert_pem)
+    with open(os.path.join(root, "msp", "config.json"), "w") as f:
+        import json
+
+        json.dump({"msp_id": org.msp_id, "node_ous": True}, f)
+    for group, members in (("nodes", org.nodes), ("users", org.users)):
+        for name, enr in members.items():
+            write_msp_dir(os.path.join(root, group, name, "msp"),
+                          enr, org.ca.cert_pem)
+    return root
+
+
+def load_org_msp(org_dir: str):
+    """→ crypto.msp.MSP from a write_org directory."""
+    import json
+
+    from fabric_tpu.crypto.msp import MSP
+
+    with open(os.path.join(org_dir, "msp", "config.json")) as f:
+        cfg = json.load(f)
+    with open(os.path.join(org_dir, "msp", "cacerts", "ca.pem"), "rb") as f:
+        root_pem = f.read()
+    return MSP(msp_id=cfg["msp_id"], root_certs=[root_pem],
+               node_ous=bool(cfg.get("node_ous", True)))
+
+
+def load_signing_identity(msp_dir: str, msp_id: str):
+    """→ SigningIdentity from an msp/ directory (keystore + signcerts)."""
+    from fabric_tpu.crypto.identity import SigningIdentity
+
+    with open(os.path.join(msp_dir, "keystore", "key.pem"), "rb") as f:
+        key_pem = f.read()
+    with open(os.path.join(msp_dir, "signcerts", "cert.pem"), "rb") as f:
+        cert_pem = f.read()
+    return SigningIdentity.from_pem(msp_id, key_pem, cert_pem)

@@ -67,7 +67,8 @@ class PeerInfo:
     msp_id: str
     host: str
     port: int
-    height: int = 0
+    height: int = 0              # max across channels (legacy/display)
+    heights: dict = field(default_factory=dict)  # channel -> height
 
 
 @dataclass

@@ -1,0 +1,324 @@
+"""Gossip layer: membership, private-data dissemination, anti-entropy
+state transfer, org-leader election.
+
+Reference mapping (SURVEY §2.6):
+* membership heartbeats (gossip/discovery/discovery_impl.go) →
+  ``GossipPing`` probes refreshing alive/height in the PeerRegistry;
+* pvtdata distribution at endorsement
+  (gossip/privdata/distributor.go) → ``PvtPush`` into peers' transient
+  stores; commit-time pulls (pull.go) → ``PvtPull`` answered from the
+  transient store or the committed pvtdata store;
+* state transfer / anti-entropy (gossip/state/state.go:584-610) → a
+  per-channel task comparing heights with members and pulling missing
+  block ranges over the peers' DeliverBlocks stream;
+* leader election (gossip/election) → deterministic lowest-endpoint
+  election among the org's ALIVE peers — the reference's static
+  org-leader mode (useLeaderElection=false) made automatic.
+
+Block dissemination itself stays pull-based (peers pull from the
+orderer or from each other), which the reference also supports; the
+epidemic push layer is intentionally replaced — on a TPU pod the
+bottleneck is the commit pipeline, not fan-out bandwidth.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+from fabric_tpu.comm.rpc import RpcClient
+
+log = logging.getLogger("fabric_tpu.gossip")
+
+
+def _enc_cleartext(cleartext: dict) -> dict:
+    return {
+        f"{ns}\x00{coll}": {
+            k: (v.hex() if v is not None else None) for k, v in kv.items()
+        }
+        for (ns, coll), kv in cleartext.items()
+    }
+
+
+def _dec_cleartext(data: dict) -> dict:
+    out = {}
+    for nscoll, kv in data.items():
+        ns, _, coll = nscoll.partition("\x00")
+        out[(ns, coll)] = {
+            k: (bytes.fromhex(v) if v is not None else None)
+            for k, v in kv.items()
+        }
+    return out
+
+
+class GossipService:
+    def __init__(self, node):
+        self.node = node
+        self._tasks: list[asyncio.Task] = []
+        self._clients: dict[tuple, RpcClient] = {}
+
+    # -- wiring ------------------------------------------------------------
+
+    def register(self) -> "GossipService":
+        s = self.node.server
+        s.register_unary("GossipPing", self._on_ping)
+        s.register_unary("PvtPush", self._on_pvt_push)
+        s.register_unary("PvtPull", self._on_pvt_pull)
+        for chan in self.node.channels.values():
+            chan.pvt_puller = self.pull_pvt_for(chan.id)
+        return self
+
+    async def _client(self, host, port) -> RpcClient:
+        key = (host, port)
+        cli = self._clients.get(key)
+        if cli is None or cli.conn is None or cli.conn.closed.is_set():
+            cli = RpcClient(host, port)
+            await cli.connect()
+            self._clients[key] = cli
+        return cli
+
+    async def stop(self):
+        for t in self._tasks:
+            t.cancel()
+        for cli in self._clients.values():
+            try:
+                await cli.close()
+            except Exception:
+                pass
+
+    # -- membership --------------------------------------------------------
+
+    async def _on_ping(self, req: bytes) -> bytes:
+        return json.dumps({
+            "alive": True,
+            "id": self.node.id,
+            "heights": {cid: ch.height for cid, ch in self.node.channels.items()},
+        }).encode()
+
+    async def probe_members(self) -> dict:
+        """Ping every registered peer; refresh alive/height state.
+        → {(host, port): ping-result | None}."""
+        out = {}
+        for org, peers in self.node.registry.peers.items():
+            for p in peers:
+                try:
+                    cli = await self._client(p.host, p.port)
+                    raw = await asyncio.wait_for(
+                        cli.unary("GossipPing", b"{}"), 3.0
+                    )
+                    res = json.loads(raw)
+                    p.heights = dict(res.get("heights", {}))
+                    p.height = max(p.heights.values(), default=0)
+                    out[(p.host, p.port)] = res
+                except Exception:
+                    out[(p.host, p.port)] = None
+        return out
+
+    def elect_leader(self, my_org_peers: list, my_endpoint: tuple) -> bool:
+        """Deterministic org-leader election: lowest (host, port) among
+        alive org peers + self wins (gossip/election analog)."""
+        candidates = [my_endpoint] + [
+            (p.host, p.port) for p in my_org_peers if p.height >= 0
+        ]
+        return min(candidates) == my_endpoint
+
+    # -- pvtdata dissemination --------------------------------------------
+
+    async def _on_pvt_push(self, req: bytes) -> bytes:
+        q = json.loads(req)
+        chan = self.node.channels.get(q["channel"])
+        if chan is None:
+            return b'{"status": 404}'
+        chan.transient.persist(
+            q["txid"], _dec_cleartext(q["data"]), int(q.get("height", 0))
+        )
+        return b'{"status": 200}'
+
+    async def _on_pvt_pull(self, req: bytes) -> bytes:
+        q = json.loads(req)
+        chan = self.node.channels.get(q["channel"])
+        if chan is None:
+            return b'{"status": 404}'
+        ns, coll = q["ns"], q["coll"]
+        # transient store first (endorsement-time data)
+        clear = chan.transient.get(q["txid"]).get((ns, coll))
+        if clear is None and "block" in q:
+            stored = chan.ledger.pvtdata.get_pvt_data(int(q["block"])).get(
+                (int(q["txnum"]), ns, coll)
+            )
+            if stored is not None:
+                from fabric_tpu.peer.transient import decode_kv
+
+                clear = decode_kv(stored)
+        if clear is None:
+            return b'{"status": 404}'
+        return json.dumps({
+            "status": 200,
+            "data": {k: (v.hex() if v is not None else None)
+                     for k, v in clear.items()},
+        }).encode()
+
+    async def push_pvt(self, channel: str, txid: str, cleartext: dict,
+                       height: int) -> None:
+        """Distribute endorsement-time pvt data to eligible peers
+        (distributor.go; eligibility = collection members — all
+        registry peers until collection configs narrow it)."""
+        payload = json.dumps({
+            "channel": channel, "txid": txid, "height": height,
+            "data": _enc_cleartext(cleartext),
+        }).encode()
+        for org, peers in self.node.registry.peers.items():
+            for p in peers:
+                try:
+                    cli = await self._client(p.host, p.port)
+                    await asyncio.wait_for(cli.unary("PvtPush", payload), 3.0)
+                except Exception as e:
+                    log.debug("pvt push to %s:%s failed: %s", p.host, p.port, e)
+
+    def pull_pvt_for(self, channel: str):
+        async def pull(txid, block_num, txnum, ns, coll):
+            req = json.dumps({
+                "channel": channel, "txid": txid, "block": block_num,
+                "txnum": txnum, "ns": ns, "coll": coll,
+            }).encode()
+            for org, peers in self.node.registry.peers.items():
+                for p in peers:
+                    try:
+                        cli = await self._client(p.host, p.port)
+                        raw = await asyncio.wait_for(
+                            cli.unary("PvtPull", req), 3.0
+                        )
+                        res = json.loads(raw)
+                        if res.get("status") == 200:
+                            return {
+                                k: (bytes.fromhex(v) if v is not None else None)
+                                for k, v in res["data"].items()
+                            }
+                    except Exception:
+                        continue
+            return None
+
+        return pull
+
+    # -- anti-entropy state transfer ---------------------------------------
+
+    async def _pull_blocks_from_peer(self, chan, host, port, stop_at: int):
+        cli = RpcClient(host, port)
+        await cli.connect()
+        try:
+            stream = await cli.open_stream("DeliverBlocks")
+            await stream.send(json.dumps({
+                "channel": chan.id, "start": chan.height, "stop": stop_at,
+            }).encode())
+            from fabric_tpu.protos import common_pb2
+
+            async for raw in stream:
+                blk = common_pb2.Block()
+                blk.ParseFromString(raw)
+                if blk.header.number < chan.height:
+                    continue
+                await chan.commit_block(blk)
+        finally:
+            await cli.close()
+
+    def start_anti_entropy(self, channel: str, interval: float = 1.0):
+        """Per-channel catch-up loop (state.go:584 antiEntropy): probe
+        members; when behind, pull the missing range from the peer
+        that has it."""
+        chan = self.node.channels[channel]
+
+        async def loop():
+            while True:
+                try:
+                    await asyncio.sleep(interval)
+                    await self.probe_members()
+                    best, best_h = None, chan.height
+                    for org, peers in self.node.registry.peers.items():
+                        for p in peers:
+                            ph = p.heights.get(channel, 0)
+                            if ph > best_h:
+                                best, best_h = p, ph
+                    if best is not None:
+                        await self._pull_blocks_from_peer(
+                            chan, best.host, best.port, best_h - 1
+                        )
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    log.debug("anti-entropy %s: %s", channel, e)
+
+        task = asyncio.ensure_future(loop())
+        self._tasks.append(task)
+        return task
+
+    def start_reconciler(self, channel: str, interval: float = 2.0):
+        """Background pvtdata reconciler (reconcile.go): retry pulling
+        collections recorded missing at commit time."""
+        chan = self.node.channels[channel]
+        pull = self.pull_pvt_for(channel)
+
+        async def loop():
+            while True:
+                try:
+                    await asyncio.sleep(interval)
+                    missing = chan.ledger.pvtdata.missing_data(chan.height)
+                    for block, txnum, ns, coll in missing:
+                        blk = chan.ledger.blocks.get_block(block)
+                        if blk is None:
+                            continue
+                        got = await pull("", block, txnum, ns, coll)
+                        if got is None:
+                            continue
+                        ok = self._verify_and_apply(
+                            chan, blk, block, txnum, ns, coll, got
+                        )
+                        if ok:
+                            log.info(
+                                "reconciled pvt (%d,%d,%s,%s)",
+                                block, txnum, ns, coll,
+                            )
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    log.debug("reconciler %s: %s", channel, e)
+
+        task = asyncio.ensure_future(loop())
+        self._tasks.append(task)
+        return task
+
+    def _verify_and_apply(self, chan, blk, block, txnum, ns, coll, clear) -> bool:
+        """Hash-verify pulled data against the committed block's rwset,
+        then commit it to pvt state + pvtdata store."""
+        import json as _json
+
+        from fabric_tpu import protoutil
+        from fabric_tpu.ledger.rwset import TxRWSet
+        from fabric_tpu.ledger.statedb import UpdateBatch
+        from fabric_tpu.peer.coordinator import _match_cleartext
+        from fabric_tpu.protos import common_pb2
+
+        try:
+            env = protoutil.unmarshal(common_pb2.Envelope, blk.data.data[txnum])
+            _, _, cap, prp, cca = protoutil.extract_action(env)
+            rw = TxRWSet.from_bytes(cca.results)
+        except Exception:
+            return False
+        writes = rw.ns.get(ns, None)
+        if writes is None:
+            return False
+        hashed = writes.hashed.get(coll, {}).get("writes", {})
+        kv = _match_cleartext(hashed, clear)
+        if kv is None:
+            return False
+        batch = UpdateBatch()
+        for key, value in kv.items():
+            if value is None:
+                batch.delete(f"{ns}${coll}", key, (block, txnum))
+            else:
+                batch.put(f"{ns}${coll}", key, value, (block, txnum))
+        chan.ledger.state.apply_updates(batch, None)
+        from fabric_tpu.peer.transient import encode_kv
+
+        chan.ledger.pvtdata.resolve_missing(block, txnum, ns, coll, encode_kv(kv))
+        return True

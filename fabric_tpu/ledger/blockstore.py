@@ -188,13 +188,17 @@ class BlockStore:
 
     def expected_prev_hash(self) -> bytes | None:
         """Hash the next block's previous_hash must carry, when known
-        (last stored block, or the snapshot anchor)."""
-        h = self.height
+        (last stored block, or the snapshot anchor).  Cached in memory
+        after the first lookup — this sits on the commit hot path."""
+        cached = getattr(self, "_last_hash", None)
+        if cached is not None:
+            return cached
         row = self._idx.execute("SELECT MAX(num) FROM blocks").fetchone()
         if row[0] is not None:
-            return self._idx.execute(
+            self._last_hash = self._idx.execute(
                 "SELECT hash FROM blocks WHERE num=?", (row[0],)
             ).fetchone()[0]
+            return self._last_hash
         boot = self.bootstrap_info()
         return boot[1] if boot else None
 
@@ -221,6 +225,7 @@ class BlockStore:
         os.fsync(self._fh.fileno())
         self._index_block(block, self._seg, off)
         self._idx.commit()
+        self._last_hash = protoutil.block_header_hash(block.header)
 
     def _read_at(self, seg: int, off: int) -> common_pb2.Block | None:
         try:

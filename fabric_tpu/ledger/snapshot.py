@@ -101,6 +101,12 @@ def generate_snapshot(ledger, out_dir: str, channel_id: str = "",
 
     sw = _HashingWriter(os.path.join(out_dir, STATE_FILE))
     for (ns, key), vv in ledger.state.iter_all():
+        # public + hashed-collection state only: pvt CLEARTEXT
+        # (ns$coll) is per-peer confidential material and would make
+        # the snapshot hash peer-dependent; joined peers re-acquire
+        # pvt data via reconciliation, like the reference
+        if "$" in ns and not ns.endswith("#hashed"):
+            continue
         sw.record(
             ns.encode(), key.encode(), vv.value or b"",
             _LEN.pack(vv.version[0]) + _LEN.pack(vv.version[1]),

@@ -127,15 +127,14 @@ class OrdererNode:
             from fabric_tpu.opsserver import HealthRegistry, OperationsServer
 
             health = HealthRegistry()
-            for cid, chain in self.chains.items():
-                health.register(
-                    f"consensus:{cid}",
-                    (lambda c: (
-                        lambda: None if c.raft.state in ("leader", "follower",
-                                                         "candidate")
-                        else "stopped"
-                    ))(chain),
-                )
+
+            def _chains():  # evaluated per check: covers late joins
+                for cid, chain in self.chains.items():
+                    if chain.raft.state not in ("leader", "follower", "candidate"):
+                        return f"consensus {cid} stopped"
+                return None
+
+            health.register("consensus", _chains)
             self.operations = await OperationsServer(
                 port=operations_port, health=health
             ).start()

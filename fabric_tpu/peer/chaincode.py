@@ -195,3 +195,29 @@ class MarblesContract(Contract):
         doc["owner"] = new_owner.decode()
         stub.put_state(name.decode(), json.dumps(doc).encode())
         return b"ok"
+
+
+class LayeredRuntime(ChaincodeRuntime):
+    """Per-channel view over a shared runtime: system chaincodes
+    (``_lifecycle`` with the channel's org set, qscc-style helpers)
+    resolve first, user chaincodes fall through to the node-wide
+    registry (the reference's system-chaincode deploy loop,
+    internal/peer/node/start.go:765)."""
+
+    def __init__(self, base: ChaincodeRuntime, overlays: dict | None = None):
+        super().__init__()
+        self._base = base
+        self._contracts.update(overlays or {})
+
+    def registered(self, name: str) -> bool:
+        return name in self._contracts or self._base.registered(name)
+
+    def execute(self, sim, name: str, args, transient=None, creator=b""):
+        if name in self._contracts:
+            contract = self._contracts[name]
+            stub = ContractStub(self, sim, name, args, transient, creator)
+            resp = contract.invoke(stub)
+            resp.events = stub.events  # type: ignore[attr-defined]
+            return resp
+        return self._base.execute(sim, name, args, transient=transient,
+                                  creator=creator)

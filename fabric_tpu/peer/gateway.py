@@ -55,8 +55,11 @@ class Gateway:
         return signed, prop, ch, ext.chaincode_id.name, chan
 
     async def _endorse_local(self, chan, signed):
+        from fabric_tpu.peer.chaincode import LayeredRuntime
+
         endorser = Endorser(
-            self.node.msp, self.node.signer, chan.ledger.state, self.node.runtime
+            self.node.msp, self.node.signer, chan.ledger.state,
+            LayeredRuntime(self.node.runtime, getattr(chan, "syscc", {})),
         )
         loop = asyncio.get_event_loop()
         async with chan.commit_lock:
@@ -203,6 +206,11 @@ class Gateway:
                 await chan._height_changed.wait()
                 continue
             blk = chan.ledger.blocks.get_block(num)
+            if blk is None:
+                await stream.error(
+                    f"block {num} unavailable (pre-snapshot)"
+                )
+                return
             flags = protoutil.get_tx_filter(blk)
             for i, env_bytes in enumerate(blk.data.data):
                 if i < len(flags) and flags[i] != 0:
@@ -282,7 +290,9 @@ class GatewayClient:
             self.signer, channel, chaincode, args
         )
         cli = await self._client()
-        raw = self._unwrap(await cli.unary("GwEvaluate", signed.SerializeToString()))
+        raw = self._unwrap(await cli.unary(
+            "GwEvaluate", signed.SerializeToString(), timeout=120.0
+        ))
         resp = proposal_pb2.Response()
         resp.ParseFromString(raw)
         return resp
@@ -296,19 +306,23 @@ class GatewayClient:
         )
         cli = await self._client()
         payload_bytes = self._unwrap(
-            await cli.unary("GwEndorse", signed.SerializeToString())
+            await cli.unary(
+                "GwEndorse", signed.SerializeToString(), timeout=120.0
+            )
         )
         env = common_pb2.Envelope(
             payload=payload_bytes, signature=self.signer.sign(payload_bytes)
         )
         hdr = json.dumps({"channel": channel}).encode()
         self._unwrap(await cli.unary(
-            "GwSubmit", hdr + b"\x00" + env.SerializeToString()
+            "GwSubmit", hdr + b"\x00" + env.SerializeToString(), timeout=60.0
         ))
         if not wait:
             return tx_id, None
         raw = self._unwrap(await cli.unary(
             "GwCommitStatus",
-            json.dumps({"channel": channel, "tx_id": tx_id}).encode(),
+            json.dumps({"channel": channel, "tx_id": tx_id,
+                        "timeout": 120.0}).encode(),
+            timeout=130.0,
         ))
         return tx_id, json.loads(raw)

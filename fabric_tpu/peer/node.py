@@ -101,8 +101,18 @@ class PeerChannel:
                 self.ledger.commit_block(
                     gb, bytes([0]), UpdateBatch(), []
                 )
+            # the _lifecycle system contract scoped to THIS channel's
+            # org set (system-chaincode deploy, start.go:765)
+            from fabric_tpu.peer.lifecycle import LIFECYCLE_NS, LifecycleContract
+
+            self.syscc = {
+                LIFECYCLE_NS: LifecycleContract(
+                    org_lister=lambda: self.processor.bundle.application_orgs()
+                )
+            }
         else:
             self.processor = config_processor
+            self.syscc = {}
         if msp_manager is None or policy_provider is None:
             raise ValueError(
                 "join without genesis_block/snapshot requires explicit "
@@ -112,6 +122,19 @@ class PeerChannel:
             msp_manager, policy_provider, self.ledger.state,
             block_store=self.ledger.blocks, config_processor=config_processor,
         )
+        from fabric_tpu.peer.coordinator import PvtDataCoordinator
+        from fabric_tpu.peer.transient import TransientStore
+
+        self.transient = TransientStore(f"{data_dir}/transient.db")
+        self.pvt_puller = None  # async callable injected by the gossip layer
+
+        async def _pull(*a):
+            if self.pvt_puller is None:
+                return None
+            return await self.pvt_puller(*a)
+
+        self.coordinator = PvtDataCoordinator(self.transient, puller=_pull)
+        self.transient_retention = 50  # blocks (core.yaml transientstore)
         self.commit_lock = asyncio.Lock()  # endorsement vs commit (txmgr RW lock)
         self._height_changed = asyncio.Event()
         self._deliver_task: asyncio.Task | None = None
@@ -139,7 +162,34 @@ class PeerChannel:
                 None, self.validator.validate, block
             )
             t1 = _time.perf_counter()
-            self.ledger.commit_block(block, flt, batch, history)
+            # pvt phase (StoreBlock, coordinator.go:190-220): cleartext
+            # from transient/pull, hash-verified, into pvt namespaces
+            from fabric_tpu.peer.transient import encode_kv
+
+            pvt = await self.coordinator.gather(
+                block.header.number, self.validator.last_parsed, flt
+            )
+            for hns, key, value, ver in pvt.updates:
+                if value is None:
+                    batch.delete(hns, key, ver)
+                else:
+                    batch.put(hns, key, value, ver)
+            pvt_store = {
+                (txnum, ns, coll): (encode_kv(kv), 0)
+                for txnum, colls in pvt.store_data.items()
+                for (ns, coll), kv in colls.items()
+            }
+            self.ledger.commit_block(block, flt, batch, history,
+                                     pvt_data=pvt_store)
+            if pvt.missing:
+                self.ledger.pvtdata.commit_block(
+                    block.header.number, {},
+                    [(txnum, ns, coll, True)
+                     for (txnum, _txid, ns, coll) in pvt.missing],
+                )
+            self.transient.purge_below(
+                max(0, block.header.number - self.transient_retention)
+            )
             t2 = _time.perf_counter()
             self._post_commit(block, flt, batch)
         # the reference's commit-path breakdown (kv_ledger.go:712-727)
@@ -284,6 +334,7 @@ class PeerChannel:
     def stop(self):
         if self._deliver_task:
             self._deliver_task.cancel()
+        self.transient.close()
         self.ledger.close()
 
 
@@ -313,6 +364,9 @@ class PeerNode:
             genesis_block=genesis_block, snapshot_dir=snapshot_dir,
         )
         self.channels[channel_id] = ch
+        gsvc = getattr(self, "gossip_service", None)
+        if gsvc is not None:
+            ch.pvt_puller = gsvc.pull_pvt_for(channel_id)
         return ch
 
     # -- services ------------------------------------------------------------
@@ -323,9 +377,13 @@ class PeerNode:
         self.server.register_unary("Query", self._on_query)
         self.server.register_unary("Info", self._on_info)
         self.server.register_unary("Discover", self._on_discover)
+        self.server.register_unary("Snapshot", self._on_snapshot)
         from fabric_tpu.peer import gateway as gw
 
         self.gateway = gw.register(self)
+        from fabric_tpu.gossip import GossipService
+
+        self.gossip_service = GossipService(self).register()
         await self.server.start()
         self.port = self.server.port
         self.operations = None
@@ -334,11 +392,14 @@ class PeerNode:
 
             health = HealthRegistry()
             health.register("rpc_server", lambda: None if self.server._server else "down")
-            for cid, ch in self.channels.items():
-                health.register(
-                    f"ledger:{cid}",
-                    (lambda c: (lambda: None if c.height >= 0 else "bad"))(ch),
-                )
+
+            def _ledgers():  # evaluated per check: covers late joins
+                for cid, ch in self.channels.items():
+                    if ch.height < 0:
+                        return f"ledger {cid} unhealthy"
+                return None
+
+            health.register("ledgers", _ledgers)
             self.operations = await OperationsServer(
                 port=operations_port, health=health
             ).start()
@@ -347,6 +408,8 @@ class PeerNode:
     async def stop(self):
         for ch in self.channels.values():
             ch.stop()
+        if getattr(self, "gossip_service", None) is not None:
+            await self.gossip_service.stop()
         if getattr(self, "operations", None) is not None:
             await self.operations.stop()
         await self.server.stop()
@@ -363,8 +426,11 @@ class PeerNode:
             pr.response.status = 404
             pr.response.message = f"not joined to {ch_hdr.channel_id}"
             return pr.SerializeToString()
+        from fabric_tpu.peer.chaincode import LayeredRuntime
+
         endorser = Endorser(
-            self.msp, self.signer, chan.ledger.state, self.runtime
+            self.msp, self.signer, chan.ledger.state,
+            LayeredRuntime(self.runtime, getattr(chan, "syscc", {})),
         )
         loop = asyncio.get_event_loop()
         async with chan.commit_lock:  # simulate against a stable height
@@ -373,6 +439,16 @@ class PeerNode:
             result = await loop.run_in_executor(
                 None, endorser.process_proposal, signed
             )
+        if result.pvt_cleartext and result.tx_id:
+            # endorsement-time pvt data: transient store + distribution
+            # to eligible peers (gossip/privdata/distributor.go)
+            chan.transient.persist(result.tx_id, result.pvt_cleartext, chan.height)
+            gsvc = getattr(self, "gossip_service", None)
+            if gsvc is not None:
+                asyncio.ensure_future(gsvc.push_pvt(
+                    ch_hdr.channel_id, result.tx_id,
+                    result.pvt_cleartext, chan.height,
+                ))
         return result.response.SerializeToString()
 
     async def _on_deliver_blocks(self, stream):
@@ -386,6 +462,12 @@ class PeerNode:
         while stop is None or num <= stop:
             if num < chan.height:
                 blk = chan.ledger.blocks.get_block(num)
+                if blk is None:
+                    # snapshot-pruned range: this peer cannot serve it
+                    await stream.error(
+                        f"block {num} unavailable (pre-snapshot)"
+                    )
+                    return
                 await stream.send(blk.SerializeToString())
                 num += 1
             else:
@@ -413,6 +495,19 @@ class PeerNode:
         if chan is None:
             return json.dumps({"status": 404}).encode()
         return json.dumps({"status": 200, "height": chan.height}).encode()
+
+    async def _on_snapshot(self, req: bytes) -> bytes:
+        """Admin snapshot request: {channel, out_dir} → signable
+        metadata (snapshotgrpc/snapshot_service.go analog)."""
+        q = json.loads(req)
+        chan = self.channels.get(q["channel"])
+        if chan is None:
+            return json.dumps({"status": 404}).encode()
+        try:
+            meta = await chan.snapshot(q["out_dir"])
+        except Exception as e:
+            return json.dumps({"status": 500, "error": str(e)}).encode()
+        return json.dumps({"status": 200, "metadata": meta}).encode()
 
     async def _on_discover(self, req: bytes) -> bytes:
         """Discovery queries: peers / config / endorsers per channel

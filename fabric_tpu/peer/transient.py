@@ -1,0 +1,73 @@
+"""Transient store: endorsement-time private data held until commit.
+
+Reference: core/transientstore/store.go — the peer stores each
+endorsement's private write-set cleartext keyed by txid, purges entries
+below a retention height, and the commit-time coordinator reads it
+back (gossip/privdata/coordinator.go:190).  Distribution to other
+eligible peers writes into THEIR transient stores (PvtPush)."""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+
+
+def encode_kv(kv: dict) -> bytes:
+    """{key: value|None} → canonical stored/wire JSON bytes (hex
+    values) — THE pvt cleartext encoding, shared by the pvtdata store
+    payloads, gossip push/pull, and the reconciler."""
+    return json.dumps(
+        {k: (v.hex() if v is not None else None) for k, v in kv.items()},
+        sort_keys=True,
+    ).encode()
+
+
+def decode_kv(raw) -> dict:
+    data = json.loads(raw)
+    return {k: (bytes.fromhex(v) if v is not None else None)
+            for k, v in data.items()}
+
+
+class TransientStore:
+    def __init__(self, path: str):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS pvt ("
+            " txid TEXT, ns TEXT, coll TEXT, key TEXT, value BLOB,"
+            " received_at_block INTEGER,"
+            " PRIMARY KEY (txid, ns, coll, key))"
+        )
+        self._conn.commit()
+
+    def persist(self, txid: str, cleartext: dict, height: int) -> None:
+        """cleartext: {(ns, coll): {key: value|None}} — the simulator's
+        pvt output (simulator.done())."""
+        rows = []
+        for (ns, coll), kv in cleartext.items():
+            for key, value in kv.items():
+                rows.append((txid, ns, coll, key, value, height))
+        if rows:
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO pvt VALUES (?,?,?,?,?,?)", rows
+            )
+            self._conn.commit()
+
+    def get(self, txid: str) -> dict:
+        """→ {(ns, coll): {key: value}} for one txid."""
+        out: dict = {}
+        for ns, coll, key, value in self._conn.execute(
+            "SELECT ns, coll, key, value FROM pvt WHERE txid=?", (txid,)
+        ):
+            out.setdefault((ns, coll), {})[key] = value
+        return out
+
+    def purge_below(self, height: int) -> int:
+        cur = self._conn.execute(
+            "DELETE FROM pvt WHERE received_at_block < ?", (height,)
+        )
+        self._conn.commit()
+        return cur.rowcount
+
+    def close(self):
+        self._conn.close()

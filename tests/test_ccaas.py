@@ -1,0 +1,80 @@
+"""Chaincode-as-a-service: a contract hosted in an external server
+process, driven through the peer's endorser with state callbacks over
+the duplex stream (reference: ccaas_builder/, handler.go:364)."""
+
+import asyncio
+
+from fabric_tpu.crypto import cryptogen
+from fabric_tpu.crypto.msp import MSPManager
+from fabric_tpu.ledger.statedb import MemVersionedDB, UpdateBatch
+from fabric_tpu.peer import txassembly as txa
+from fabric_tpu.peer.ccaas import CCaaSProxy, ChaincodeServer
+from fabric_tpu.peer.chaincode import ChaincodeRuntime, KVContract
+from fabric_tpu.peer.endorser import Endorser
+from fabric_tpu.protos import proposal_pb2
+
+CHANNEL, CC = "ccaaschan", "remotecc"
+
+
+def test_ccaas_end_to_end(tmp_path):
+    async def scenario():
+        server = await ChaincodeServer().start()
+        server.register(CC, KVContract())
+        try:
+            org = cryptogen.generate_org("Org1MSP", "org1.example.com", peers=1, users=1)
+            mgr = MSPManager({"Org1MSP": org.msp()})
+            signer = cryptogen.signing_identity(org, "peer0.org1.example.com")
+            client = cryptogen.signing_identity(org, "User1@org1.example.com")
+
+            state = MemVersionedDB()
+            seed = UpdateBatch()
+            seed.put(CC, "existing", b"42", (1, 0))
+            state.apply_updates(seed, (1, 0))
+
+            rt = ChaincodeRuntime()
+            rt.register(CC, CCaaSProxy(CC, "127.0.0.1", server.port))
+            endorser = Endorser(mgr, signer, state, rt)
+
+            loop = asyncio.get_event_loop()
+
+            async def endorse(args, transient=None):
+                signed, tx_id, prop = txa.create_signed_proposal(
+                    client, CHANNEL, CC, args, transient=transient
+                )
+                return await loop.run_in_executor(
+                    None, endorser.process_proposal, signed
+                )
+
+            # read existing state through the remote contract
+            res = await endorse([b"get", b"existing"])
+            assert res.response.response.status == 200
+
+            # write path: rwset is built peer-side
+            res = await endorse([b"put", b"k1", b"v1"])
+            assert res.response.response.status == 200
+            from fabric_tpu.ledger.rwset import TxRWSet
+            from fabric_tpu import protoutil
+            prp = protoutil.unmarshal(
+                proposal_pb2.ProposalResponsePayload, res.response.payload
+            )
+            cca = protoutil.unmarshal(proposal_pb2.ChaincodeAction, prp.extension)
+            rw = TxRWSet.from_bytes(cca.results)
+            assert rw.ns[CC].writes["k1"] == b"v1"
+
+            # private data through the remote contract
+            res = await endorse([b"put_private", b"collX", b"pk"],
+                                transient={"value": b"pv"})
+            assert res.response.response.status == 200
+            assert res.pvt_cleartext[(CC, "collX")]["pk"] == b"pv"
+
+            # error propagation
+            res = await endorse([b"get", b"missing-key"])
+            assert res.response.response.status == 404
+        finally:
+            await server.stop()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(asyncio.wait_for(scenario(), 60))
+    finally:
+        loop.close()

@@ -1,0 +1,256 @@
+"""Gossip + private-data tests over real localhost sockets:
+endorsement-time distribution into transient stores, commit-time
+coordinator sourcing (transient hit AND pull path), missing-data
+recording + background reconciliation, anti-entropy block transfer,
+leader election (reference: gossip/privdata/{distributor,pull,
+reconcile}.go, gossip/state/state.go:584, gossip/election)."""
+
+import asyncio
+import json
+
+import pytest
+
+from fabric_tpu.crypto import cryptogen
+from fabric_tpu.crypto import policy as pol
+from fabric_tpu.crypto.msp import MSPManager
+from fabric_tpu.discovery import PeerInfo
+from fabric_tpu.ordering.blockcutter import BatchConfig
+from fabric_tpu.ordering.node import BroadcastClient, OrdererNode
+from fabric_tpu.peer import txassembly as txa
+from fabric_tpu.peer.chaincode import ChaincodeRuntime, KVContract
+from fabric_tpu.peer.node import PeerNode
+from fabric_tpu.peer.validator import NamespaceInfo, PolicyProvider
+
+CHANNEL = "pvtchan"
+CC = "pvtcc"
+
+
+def run(coro, timeout=120):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+    finally:
+        loop.close()
+
+
+async def _wait(cond, timeout=15.0):
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(0.03)
+    return False
+
+
+async def _mknet(tmp_path, n_peers=2):
+    org1 = cryptogen.generate_org("Org1MSP", "org1.example.com", peers=2, users=1)
+    org2 = cryptogen.generate_org("Org2MSP", "org2.example.com", peers=1)
+    mgr = MSPManager({"Org1MSP": org1.msp(), "Org2MSP": org2.msp()})
+    client = cryptogen.signing_identity(org1, "User1@org1.example.com")
+    signers = [
+        cryptogen.signing_identity(org1, "peer0.org1.example.com"),
+        cryptogen.signing_identity(org2, "peer0.org2.example.com"),
+    ]
+    orgs = ["Org1MSP", "Org2MSP"]
+
+    orderer = OrdererNode(
+        "o0", str(tmp_path / "o0"), {},
+        batch_config=BatchConfig(max_message_count=1, batch_timeout_s=0.1),
+    )
+    await orderer.start()
+    orderer.cluster["o0"] = ("127.0.0.1", orderer.port)
+    orderer.join_channel(CHANNEL)
+
+    policy = pol.from_dsl("OutOf(1, 'Org1MSP.peer', 'Org2MSP.peer')")
+    peers = []
+    for i in range(n_peers):
+        rt = ChaincodeRuntime()
+        rt.register(CC, KVContract())
+        node = PeerNode(f"p{i}", str(tmp_path / f"p{i}"), mgr, signers[i], rt)
+        await node.start()
+        prov = PolicyProvider({CC: NamespaceInfo(policy=policy)})
+        ch = node.join_channel(CHANNEL, prov)
+        peers.append(node)
+    for i, node in enumerate(peers):
+        for j, other in enumerate(peers):
+            if i != j:
+                node.registry.add(
+                    PeerInfo(orgs[j % 2], "127.0.0.1", other.port)
+                )
+    return orderer, peers, client
+
+
+def test_pvt_distribution_and_pull(tmp_path):
+    async def scenario():
+        orderer, peers, client = await _mknet(tmp_path)
+        p0, p1 = peers
+        try:
+            p0.channels[CHANNEL].start_deliver([("127.0.0.1", orderer.port)])
+            p1.channels[CHANNEL].start_deliver([("127.0.0.1", orderer.port)])
+            p0.channels[CHANNEL].validator.warmup()
+
+            # endorse ONLY on p0 with transient value; p0 distributes
+            # to p1's transient store at endorsement time
+            from fabric_tpu.comm.rpc import RpcClient
+
+            signed, tx_id, prop = txa.create_signed_proposal(
+                client, CHANNEL, CC, [b"put_private", b"collA", b"secret-key"],
+                transient={"value": b"secret-value"},
+            )
+            cli = RpcClient("127.0.0.1", p0.port)
+            await cli.connect()
+            raw = await cli.unary("Endorse", signed.SerializeToString())
+            await cli.close()
+            from fabric_tpu.protos import proposal_pb2
+
+            pr = proposal_pb2.ProposalResponse()
+            pr.ParseFromString(raw)
+            assert pr.response.status == 200, pr.response.message
+
+            # distribution reached p1's transient store
+            assert await _wait(lambda: bool(
+                p1.channels[CHANNEL].transient.get(tx_id)
+            ))
+
+            env = txa.assemble_transaction(prop, [pr], client)
+            bc = BroadcastClient([("127.0.0.1", orderer.port)])
+            res = await bc.broadcast(CHANNEL, env.SerializeToString())
+            assert res["status"] == 200
+            await bc.close()
+
+            # BOTH peers commit the cleartext into pvt state
+            def committed(p):
+                vv = p.channels[CHANNEL].ledger.state.get_state(
+                    f"{CC}$collA", "secret-key"
+                )
+                return vv is not None and vv.value == b"secret-value"
+
+            assert await _wait(lambda: committed(p0) and committed(p1), 20)
+            # hashed state matches on both, cleartext never hit the rwset
+            import hashlib
+
+            kh = hashlib.sha256(b"secret-key").digest().hex()
+            for p in (p0, p1):
+                hv = p.channels[CHANNEL].ledger.state.get_state(
+                    f"{CC}$collA#hashed", kh
+                )
+                assert hv is not None
+                assert hv.value == hashlib.sha256(b"secret-value").digest()
+        finally:
+            for p in peers:
+                await p.stop()
+            await orderer.stop()
+
+    run(scenario())
+
+
+def test_missing_then_reconcile(tmp_path):
+    async def scenario():
+        orderer, peers, client = await _mknet(tmp_path)
+        p0, p1 = peers
+        try:
+            # p1 gets NO distribution and cannot pull at commit time
+            # (puller disabled) → records missing, then the reconciler
+            # catches up once pulling is re-enabled
+            p0.channels[CHANNEL].start_deliver([("127.0.0.1", orderer.port)])
+            p1.channels[CHANNEL].start_deliver([("127.0.0.1", orderer.port)])
+            p0.gossip_service._clients.clear()
+            p0.registry.peers.clear()  # no distribution targets
+
+            async def no_pull(*a):
+                return None
+
+            real_puller = p1.channels[CHANNEL].pvt_puller
+            p1.channels[CHANNEL].pvt_puller = no_pull
+
+            from fabric_tpu.comm.rpc import RpcClient
+            from fabric_tpu.protos import proposal_pb2
+
+            signed, tx_id, prop = txa.create_signed_proposal(
+                client, CHANNEL, CC, [b"put_private", b"collB", b"k2"],
+                transient={"value": b"v2"},
+            )
+            cli = RpcClient("127.0.0.1", p0.port)
+            await cli.connect()
+            raw = await cli.unary("Endorse", signed.SerializeToString())
+            await cli.close()
+            pr = proposal_pb2.ProposalResponse()
+            pr.ParseFromString(raw)
+            assert pr.response.status == 200
+
+            env = txa.assemble_transaction(prop, [pr], client)
+            bc = BroadcastClient([("127.0.0.1", orderer.port)])
+            assert (await bc.broadcast(CHANNEL, env.SerializeToString()))["status"] == 200
+            await bc.close()
+
+            ch1 = p1.channels[CHANNEL]
+            assert await _wait(lambda: ch1.height >= 1, 20)
+            assert await _wait(
+                lambda: bool(ch1.ledger.pvtdata.missing_data(ch1.height)), 10
+            )
+            assert ch1.ledger.state.get_state(f"{CC}$collB", "k2") is None
+
+            # re-enable pulling and run the reconciler
+            ch1.pvt_puller = real_puller
+            p1.gossip_service.start_reconciler(CHANNEL, interval=0.2)
+            assert await _wait(
+                lambda: not ch1.ledger.pvtdata.missing_data(ch1.height), 15
+            )
+            vv = ch1.ledger.state.get_state(f"{CC}$collB", "k2")
+            assert vv is not None and vv.value == b"v2"
+        finally:
+            for p in peers:
+                await p.stop()
+            await orderer.stop()
+
+    run(scenario())
+
+
+def test_anti_entropy_catchup(tmp_path):
+    async def scenario():
+        orderer, peers, client = await _mknet(tmp_path)
+        p0, p1 = peers
+        try:
+            # only p0 talks to the orderer (org leader); p1 relies on
+            # anti-entropy pulls from p0
+            p0.channels[CHANNEL].start_deliver([("127.0.0.1", orderer.port)])
+            p0.channels[CHANNEL].validator.warmup()
+            bc = BroadcastClient([("127.0.0.1", orderer.port)])
+            for i in range(3):
+                signed, tx_id, prop = txa.create_signed_proposal(
+                    client, CHANNEL, CC, [b"put", b"k%d" % i, b"v%d" % i]
+                )
+                from fabric_tpu.comm.rpc import RpcClient
+                from fabric_tpu.protos import proposal_pb2
+
+                cli = RpcClient("127.0.0.1", p0.port)
+                await cli.connect()
+                raw = await cli.unary("Endorse", signed.SerializeToString())
+                await cli.close()
+                pr = proposal_pb2.ProposalResponse()
+                pr.ParseFromString(raw)
+                env = txa.assemble_transaction(prop, [pr], client)
+                assert (await bc.broadcast(CHANNEL, env.SerializeToString()))["status"] == 200
+            await bc.close()
+            assert await _wait(lambda: p0.channels[CHANNEL].height >= 3, 20)
+
+            assert p1.channels[CHANNEL].height == 0
+            p1.gossip_service.start_anti_entropy(CHANNEL, interval=0.2)
+            assert await _wait(lambda: p1.channels[CHANNEL].height >= 3, 20)
+            c0, c1 = p0.channels[CHANNEL], p1.channels[CHANNEL]
+            for k in range(3):
+                assert (c0.ledger.blocks.get_block(k).SerializeToString()
+                        == c1.ledger.blocks.get_block(k).SerializeToString())
+
+            # leader election: deterministic lowest endpoint
+            gs = p0.gossip_service
+            me = ("127.0.0.1", p0.port)
+            others = [PeerInfo("Org1MSP", "127.0.0.1", p1.port, height=3)]
+            assert gs.elect_leader(others, me) == (me < ("127.0.0.1", p1.port))
+        finally:
+            for p in peers:
+                await p.stop()
+            await orderer.stop()
+
+    run(scenario())
