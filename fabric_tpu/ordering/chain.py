@@ -63,7 +63,9 @@ class OrderingChain:
     def __init__(self, channel_id: str, node_id: str, peers: list[str],
                  data_dir: str, send_cb, config: BatchConfig | None = None,
                  msgproc: MsgProcessor | None = None,
-                 genesis_block: common_pb2.Block | None = None):
+                 genesis_block: common_pb2.Block | None = None,
+                 consensus: str = "raft", signer=None, verifiers=None,
+                 view_timeout: float = 2.0):
         self.channel = channel_id
         self.config = config or BatchConfig()
         self.cutter = BlockCutter(self.config)
@@ -71,10 +73,23 @@ class OrderingChain:
         self.blocks = BlockStore(f"{data_dir}/chains")
         if self.blocks.height == 0 and genesis_block is not None:
             self.blocks.add_block(genesis_block)
-        self.raft = RaftNode(
-            node_id, peers, WAL(f"{data_dir}/wal"),
-            apply_cb=self._apply, send_cb=send_cb,
-        )
+        # consenter selection — the consensus.Chain SPI seam
+        # (consensus.go:57; registry main.go:635: etcdraft | BFT)
+        if consensus == "bft":
+            from fabric_tpu.ordering.bft import BFTNode
+
+            self.raft = BFTNode(
+                node_id, peers, WAL(f"{data_dir}/wal"),
+                apply_cb=self._apply, send_cb=send_cb,
+                signer=signer, verifiers=verifiers,
+                view_timeout=view_timeout,
+            )
+        else:
+            self.raft = RaftNode(
+                node_id, peers, WAL(f"{data_dir}/wal"),
+                apply_cb=self._apply, send_cb=send_cb,
+            )
+        self.consenter = self.raft  # canonical name; raft kept for compat
         self._applied_batches = 0
         self._recovered_batches = 0
         self._timer_task: asyncio.Task | None = None
@@ -112,6 +127,10 @@ class OrderingChain:
         if reason is not None:
             return {"status": 400, "info": reason}
         if self.raft.state != "leader":
+            # BFT: a client knocking on a follower while the leader is
+            # dead is the liveness signal for a view change
+            if hasattr(self.raft, "note_client_request"):
+                self.raft.note_client_request()
             return {"status": 503, "info": "not leader",
                     "leader": self.raft.leader_id}
         batches, pending = self.cutter.ordered(env_bytes)

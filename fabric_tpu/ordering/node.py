@@ -32,13 +32,20 @@ class OrdererNode:
                  cluster: dict[str, tuple[str, int]],
                  host: str = "127.0.0.1", port: int = 0,
                  batch_config: BatchConfig | None = None,
-                 msp_manager=None):
+                 msp_manager=None, consensus: str = "raft",
+                 signer=None, verifiers=None, view_timeout: float = 2.0):
         self.id = node_id
         self.dir = data_dir
         self.cluster = dict(cluster)  # node_id -> (host, port)
         self.host, self.port = host, port
         self.batch_config = batch_config or BatchConfig()
         self.msp = msp_manager
+        self.consensus = consensus
+        self.broadcast_rate = 0.0  # msgs/s per channel; 0 = unthrottled
+        self._throttle: dict[str, list] = {}  # channel -> [tokens, last_ts]
+        self.signer = signer
+        self.verifiers = verifiers or {}
+        self.view_timeout = view_timeout
         self.chains: dict[str, OrderingChain] = {}
         self.server = RpcServer(host, port)
         self._peer_clients: dict[str, RpcClient] = {}
@@ -106,6 +113,8 @@ class OrdererNode:
             config=self.batch_config,
             msgproc=MsgProcessor(self.batch_config, self.msp),
             genesis_block=genesis_block,
+            consensus=self.consensus, signer=self.signer,
+            verifiers=self.verifiers, view_timeout=self.view_timeout,
         )
         self.chains[channel_id] = chain
         if start:
@@ -155,6 +164,22 @@ class OrdererNode:
                 task.cancel()
         await self.server.stop()
 
+    def _throttled(self, channel: str) -> bool:
+        """Token-bucket broadcast rate limit per channel
+        (orderer/common/throttle/ratelimit.go)."""
+        if self.broadcast_rate <= 0:
+            return False
+        now = asyncio.get_event_loop().time()
+        cap = max(1.0, self.broadcast_rate)  # rates < 1/s must still pass
+        bucket = self._throttle.setdefault(channel, [cap, now])
+        tokens, last = bucket
+        tokens = min(cap, tokens + (now - last) * self.broadcast_rate)
+        if tokens < 1.0:
+            bucket[0], bucket[1] = tokens, now
+            return True
+        bucket[0], bucket[1] = tokens - 1.0, now
+        return False
+
     async def _on_broadcast(self, req: bytes) -> bytes:
         hdr_len = int.from_bytes(req[:4], "big")
         hdr = json.loads(req[4:4 + hdr_len])
@@ -162,6 +187,10 @@ class OrdererNode:
         chain = self.chains.get(hdr["channel"])
         if chain is None:
             return json.dumps({"status": 404, "info": "no such channel"}).encode()
+        if self._throttled(hdr["channel"]):
+            return json.dumps(
+                {"status": 429, "info": "broadcast rate limit"}
+            ).encode()
         res = await chain.broadcast(env)
         if res.get("leader") and res["leader"] in self.cluster:
             res["leader_addr"] = list(self.cluster[res["leader"]])
@@ -245,8 +274,11 @@ class BroadcastClient:
                 continue
             if resp["status"] == 200:
                 return resp
-            if 400 <= resp["status"] < 500:
+            if 400 <= resp["status"] < 500 and resp["status"] != 429:
                 return resp  # deterministic rejection — retrying can't help
+            if resp["status"] == 429:  # backpressure: retry after a beat
+                await asyncio.sleep(0.1 * min(attempt + 1, 6))
+                continue
             if resp.get("leader_addr"):
                 hint = tuple(resp["leader_addr"])
             last = resp

@@ -31,7 +31,8 @@ class Endorser:
     def __init__(self, msp_manager, signer, state_db,
                  runtime: ChaincodeRuntime, acl_check=None):
         """signer: the peer's SigningIdentity (ESCC key).
-        acl_check(channel, identity) -> bool (writers-policy hook)."""
+        acl_check(channel, creator_bytes, message, signature) -> bool
+        (the peer/Propose Writers-policy gate, aclmgmt)."""
         self.msp = msp_manager
         self.signer = signer
         self.state = state_db
@@ -53,7 +54,9 @@ class Endorser:
             return self._err(500, "invalid proposal signature")
         if ch.tx_id != protoutil.compute_tx_id(sh.nonce, sh.creator):
             return self._err(500, "tx_id mismatch")
-        if self.acl_check is not None and not self.acl_check(ch.channel_id, ident):
+        if self.acl_check is not None and not self.acl_check(
+            ch.channel_id, sh.creator, signed.proposal_bytes, signed.signature
+        ):
             return self._err(403, "access denied")
 
         # what to run

@@ -55,11 +55,8 @@ class Gateway:
         return signed, prop, ch, ext.chaincode_id.name, chan
 
     async def _endorse_local(self, chan, signed):
-        from fabric_tpu.peer.chaincode import LayeredRuntime
-
-        endorser = Endorser(
-            self.node.msp, self.node.signer, chan.ledger.state,
-            LayeredRuntime(self.node.runtime, getattr(chan, "syscc", {})),
+        endorser = chan.make_endorser(
+            self.node.msp, self.node.signer, self.node.runtime
         )
         loop = asyncio.get_event_loop()
         async with chan.commit_lock:

@@ -101,6 +101,12 @@ class PeerChannel:
                 self.ledger.commit_block(
                     gb, bytes([0]), UpdateBatch(), []
                 )
+            # ACLs over the live bundle (rotates with config updates)
+            from fabric_tpu.peer.acl import ACLProvider, PROPOSE
+
+            self.acl = ACLProvider(
+                lambda: getattr(self.processor, "bundle", None)
+            )
             # the _lifecycle system contract scoped to THIS channel's
             # org set (system-chaincode deploy, start.go:765)
             from fabric_tpu.peer.lifecycle import LIFECYCLE_NS, LifecycleContract
@@ -113,6 +119,7 @@ class PeerChannel:
         else:
             self.processor = config_processor
             self.syscc = {}
+            self.acl = None
         if msp_manager is None or policy_provider is None:
             raise ValueError(
                 "join without genesis_block/snapshot requires explicit "
@@ -134,6 +141,9 @@ class PeerChannel:
             return await self.pvt_puller(*a)
 
         self.coordinator = PvtDataCoordinator(self.transient, puller=_pull)
+        from fabric_tpu.ledger.confighistory import ConfigHistoryDB
+
+        self.confighistory = ConfigHistoryDB(f"{data_dir}/confighistory.db")
         self.transient_retention = 50  # blocks (core.yaml transientstore)
         self.commit_lock = asyncio.Lock()  # endorsement vs commit (txmgr RW lock)
         self._height_changed = asyncio.Event()
@@ -142,6 +152,24 @@ class PeerChannel:
     @property
     def height(self) -> int:
         return self.ledger.blocks.height
+
+    def make_endorser(self, msp, signer, runtime):
+        """Endorser over THIS channel's state, system chaincodes and
+        ACLs — the single construction point shared by the Endorse RPC
+        and the gateway (endorser.go:304 wiring)."""
+        from fabric_tpu.peer.acl import PROPOSE
+        from fabric_tpu.peer.chaincode import LayeredRuntime
+
+        acl = getattr(self, "acl", None)
+        return Endorser(
+            msp, signer, self.ledger.state,
+            LayeredRuntime(runtime, getattr(self, "syscc", {})),
+            acl_check=(
+                (lambda _ch, creator, msg, sig:
+                 acl.check(PROPOSE, creator, msg, sig))
+                if acl is not None else None
+            ),
+        )
 
     async def commit_block(self, block) -> bytes:
         """Validate + commit one block (the StoreBlock path).
@@ -229,6 +257,17 @@ class PeerChannel:
         pol_provider = self.validator.policies
         if hasattr(pol_provider, "on_block_committed"):
             pol_provider.on_block_committed(batch)
+        # record definition changes for point-in-time config queries
+        # (confighistory/mgr.go, reconciler eligibility on old blocks)
+        from fabric_tpu.peer.lifecycle import LIFECYCLE_NS
+
+        prefix = "namespaces/fields/"
+        for (ns, key), vv in batch.items():
+            if ns == LIFECYCLE_NS and key.startswith(prefix)                     and key.endswith("/Definition") and vv.value:
+                cc_name = key[len(prefix):-len("/Definition")]
+                self.confighistory.record(
+                    block.header.number, cc_name, vv.value
+                )
         proc = self.validator.config_processor
         if proc is None or not hasattr(proc, "apply"):
             return
@@ -335,6 +374,7 @@ class PeerChannel:
         if self._deliver_task:
             self._deliver_task.cancel()
         self.transient.close()
+        self.confighistory.close()
         self.ledger.close()
 
 
@@ -426,12 +466,7 @@ class PeerNode:
             pr.response.status = 404
             pr.response.message = f"not joined to {ch_hdr.channel_id}"
             return pr.SerializeToString()
-        from fabric_tpu.peer.chaincode import LayeredRuntime
-
-        endorser = Endorser(
-            self.msp, self.signer, chan.ledger.state,
-            LayeredRuntime(self.runtime, getattr(chan, "syscc", {})),
-        )
+        endorser = chan.make_endorser(self.msp, self.signer, self.runtime)
         loop = asyncio.get_event_loop()
         async with chan.commit_lock:  # simulate against a stable height
             # off the event loop: ECDSA verify + chaincode execution
