@@ -318,7 +318,7 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5):
         return dt, n_valid
 
     run_tpu()  # compile + warm every cache
-    runs = [run_tpu() for _ in range(2)]
+    runs = [run_tpu() for _ in range(3)]  # min-of-3: tunnel jitter
     tpu_s = min(dt for dt, _ in runs)
     total = n_tx * n_blocks
     assert runs[0][1] == total, f"expected all {total} valid, got {runs[0][1]}"
@@ -367,7 +367,22 @@ _BENCHES = {
 
 
 def main():
+    import os
     import sys
+
+    # persistent XLA compile cache: the driver launches this script
+    # fresh every round — the verify/MVCC graphs must not recompile
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
 
     name = sys.argv[1] if len(sys.argv) > 1 else "block_commit"
     result = _BENCHES[name]()

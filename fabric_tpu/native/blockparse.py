@@ -1,0 +1,105 @@
+"""Python binding for the native block pre-parser.
+
+``parse_envelopes(env_list)`` → ParsedBlock (numpy arrays over one
+shared blob) or None when the native library is unavailable.  Spans
+index into ``blob``; per-envelope ``ok`` distinguishes fast-path
+endorser txs from envelopes the caller must re-parse in Python."""
+
+from __future__ import annotations
+
+import ctypes
+from dataclasses import dataclass
+
+import numpy as np
+
+from fabric_tpu.native import blockparse_lib
+
+
+@dataclass
+class ParsedBlock:
+    blob: bytes
+    ok: np.ndarray            # [n] uint8
+    ch_type: np.ndarray       # [n] int64
+    txid_span: np.ndarray     # [n,2]
+    channel_span: np.ndarray
+    creator_span: np.ndarray
+    nonce_span: np.ndarray
+    results_span: np.ndarray
+    events_span: np.ndarray
+    payload_digest: np.ndarray   # [n,32]
+    txid_digest: np.ndarray      # [n,32]
+    creator_sig_ok: np.ndarray   # [n]
+    creator_r: np.ndarray        # [n,32]
+    creator_s: np.ndarray        # [n,32]
+    endo_start: np.ndarray
+    endo_count: np.ndarray
+    e_endorser_span: np.ndarray  # [m,2]
+    e_digest: np.ndarray         # [m,32]
+    e_r: np.ndarray
+    e_s: np.ndarray
+    e_ok: np.ndarray
+
+    def span(self, arr: np.ndarray, i: int) -> bytes | None:
+        off, ln = int(arr[i, 0]), int(arr[i, 1])
+        if off < 0:
+            return None
+        return self.blob[off:off + ln]
+
+
+def parse_envelopes(envs: list[bytes]) -> ParsedBlock | None:
+    lib = blockparse_lib()
+    if lib is None or not envs:
+        return None
+    n = len(envs)
+    blob = b"".join(envs)
+    offs = np.zeros(n, np.int64)
+    lens = np.zeros(n, np.int64)
+    pos = 0
+    for i, e in enumerate(envs):
+        offs[i] = pos
+        lens[i] = len(e)
+        pos += len(e)
+
+    cap = max(8, 8 * n)
+    out = ParsedBlock(
+        blob=blob,
+        ok=np.zeros(n, np.uint8),
+        ch_type=np.zeros(n, np.int64),
+        txid_span=np.zeros((n, 2), np.int64),
+        channel_span=np.zeros((n, 2), np.int64),
+        creator_span=np.zeros((n, 2), np.int64),
+        nonce_span=np.zeros((n, 2), np.int64),
+        results_span=np.zeros((n, 2), np.int64),
+        events_span=np.zeros((n, 2), np.int64),
+        payload_digest=np.zeros((n, 32), np.uint8),
+        txid_digest=np.zeros((n, 32), np.uint8),
+        creator_sig_ok=np.zeros(n, np.uint8),
+        creator_r=np.zeros((n, 32), np.uint8),
+        creator_s=np.zeros((n, 32), np.uint8),
+        endo_start=np.zeros(n, np.int64),
+        endo_count=np.zeros(n, np.int64),
+        e_endorser_span=np.zeros((cap, 2), np.int64),
+        e_digest=np.zeros((cap, 32), np.uint8),
+        e_r=np.zeros((cap, 32), np.uint8),
+        e_s=np.zeros((cap, 32), np.uint8),
+        e_ok=np.zeros(cap, np.uint8),
+    )
+
+    def ptr(a):
+        return a.ctypes.data_as(ctypes.c_void_p)
+
+    ne = lib.parse_block(
+        ctypes.c_char_p(blob), ptr(offs), ptr(lens),
+        ctypes.c_int64(n), ctypes.c_int64(cap),
+        ptr(out.ok), ptr(out.ch_type),
+        ptr(out.txid_span), ptr(out.channel_span), ptr(out.creator_span),
+        ptr(out.nonce_span), ptr(out.results_span), ptr(out.events_span),
+        ptr(out.payload_digest), ptr(out.txid_digest),
+        ptr(out.creator_sig_ok), ptr(out.creator_r), ptr(out.creator_s),
+        ptr(out.endo_start), ptr(out.endo_count),
+        ptr(out.e_endorser_span), ptr(out.e_digest), ptr(out.e_r),
+        ptr(out.e_s), ptr(out.e_ok),
+    )
+    if ne < 0:
+        return None  # endorsement capacity exceeded — python path
+    return out

@@ -64,7 +64,8 @@ def _signable(msg: dict) -> bytes:
 class _SlotState:
     payload: bytes | None = None
     pre_prepared: bool = False
-    prepares: dict = field(default_factory=dict)   # node -> digest
+    prepares: dict = field(default_factory=dict)      # node -> digest
+    prepare_msgs: dict = field(default_factory=dict)  # node -> signed msg
     commits: dict = field(default_factory=dict)
     committed: bool = False
 
@@ -96,6 +97,7 @@ class BFTNode:
         self.last_applied = 0
         self.slots: dict[int, _SlotState] = {}
         self.view_changes: dict[int, dict] = {}  # new_view -> {node: vc}
+        self._applied_digest: dict[int, str] = {}  # seq -> payload digest
         self._applied_ev: dict[int, asyncio.Event] = {}
         self._progress_task: asyncio.Task | None = None
         self._pending_since: float | None = None
@@ -177,11 +179,17 @@ class BFTNode:
         })
         return seq
 
-    async def wait_applied(self, seq: int):
-        if seq <= self.last_applied:
-            return
-        ev = self._applied_ev.setdefault(seq, asyncio.Event())
-        await ev.wait()
+    async def wait_applied(self, seq: int, digest: str | None = None) -> bool:
+        """Wait for seq to apply; with ``digest``, additionally confirm
+        THE CALLER'S payload is what got applied — after a view change
+        sequences are reassigned, and an ack for a different payload
+        would make the client drop a tx that was never ordered."""
+        if seq > self.last_applied:
+            ev = self._applied_ev.setdefault(seq, asyncio.Event())
+            await ev.wait()
+        if digest is None:
+            return True
+        return self._applied_digest.get(seq) == digest
 
     # -- message handling --------------------------------------------------
 
@@ -230,6 +238,7 @@ class BFTNode:
             return
         slot = self._slot(msg["seq"])
         slot.prepares[msg["from"]] = msg["digest"]
+        slot.prepare_msgs[msg["from"]] = msg  # retained for VC certificates
         if slot.payload is None or slot.committed:
             return
         d = _digest(slot.payload)
@@ -257,6 +266,10 @@ class BFTNode:
             slot.committed = True
             entry = Entry(term=self.view, index=seq, data=slot.payload)
             self.wal.append([entry])
+            self._applied_digest[seq] = d
+            if len(self._applied_digest) > 4096:
+                for old in sorted(self._applied_digest)[:2048]:
+                    del self._applied_digest[old]
             self.last_applied = seq
             self._pending_since = None
             self.apply_cb(entry)
@@ -277,7 +290,13 @@ class BFTNode:
                 now = asyncio.get_event_loop().time()
                 if now - self._pending_since > self.view_timeout:
                     self._pending_since = now  # rate-limit re-sends
-                    self._start_view_change(self.view + 1)
+                    # escalate past consecutive dead leaders: each timer
+                    # expiry targets one view further (PBFT's doubling
+                    # timer serves the same liveness purpose)
+                    self._vc_target = max(
+                        getattr(self, "_vc_target", self.view), self.view
+                    ) + 1
+                    self._start_view_change(self._vc_target)
             except asyncio.CancelledError:
                 return
 
@@ -294,15 +313,39 @@ class BFTNode:
     def _start_view_change(self, new_view: int):
         self._vc_sent = getattr(self, "_vc_sent", set())
         self._vc_sent.add(new_view)
-        prepared = {
-            str(seq): {"payload": s.payload.hex(), "view": self.view}
-            for seq, s in self.slots.items()
-            if s.pre_prepared and seq > self.last_applied and s.payload
-        }
+        # only PREPARED entries (2f+1 matching signed PREPAREs — the
+        # certificate) ride the view change: an uncertified claim must
+        # not be able to override what another node already committed
+        prepared = {}
+        for seq, s in self.slots.items():
+            if not (s.pre_prepared and seq > self.last_applied and s.payload):
+                continue
+            d = _digest(s.payload)
+            cert = [m for m in s.prepare_msgs.values() if m.get("digest") == d]
+            if len(cert) >= self.quorum:
+                prepared[str(seq)] = {
+                    "payload": s.payload.hex(), "view": self.view,
+                    "cert": cert,
+                }
         self._bcast({
             "type": VIEW_CHANGE, "from": self.id, "new_view": new_view,
             "last_applied": self.last_applied, "prepared": prepared,
         })
+
+    def _cert_valid(self, seq: int, payload: bytes, cert: list) -> bool:
+        """2f+1 distinct, correctly signed PREPAREs for (seq, digest)."""
+        d = _digest(payload)
+        senders = set()
+        for m in cert:
+            if not isinstance(m, dict) or m.get("type") != PREPARE:
+                continue
+            if m.get("seq") != seq or m.get("digest") != d:
+                continue
+            if m.get("from") in senders:
+                continue
+            if m.get("from") == self.id or self._verify(m):
+                senders.add(m.get("from"))
+        return len(senders) >= self.quorum
 
     def _on_view_change(self, msg):
         nv = msg["new_view"]
@@ -316,21 +359,30 @@ class BFTNode:
         if len(vcs) > self.f and nv not in getattr(self, "_vc_sent", set()):
             self._start_view_change(nv)
         if len(vcs) >= self.quorum and self.peers[nv % self.n] == self.id:
-            # I lead the new view: install + re-propose prepared entries
+            # I lead the new view: install + re-propose entries that
+            # carry a VALID prepare certificate, preferring the
+            # highest-view certificate per sequence (PBFT new-view)
             self._install_view(nv)
-            repro: dict[int, bytes] = {}
+            repro: dict[int, tuple[int, bytes]] = {}
             for vc in vcs.values():
                 for seq_s, info in vc.get("prepared", {}).items():
                     seq = int(seq_s)
-                    if seq > self.last_applied:
-                        repro.setdefault(seq, bytes.fromhex(info["payload"]))
+                    if seq <= self.last_applied:
+                        continue
+                    payload = bytes.fromhex(info["payload"])
+                    cview = int(info.get("view", 0))
+                    if not self._cert_valid(seq, payload, info.get("cert", [])):
+                        continue
+                    cur = repro.get(seq)
+                    if cur is None or cview > cur[0]:
+                        repro[seq] = (cview, payload)
             self._bcast({
                 "type": NEW_VIEW, "from": self.id, "view": nv,
                 "vc_count": len(vcs),
             })
             self.next_seq = self.last_applied + 1
             for seq in sorted(repro):
-                payload = repro[seq]
+                payload = repro[seq][1]
                 s = self.next_seq
                 self.next_seq += 1
                 self._bcast({
@@ -344,6 +396,7 @@ class BFTNode:
 
     def _install_view(self, view: int):
         self.view = view
+        self._vc_target = view
         self._pending_since = None
         # drop uncommitted slot votes from the old view (re-proposals
         # will rebuild them under the new view's sequences)

@@ -144,16 +144,23 @@ class OrderingChain:
             self._timer_task = None
         if last_index is not None:
             try:
-                await asyncio.wait_for(
-                    self.raft.wait_applied(last_index),
+                confirmed = await asyncio.wait_for(
+                    self.raft.wait_applied(last_index, digest=self._last_digest),
                     timeout=10.0,
                 )
             except asyncio.TimeoutError:
                 return {"status": 500, "info": "commit timeout"}
+            if confirmed is False:
+                # a view change reassigned the sequence: this batch was
+                # NOT ordered — the client must resubmit
+                return {"status": 503, "info": "reordered during view change"}
         return {"status": 200}
 
     def _propose_batch(self, batch: list[bytes]) -> int | None:
+        import hashlib
+
         payload = json.dumps([b.hex() for b in batch]).encode()
+        self._last_digest = hashlib.sha256(payload).hexdigest()
         return self.raft.propose(payload)
 
     def _arm_timer(self):

@@ -277,6 +277,7 @@ class BroadcastClient:
             if 400 <= resp["status"] < 500 and resp["status"] != 429:
                 return resp  # deterministic rejection — retrying can't help
             if resp["status"] == 429:  # backpressure: retry after a beat
+                last = resp
                 await asyncio.sleep(0.1 * min(attempt + 1, 6))
                 continue
             if resp.get("leader_addr"):

@@ -244,7 +244,9 @@ class RaftNode:
         self._advance_commit()
         return ent.index
 
-    async def wait_applied(self, index: int):
+    async def wait_applied(self, index: int, digest: str | None = None):
+        # raft never reassigns indices (leader-append-only log), so the
+        # digest confirmation the BFT consenter needs is a no-op here
         if self.last_applied >= index:
             return
         ev = asyncio.Event()

@@ -40,7 +40,9 @@ class ACLProvider:
         stolen identity without the key cannot pass."""
         ref = self.refs.get(resource)
         bundle = self._bundle()
-        if ref is None or bundle is None:
+        if bundle is None:
+            return False  # no policy source → fail CLOSED (aclmgmt)
+        if ref is None:
             return True  # unmapped resources follow the open default
         sd = SignedData(identity=identity_bytes, data=message,
                         signature=signature)

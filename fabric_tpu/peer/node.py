@@ -119,7 +119,14 @@ class PeerChannel:
         else:
             self.processor = config_processor
             self.syscc = {}
-            self.acl = None
+            if config_processor is not None and hasattr(config_processor, "bundle"):
+                from fabric_tpu.peer.acl import ACLProvider
+
+                self.acl = ACLProvider(
+                    lambda: getattr(self.processor, "bundle", None)
+                )
+            else:
+                self.acl = None  # dev mode: no policy source, no ACLs
         if msp_manager is None or policy_provider is None:
             raise ValueError(
                 "join without genesis_block/snapshot requires explicit "

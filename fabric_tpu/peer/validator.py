@@ -141,10 +141,29 @@ class BlockValidator:
     # -- phase 0: parse + collect -----------------------------------------
 
     def _parse(self, block: common_pb2.Block) -> tuple[list, list]:
+        """Parse every envelope + collect the signature batch.
+
+        Fast path: the native C++ pre-parser (fabric_tpu.native) walks
+        the whole block's wire format, hashes every message and splits
+        every DER signature in ONE call; envelopes it cannot fully
+        handle (config txs, malformed bytes) fall back to the Python
+        path below, envelope by envelope — identical verdicts either
+        way (tests/test_native_parse.py pins the equivalence)."""
         txs: list[ParsedTx] = []
         items: list = []  # (digest, r, s, qx, qy)
         seen_txids: dict[str, int] = {}
+        native = None
+        if len(block.data.data) >= 16 and block.header.number != 0:
+            try:
+                from fabric_tpu.native import blockparse as nbp
+
+                native = nbp.parse_envelopes(list(block.data.data))
+            except Exception:
+                native = None
         for i, env_bytes in enumerate(block.data.data):
+            if native is not None and native.ok[i]:
+                self._parse_fast(i, native, txs, items, seen_txids)
+                continue
             ptx = ParsedTx(idx=i)
             txs.append(ptx)
             if not env_bytes:
@@ -252,6 +271,74 @@ class BlockValidator:
                 ptx.code = C.BAD_RWSET
                 continue
         return txs, items
+
+    def _parse_fast(self, i: int, native, txs, items, seen_txids) -> None:
+        """Native-pre-parsed endorser tx → ParsedTx + signature items;
+        check order mirrors the Python path exactly."""
+        ptx = ParsedTx(idx=i)
+        txs.append(ptx)
+        txid_b = native.span(native.txid_span, i)
+        channel_b = native.span(native.channel_span, i)
+        creator = native.span(native.creator_span, i) or b""
+        ptx.txid = txid_b.decode("utf-8", "replace") if txid_b else ""
+        ptx.channel = channel_b.decode("utf-8", "replace") if channel_b else ""
+        ptx.creator = creator
+
+        # txid binding: tx_id == sha256(nonce ‖ creator) hex
+        if not ptx.txid or ptx.txid != bytes(native.txid_digest[i]).hex():
+            ptx.code = C.BAD_PROPOSAL_TXID
+            return
+        if ptx.txid in seen_txids:
+            ptx.code = C.DUPLICATE_TXID
+            return
+        seen_txids[ptx.txid] = i
+
+        try:
+            ident = self.msp.deserialize_identity(creator)
+            qx, qy = ident.public_numbers
+        except Exception:
+            ptx.code = C.BAD_CREATOR_SIGNATURE
+            return
+        if not ident.is_valid or not native.creator_sig_ok[i]:
+            ptx.code = C.BAD_CREATOR_SIGNATURE
+            return
+        ptx.creator_item_idx = len(items)
+        items.append((
+            int.from_bytes(bytes(native.payload_digest[i]), "big"),
+            int.from_bytes(bytes(native.creator_r[i]), "big"),
+            int.from_bytes(bytes(native.creator_s[i]), "big"),
+            qx, qy,
+        ))
+
+        try:
+            results = native.span(native.results_span, i) or b""
+            ptx.rwset = TxRWSet.from_bytes(results)
+            ptx.namespaces = tuple(sorted(ptx.rwset.ns))
+        except Exception:
+            ptx.code = C.BAD_RWSET
+            return
+        seen_endorsers: set[bytes] = set()
+        base = int(native.endo_start[i])
+        for j in range(base, base + int(native.endo_count[i])):
+            endorser = native.span(native.e_endorser_span, j)
+            if not native.e_ok[j] or endorser is None:
+                continue  # unparseable endorsement contributes nothing
+            if endorser in seen_endorsers:
+                continue  # dedup by identity (policy.go:360-363)
+            try:
+                eident = self.msp.deserialize_identity(endorser)
+                eqx, eqy = eident.public_numbers
+            except Exception:
+                continue
+            seen_endorsers.add(endorser)
+            ptx.endo_item_idx.append(len(items))
+            ptx.endorsements.append((endorser, eident))
+            items.append((
+                int.from_bytes(bytes(native.e_digest[j]), "big"),
+                int.from_bytes(bytes(native.e_r[j]), "big"),
+                int.from_bytes(bytes(native.e_s[j]), "big"),
+                eqx, eqy,
+            ))
 
     # -- the pipeline ------------------------------------------------------
 

@@ -1,0 +1,147 @@
+"""Native C++ block pre-parser: bit-exact equivalence with the Python
+parse path across a mixed adversarial block, and identical validator
+verdicts with the fast path forced on and off."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from fabric_tpu import protoutil as pu
+from fabric_tpu.crypto import cryptogen
+from fabric_tpu.crypto import policy as pol
+from fabric_tpu.crypto.identity import sig_to_ints
+from fabric_tpu.crypto.msp import MSPManager
+from fabric_tpu.ledger.rwset import TxRWSet
+from fabric_tpu.ledger.statedb import MemVersionedDB, UpdateBatch
+from fabric_tpu.native import blockparse as nbp
+from fabric_tpu.peer import txassembly as txa
+from fabric_tpu.peer.validator import BlockValidator, NamespaceInfo, PolicyProvider
+from fabric_tpu.protos import common_pb2, transaction_pb2
+
+C = transaction_pb2.TxValidationCode
+CHANNEL, CC = "natchan", "natcc"
+
+
+@pytest.fixture(scope="module")
+def net():
+    org1 = cryptogen.generate_org("Org1MSP", "org1.example.com", peers=1, users=1)
+    org2 = cryptogen.generate_org("Org2MSP", "org2.example.com", peers=1)
+    return {
+        "mgr": MSPManager({"Org1MSP": org1.msp(), "Org2MSP": org2.msp()}),
+        "client": cryptogen.signing_identity(org1, "User1@org1.example.com"),
+        "p1": cryptogen.signing_identity(org1, "peer0.org1.example.com"),
+        "p2": cryptogen.signing_identity(org2, "peer0.org2.example.com"),
+    }
+
+
+def _tx(net, endorsers, writes=(), reads=(), tamper=None):
+    signed, tx_id, prop = txa.create_signed_proposal(
+        net["client"], CHANNEL, CC, [b"invoke"]
+    )
+    tx = TxRWSet()
+    ns = tx.ns_rwset(CC)
+    for k, ver in reads:
+        ns.reads[k] = ver
+    for k, v in writes:
+        ns.writes[k] = v
+    rw = tx.to_proto().SerializeToString()
+    resps = [txa.create_proposal_response(prop, rw, e, CC) for e in endorsers]
+    env = txa.assemble_transaction(prop, resps, net["client"])
+    if tamper == "sig":
+        env.signature = env.signature[:-3] + bytes(3)
+    elif tamper == "endo":
+        payload = pu.unmarshal(common_pb2.Payload, env.payload)
+        t = pu.unmarshal(transaction_pb2.Transaction, payload.data)
+        cap = pu.unmarshal(
+            transaction_pb2.ChaincodeActionPayload, t.actions[0].payload
+        )
+        sig = bytearray(cap.action.endorsements[0].signature)
+        sig[-2] ^= 0xFF
+        cap.action.endorsements[0].signature = bytes(sig)
+        t.actions[0].payload = cap.SerializeToString()
+        payload.data = t.SerializeToString()
+        env.payload = payload.SerializeToString()
+        env.signature = net["client"].sign(env.payload)
+    return env
+
+
+def _mixed_block(net, num=2):
+    envs = [
+        _tx(net, [net["p1"], net["p2"]], writes=[("a", b"1")]),
+        _tx(net, [net["p1"]], writes=[("b", b"2")]),           # under-endorsed
+        _tx(net, [net["p1"], net["p2"]], tamper="sig"),        # bad creator sig
+        _tx(net, [net["p1"], net["p2"]], tamper="endo"),       # bad endorsement
+        _tx(net, [net["p1"], net["p2"]],
+            reads=[("stale", (9, 9))], writes=[("c", b"3")]),  # mvcc conflict
+        _tx(net, [net["p1"], net["p2"], net["p1"]],            # dup endorser
+            writes=[("d", b"4")]),
+    ]
+    raw = [e.SerializeToString() for e in envs]
+    raw.append(b"")                 # nil envelope
+    raw.append(b"\x09garbage")      # malformed
+    # pad with valid txs so the native fast path engages (>= 16)
+    while len(raw) < 18:
+        raw.append(_tx(net, [net["p1"], net["p2"]],
+                       writes=[(f"p{len(raw)}", b"x")]).SerializeToString())
+    blk = pu.new_block(num, b"prev")
+    for r in raw:
+        blk.data.data.append(r)
+    return pu.finalize_block(blk)
+
+
+def _validator(net):
+    state = MemVersionedDB()
+    seed = UpdateBatch()
+    seed.put(CC, "stale", b"v", (1, 0))
+    state.apply_updates(seed, (1, 0))
+    policy = pol.from_dsl("AND('Org1MSP.peer', 'Org2MSP.peer')")
+    return BlockValidator(
+        net["mgr"], PolicyProvider({CC: NamespaceInfo(policy=policy)}), state
+    )
+
+
+def test_native_vs_python_identical_verdicts(net, monkeypatch):
+    blk = _mixed_block(net)
+    v1 = _validator(net)
+    flt_fast, batch_fast, hist_fast = v1.validate(blk)
+
+    # force the python path by disabling the native library
+    import fabric_tpu.native as nat
+
+    monkeypatch.setattr(nat, "_lib", None)
+    monkeypatch.setattr(nat, "_lib_failed", True)
+    v2 = _validator(net)
+    flt_slow, batch_slow, hist_slow = v2.validate(blk)
+
+    assert list(flt_fast) == list(flt_slow)
+    assert flt_fast[0] == C.VALID
+    assert flt_fast[1] == C.ENDORSEMENT_POLICY_FAILURE
+    assert flt_fast[2] == C.BAD_CREATOR_SIGNATURE
+    assert flt_fast[3] == C.ENDORSEMENT_POLICY_FAILURE
+    assert flt_fast[4] == C.MVCC_READ_CONFLICT
+    assert flt_fast[5] == C.VALID
+    assert flt_fast[6] == C.NIL_ENVELOPE
+    assert flt_fast[7] == C.BAD_PAYLOAD
+    assert sorted(batch_fast.updates) == sorted(batch_slow.updates)
+    assert hist_fast == hist_slow
+
+
+def test_native_span_extraction(net):
+    env = _tx(net, [net["p1"], net["p2"]], writes=[("k", b"v")])
+    raw = env.SerializeToString()
+    out = nbp.parse_envelopes([raw])
+    if out is None:
+        pytest.skip("no native toolchain")
+    assert out.ok[0] == 1
+    e0 = pu.unmarshal(common_pb2.Envelope, raw)
+    payload = pu.unmarshal(common_pb2.Payload, e0.payload)
+    sh = pu.unmarshal(common_pb2.SignatureHeader, payload.header.signature_header)
+    assert out.span(out.creator_span, 0) == sh.creator
+    assert bytes(out.payload_digest[0]) == hashlib.sha256(e0.payload).digest()
+    r, s = sig_to_ints(e0.signature)
+    assert int.from_bytes(bytes(out.creator_r[0]), "big") == r
+    assert int.from_bytes(bytes(out.creator_s[0]), "big") == s
+    _, _, cap, prp, cca = pu.extract_action(e0)
+    assert out.span(out.results_span, 0) == cca.results
+    assert int(out.endo_count[0]) == 2
