@@ -9,7 +9,7 @@ deliberate and TPU-motivated:
   (the reference adds a cache layer, msp/cache) and exposed batch-wise:
   ``match_matrix`` classifies every distinct endorser of a block once,
   producing the [signers × principals] boolean matrix the policy
-  kernel consumes (fabric_tpu.ops.policy_eval).
+  kernel consumes (fabric_tpu.peer.device_block).
 * Chain validation is explicit two-level (root → [intermediate] →
   leaf) path checking via issuer signature verification + validity
   windows + CRL serial check — the reference delegates to Go's x509

@@ -17,7 +17,7 @@ TPU-first re-ordering — compute first, control flow after:
                   versions for every read key.
   phase 1 (TPU)   ONE batched ECDSA verify over all signatures
                   (ops.p256), ONE vectorized policy reduction per
-                  distinct policy shape (ops.policy_eval).
+                  distinct policy shape (peer.device_block).
   phase 2 (TPU)   ONE MVCC kernel call over the whole block (ops.mvcc)
                   with pre_ok = structural ∧ creator-sig ∧ policy.
   phase 3 (host)  TRANSACTIONS_FILTER codes, update batch, history

@@ -100,21 +100,6 @@ def test_counts_equal_interpreter_when_safe(rng):
         assert plan.evaluate_counts(m) == pol.evaluate(rule, m)
 
 
-def test_batch_kernel_matches_counts(rng):
-    """Device kernel over a block == host count evaluation per tx."""
-    from fabric_tpu.ops import policy_eval
-
-    rule = pol.from_dsl("AND('O1.member', OR('O2.member', 'O3.admin'))")
-    plan = pol.compile_plan(rule)
-    T, S, P = 16, 3, len(plan.principals)
-    valid = rng.random((T, S)) > 0.3
-    sat = rng.random((T, S, P)) > 0.5
-    got = np.asarray(policy_eval.eval_block(plan, valid, sat))
-    for t in range(T):
-        m = valid[t][:, None] & sat[t]
-        assert got[t] == plan.evaluate_counts(m), t
-
-
 def test_nested_plan_compile():
     rule = pol.from_dsl(
         "OutOf(2, 'A.member', AND('B.member', 'C.member'), OR('D.member', 'A.admin'))"
@@ -158,20 +143,6 @@ def test_counts_equal_interpreter_with_repeats(rng):
         plan, m = _sat(rule, idents)
         assert plan.consumption_safe(m)
         assert plan.evaluate_counts(m) == pol.evaluate(rule, m), (rule, idents)
-
-
-def test_batch_kernel_repeated_principal(rng):
-    """Device kernel honors per-column consumption budgets."""
-    from fabric_tpu.ops import policy_eval
-
-    a = pol.SignedBy(pol.Principal("A"))
-    rule = pol.NOutOf(2, (a, a))
-    plan = pol.compile_plan(rule)
-    # tx0: one A-signature; tx1: two A-signatures
-    valid = np.array([[True, False], [True, True]])
-    sat = np.ones((2, 2, 1), bool)
-    got = np.asarray(policy_eval.eval_block(plan, valid, sat))
-    assert list(got) == [False, True]
 
 
 def test_nested_repeated_principals_across_gates(rng):
