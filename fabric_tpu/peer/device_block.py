@@ -121,12 +121,19 @@ def build_stage2(t_bucket: int, n_sig: int, group_sigs: tuple):
     return jax.jit(stage2)
 
 
+_PROGRAM_CACHE: dict = {}
+
+
 class DeviceBlockPipeline:
     """Caches compiled stage-2 programs keyed by static block shape +
-    the set of policy plans in play."""
+    the set of policy plans in play.
+
+    The cache is MODULE-global: the key (buckets + PlanSig tuples) is
+    fully structural, so validators across channels/instances share the
+    traced program — a fresh validator must not pay a retrace."""
 
     def __init__(self):
-        self._cache: dict = {}
+        self._cache = _PROGRAM_CACHE
 
     def run(self, handle, creator_idx, structural_ok, groups, mvcc_arrays,
             pre_ok_pad_len):
