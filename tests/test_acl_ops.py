@@ -1,0 +1,161 @@
+"""Tests for ACL enforcement, orderer broadcast throttling, and the
+Snapshot RPC — the operator-surface features (reference: core/aclmgmt,
+orderer/common/throttle, core/ledger/snapshotgrpc)."""
+
+import asyncio
+import json
+
+import pytest
+
+from fabric_tpu import protoutil as pu
+from fabric_tpu.crypto import cryptogen
+from fabric_tpu.peer import txassembly as txa
+from fabric_tpu.peer.node import PeerChannel
+from fabric_tpu.tools import configtxgen as cg
+
+CHANNEL = "aclchan"
+CC = "aclcc"
+
+
+@pytest.fixture(scope="module")
+def material():
+    orgs = [
+        cryptogen.generate_org(f"Org{i}MSP", f"org{i}.example.com", peers=1, users=1)
+        for i in (1, 2)
+    ]
+    # Org2 is NOT an application org → its members are not Writers
+    profile = cg.Profile(
+        CHANNEL, application_orgs=[cg.OrgProfile(orgs[0].msp_id, orgs[0].msp())]
+    )
+    return {
+        "orgs": orgs,
+        "genesis": cg.genesis_block(profile),
+        "writer": cryptogen.signing_identity(orgs[0], "User1@org1.example.com"),
+        "outsider": cryptogen.signing_identity(orgs[1], "User1@org2.example.com"),
+        "peer_signer": cryptogen.signing_identity(orgs[0], "peer0.org1.example.com"),
+    }
+
+
+def test_acl_propose_writers_gate(material, tmp_path):
+    """peer/Propose maps to /Channel/Application/Writers: a member of a
+    non-channel org is rejected with 403 before simulation."""
+    from fabric_tpu.crypto.msp import MSPManager
+    from fabric_tpu.peer.chaincode import ChaincodeRuntime, KVContract
+
+    ch = PeerChannel(
+        CHANNEL, str(tmp_path / "p"), genesis_block=material["genesis"]
+    )
+    # the endorser-side MSP manager knows BOTH orgs (the outsider has a
+    # valid identity — only the ACL can reject it)
+    mgr = MSPManager({
+        o.msp_id: o.msp() for o in material["orgs"]
+    })
+    rt = ChaincodeRuntime()
+    rt.register(CC, KVContract())
+    endorser = ch.make_endorser(mgr, material["peer_signer"], rt)
+
+    ok_prop, _, _ = txa.create_signed_proposal(
+        material["writer"], CHANNEL, CC, [b"put", b"k", b"v"]
+    )
+    res = endorser.process_proposal(ok_prop)
+    assert res.response.response.status == 200, res.response.response.message
+
+    bad_prop, _, _ = txa.create_signed_proposal(
+        material["outsider"], CHANNEL, CC, [b"put", b"k", b"v"]
+    )
+    res = endorser.process_proposal(bad_prop)
+    assert res.response.response.status == 403
+    ch.stop()
+
+
+def test_snapshot_rpc(material, tmp_path):
+    """The Snapshot RPC exports a verifiable snapshot of a channel."""
+    import urllib.request
+
+    from fabric_tpu.comm.rpc import RpcClient
+    from fabric_tpu.crypto.msp import MSPManager
+    from fabric_tpu.ledger import snapshot as snap
+    from fabric_tpu.peer.node import PeerNode
+
+    async def scenario():
+        mgr = MSPManager({material["orgs"][0].msp_id: material["orgs"][0].msp()})
+        node = PeerNode(
+            "p0", str(tmp_path / "node"), mgr, material["peer_signer"]
+        )
+        await node.start(operations_port=0)
+        node.join_channel(CHANNEL, genesis_block=material["genesis"])
+        try:
+            cli = RpcClient("127.0.0.1", node.port)
+            await cli.connect()
+            out_dir = str(tmp_path / "snap")
+            raw = await cli.unary("Snapshot", json.dumps(
+                {"channel": CHANNEL, "out_dir": out_dir}
+            ).encode(), timeout=60)
+            res = json.loads(raw)
+            assert res["status"] == 200, res
+            assert res["metadata"]["last_block_number"] == 0
+            assert snap.verify_snapshot(out_dir)
+            # unknown channel → 404
+            raw = await cli.unary("Snapshot", json.dumps(
+                {"channel": "nope", "out_dir": out_dir}
+            ).encode())
+            assert json.loads(raw)["status"] == 404
+            await cli.close()
+            # the operations server is live alongside
+            st = await asyncio.get_event_loop().run_in_executor(
+                None,
+                lambda: urllib.request.urlopen(
+                    f"http://127.0.0.1:{node.operations.port}/healthz", timeout=5
+                ).status,
+            )
+            assert st == 200
+        finally:
+            await node.stop()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(asyncio.wait_for(scenario(), 90))
+    finally:
+        loop.close()
+
+
+def test_broadcast_throttle(tmp_path):
+    """Token-bucket rate limit: overflow traffic gets 429; sub-1/s
+    rates still admit the first message."""
+    from fabric_tpu.ordering.blockcutter import BatchConfig
+    from fabric_tpu.ordering.node import OrdererNode
+
+    async def scenario():
+        n = OrdererNode(
+            "o0", str(tmp_path / "o0"), {},
+            batch_config=BatchConfig(max_message_count=100, batch_timeout_s=5),
+        )
+        await n.start()
+        n.cluster["o0"] = ("127.0.0.1", n.port)
+        n.join_channel("tchan")
+        n.broadcast_rate = 2.0
+        try:
+            hdr = json.dumps({"channel": "tchan"}).encode()
+            req = len(hdr).to_bytes(4, "big") + hdr + b"env"
+            codes = []
+            for _ in range(6):
+                # drive the handler directly; the limiter acts before
+                # consensus sees the message
+                codes.append(json.loads(await n._on_broadcast(req))["status"])
+            assert codes.count(429) >= 3, codes
+            assert codes[0] != 429
+
+            n.broadcast_rate = 0.5  # sub-1/s must still pass initially
+            n._throttle.clear()
+            first = json.loads(await n._on_broadcast(req))["status"]
+            assert first != 429
+            second = json.loads(await n._on_broadcast(req))["status"]
+            assert second == 429
+        finally:
+            await n.stop()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(asyncio.wait_for(scenario(), 60))
+    finally:
+        loop.close()
