@@ -135,7 +135,13 @@ static bool varint(const uint8_t*& p, const uint8_t* end, uint64_t& out) {
 // LAST occurrence of length-delimited field `field` — protobuf
 // last-field-wins semantics, matching the Python decoder exactly (a
 // duplicate-field envelope must not validate differently on the two
-// parse paths)
+// parse paths).
+//
+// All length checks compare the attacker-controlled varint length
+// against the REMAINING size (`len > uint64_t(end - p)`) — never
+// `p + len > end`, whose pointer arithmetic is UB and wraps for huge
+// lengths, letting a crafted envelope pass the check with an
+// out-of-bounds span.
 static Span field_bytes(const uint8_t* p, size_t n, uint32_t field) {
   const uint8_t* end = p + n;
   Span found{};
@@ -145,7 +151,7 @@ static Span field_bytes(const uint8_t* p, size_t n, uint32_t field) {
     uint32_t f = uint32_t(key >> 3), wt = uint32_t(key & 7);
     if (wt == 2) {
       uint64_t len;
-      if (!varint(p, end, len) || p + len > end) return {};
+      if (!varint(p, end, len) || len > uint64_t(end - p)) return {};
       if (f == field) found = {p, size_t(len), true};
       p += len;
     } else if (wt == 0) {
@@ -153,10 +159,10 @@ static Span field_bytes(const uint8_t* p, size_t n, uint32_t field) {
       if (!varint(p, end, v)) return {};
       (void)v;
     } else if (wt == 5) {
-      if (p + 4 > end) return {};
+      if (uint64_t(end - p) < 4) return {};
       p += 4;
     } else if (wt == 1) {
-      if (p + 8 > end) return {};
+      if (uint64_t(end - p) < 8) return {};
       p += 8;
     } else {
       return {};
@@ -179,13 +185,13 @@ static bool field_varint(const uint8_t* p, size_t n, uint32_t field,
       if (f == field) { out = v; got = true; }  // last wins
     } else if (wt == 2) {
       uint64_t len;
-      if (!varint(p, end, len) || p + len > end) return false;
+      if (!varint(p, end, len) || len > uint64_t(end - p)) return false;
       p += len;
     } else if (wt == 5) {
-      if (p + 4 > end) return false;
+      if (uint64_t(end - p) < 4) return false;
       p += 4;
     } else if (wt == 1) {
-      if (p + 8 > end) return false;
+      if (uint64_t(end - p) < 8) return false;
       p += 8;
     } else {
       return false;
@@ -202,7 +208,7 @@ static bool der_sig(const uint8_t* p, size_t n, uint8_t r[32], uint8_t s[32]) {
     uint8_t b = *q++;
     if (b < 0x80) { len = b; return true; }
     int cnt = b & 0x7f;
-    if (cnt < 1 || cnt > 2 || q + cnt > end) return false;
+    if (cnt < 1 || cnt > 2 || cnt > end - q) return false;
     len = 0;
     while (cnt--) len = (len << 8) | *q++;
     return true;
@@ -210,7 +216,7 @@ static bool der_sig(const uint8_t* p, size_t n, uint8_t r[32], uint8_t s[32]) {
   auto read_int = [&](const uint8_t*& q, uint8_t out[32]) -> bool {
     if (q >= end || *q++ != 0x02) return false;
     size_t len;
-    if (!read_len(q, len) || len == 0 || q + len > end) return false;
+    if (!read_len(q, len) || len == 0 || len > size_t(end - q)) return false;
     const uint8_t* v = q;
     q += len;
     if (v[0] & 0x80) return false;              // negative: invalid
@@ -226,7 +232,7 @@ static bool der_sig(const uint8_t* p, size_t n, uint8_t r[32], uint8_t s[32]) {
   const uint8_t* q = p + 1;
   size_t total;
   if (!read_len(q, total)) return false;
-  if (q + total != end) return false;           // exact outer length
+  if (total != size_t(end - q)) return false;   // exact outer length
   if (!read_int(q, r) || !read_int(q, s)) return false;
   return q == end;                              // no trailing bytes
 }
@@ -336,12 +342,12 @@ int64_t parse_block(
       if (wt != 2) {
         uint64_t v;
         if (wt == 0) { if (!varint(p, cend, v)) break; continue; }
-        if (wt == 5) { p += 4; continue; }
-        if (wt == 1) { p += 8; continue; }
+        if (wt == 5) { if (uint64_t(cend - p) < 4) break; p += 4; continue; }
+        if (wt == 1) { if (uint64_t(cend - p) < 8) break; p += 8; continue; }
         break;
       }
       uint64_t flen;
-      if (!varint(p, cend, flen) || p + flen > cend) break;
+      if (!varint(p, cend, flen) || flen > uint64_t(cend - p)) break;
       const uint8_t* fp = p;
       p += flen;
       if (f != 2) continue;

@@ -145,3 +145,49 @@ def test_native_span_extraction(net):
     _, _, cap, prp, cca = pu.extract_action(e0)
     assert out.span(out.results_span, 0) == cca.results
     assert int(out.endo_count[0]) == 2
+
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out.append(b | (0x80 if v else 0))
+        if not v:
+            return bytes(out)
+
+
+def test_native_adversarial_lengths(net):
+    """Crafted wire bytes with huge/overflowing varint lengths must not
+    crash or mis-span — the `p + len > end` pointer form would wrap and
+    accept them (ADVICE r3: overflow UB on attacker-controlled
+    lengths).  Every case must come back ok=0 / harmless, byte-for-byte
+    identical behavior to the Python decoder's rejection."""
+    good = _tx(net, [net["p1"], net["p2"]], writes=[("k", b"v")])
+    good_raw = good.SerializeToString()
+
+    def fld(field: int, payload: bytes) -> bytes:
+        return _varint(field << 3 | 2) + _varint(len(payload)) + payload
+
+    huge = (1 << 64) - 9  # wraps p + len back below end
+    cases = [
+        # envelope payload-field length far beyond the buffer
+        _varint(1 << 3 | 2) + _varint(huge) + b"x" * 32,
+        # plausible envelope whose nested header length overflows
+        fld(1, _varint(1 << 3 | 2) + _varint(huge) + b"y" * 8) + fld(2, b"sig"),
+        # fixed32/fixed64 fields truncated at the buffer edge
+        _varint(5 << 3 | 5) + b"\x01",
+        _varint(5 << 3 | 1) + b"\x01\x02",
+        # DER signature with a huge inner INTEGER length
+        fld(1, fld(1, fld(1, b"\x08\x03") + fld(2, b"\x0a\x02hi")))
+        + fld(2, b"\x30\x84\xff\xff\xff\xff\x02\x01\x01"),
+        # truncated varint at end of buffer
+        b"\xff\xff\xff",
+        b"",
+    ]
+    out = nbp.parse_envelopes(cases + [good_raw])
+    if out is None:
+        pytest.skip("no native toolchain")
+    for i in range(len(cases)):
+        assert out.ok[i] == 0
+    assert out.ok[len(cases)] == 1  # sane envelope still parses
