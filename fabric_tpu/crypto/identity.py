@@ -82,6 +82,19 @@ class Identity:
         n = pub.public_numbers()
         return (n.x, n.y)
 
+    @cached_property
+    def rns_pub(self):
+        """(qx_residues, qy_residues) [2n] int32 — cached per identity
+        so the commit path's signature-batch assembly is a numpy gather
+        over the block's (few) distinct endorser keys, not a per-item
+        bigint→residue conversion (a block re-presents the same certs
+        thousands of times)."""
+        from fabric_tpu.ops import rns
+
+        qx, qy = self.public_numbers
+        res = rns.ints_to_rns([qx, qy])
+        return res[0], res[1]
+
     def verify_item(self, message: bytes, der_sig: bytes):
         """→ (digest_int, r, s, qx, qy) for the batched TPU verifier."""
         r, s = decode_dss_signature(der_sig)

@@ -18,25 +18,23 @@ import subprocess
 log = logging.getLogger("fabric_tpu.native")
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "blockparse.cpp")
-_SO = os.path.join(_DIR, "_build", "libblockparse.so")
 
-_lib = None
-_lib_failed = False
+_libs: dict = {}       # name → CDLL
+_lib_failed: set = set()
 
 
-def _build() -> bool:
-    os.makedirs(os.path.dirname(_SO), exist_ok=True)
-    tmp = f"{_SO}.{os.getpid()}.tmp"
+def _build(src: str, so: str) -> bool:
+    os.makedirs(os.path.dirname(so), exist_ok=True)
+    tmp = f"{so}.{os.getpid()}.tmp"
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp],
             check=True, capture_output=True, timeout=120,
         )
-        os.replace(tmp, _SO)  # atomic: concurrent builders can't corrupt
+        os.replace(tmp, so)  # atomic: concurrent builders can't corrupt
         return True
     except Exception as e:
-        log.warning("native blockparse build failed (%s); using Python path", e)
+        log.warning("native %s build failed (%s); using Python path", src, e)
         try:
             os.unlink(tmp)
         except OSError:
@@ -44,23 +42,43 @@ def _build() -> bool:
         return False
 
 
-def blockparse_lib():
-    """→ ctypes CDLL with parse_block, or None (Python fallback)."""
-    global _lib, _lib_failed
-    if _lib is not None or _lib_failed:
-        return _lib
-    fresh = os.path.exists(_SO) and (
-        os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+def _load(name: str):
+    """Build-if-stale + dlopen fabric_tpu/native/<name>.cpp → CDLL or
+    None (callers fall back to their pure-Python paths)."""
+    if name in _libs:
+        return _libs[name]
+    if name in _lib_failed:
+        return None
+    src = os.path.join(_DIR, f"{name}.cpp")
+    so = os.path.join(_DIR, "_build", f"lib{name}.so")
+    fresh = os.path.exists(so) and (
+        os.path.getmtime(so) >= os.path.getmtime(src)
     )
-    if not fresh and not _build():
-        _lib_failed = True
+    if not fresh and not _build(src, so):
+        _lib_failed.add(name)
         return None
     try:
-        lib = ctypes.CDLL(_SO)
+        lib = ctypes.CDLL(so)
     except OSError as e:
-        log.warning("native blockparse load failed (%s)", e)
-        _lib_failed = True
+        log.warning("native %s load failed (%s)", name, e)
+        _lib_failed.add(name)
         return None
-    lib.parse_block.restype = ctypes.c_int64
-    _lib = lib
-    return _lib
+    _libs[name] = lib
+    return lib
+
+
+def blockparse_lib():
+    """→ ctypes CDLL with parse_block, or None (Python fallback)."""
+    lib = _load("blockparse")
+    if lib is not None:
+        lib.parse_block.restype = ctypes.c_int64
+    return lib
+
+
+def ecprep_lib():
+    """→ ctypes CDLL with ec_prepare (batch u1/u2 window recoding +
+    admission flags), or None (Python fallback)."""
+    lib = _load("ecprep")
+    if lib is not None:
+        lib.ec_prepare.restype = None
+    return lib

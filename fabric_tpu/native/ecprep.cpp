@@ -1,0 +1,220 @@
+// Native ECDSA verify preparation for the commit hot path.
+//
+// The TPU kernel (ops/p256v3.py) receives 4-bit window digits of
+// u1 = e·s⁻¹ mod n and u2 = r·s⁻¹ mod n; computing them for a block's
+// ~3000 signatures in Python costs tens of ms of bigint loops under
+// the GIL (round-3 bench: the single largest host phase).  This module
+// does the whole batch in one C call — Montgomery batch inversion
+// (one Fermat exponentiation + 3(B−1) modmuls, the same algorithm as
+// p256v3._batch_inv_mod_n) over 4×64-limb arithmetic — and ctypes
+// releases the GIL for the duration, so the work also overlaps the
+// commit pipeline's other host phases.
+//
+// Semantics pinned to ops/p256v3.prepare_cols (and transitively to the
+// reference accept set, bccsp/sw/ecdsa.go:41-58): admission is
+// 0 < r < n ∧ 0 < s ≤ n/2; rows failing 0 < s < n invert s = 1 so the
+// batch product stays invertible; rpn_ok ⇔ r + n < p.
+//
+// Built on demand with g++ (see fabric_tpu/native/__init__.py).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+typedef unsigned __int128 u128;
+
+struct U256 {
+  uint64_t w[4];  // little-endian limbs
+};
+
+// P-256 group order n and field prime p
+static const U256 ORDER_N = {{0xf3b9cac2fc632551ull, 0xbce6faada7179e84ull,
+                              0xffffffffffffffffull, 0xffffffff00000000ull}};
+static const U256 PRIME_P = {{0xffffffffffffffffull, 0x00000000ffffffffull,
+                              0x0000000000000000ull, 0xffffffff00000001ull}};
+
+static int cmp(const U256& a, const U256& b) {
+  for (int i = 3; i >= 0; i--) {
+    if (a.w[i] < b.w[i]) return -1;
+    if (a.w[i] > b.w[i]) return 1;
+  }
+  return 0;
+}
+
+static bool is_zero(const U256& a) {
+  return !(a.w[0] | a.w[1] | a.w[2] | a.w[3]);
+}
+
+// a - b, returns borrow
+static uint64_t sub(U256& out, const U256& a, const U256& b) {
+  uint64_t borrow = 0;
+  for (int i = 0; i < 4; i++) {
+    u128 d = (u128)a.w[i] - b.w[i] - borrow;
+    out.w[i] = (uint64_t)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+  return borrow;
+}
+
+// a + b, returns carry
+static uint64_t add(U256& out, const U256& a, const U256& b) {
+  uint64_t carry = 0;
+  for (int i = 0; i < 4; i++) {
+    u128 s = (u128)a.w[i] + b.w[i] + carry;
+    out.w[i] = (uint64_t)s;
+    carry = (uint64_t)(s >> 64);
+  }
+  return carry;
+}
+
+// Montgomery context for one odd 256-bit modulus (R = 2^256)
+struct Mont {
+  U256 mod;
+  uint64_t n0;  // -mod^{-1} mod 2^64
+  U256 R2;      // 2^512 mod mod
+
+  void init(const U256& m) {
+    mod = m;
+    // Newton iteration for mod^{-1} mod 2^64, then negate
+    uint64_t inv = m.w[0];
+    for (int i = 0; i < 6; i++) inv *= 2 - m.w[0] * inv;
+    n0 = (uint64_t)(0 - inv);
+    // R2 = 2^512 mod m by 512 modular doublings of 1
+    U256 x = {{1, 0, 0, 0}};
+    for (int i = 0; i < 512; i++) {
+      uint64_t carry = add(x, x, x);
+      if (carry || cmp(x, mod) >= 0) sub(x, x, mod);
+    }
+    R2 = x;
+  }
+
+  // CIOS Montgomery multiplication: a·b·2^{-256} mod m.
+  // Safe for any a, b < 2^256 (output < m + small overflow handled by
+  // the final conditional subtract; garbage-in rows are masked by the
+  // kernel's pre_ok anyway).
+  U256 mul(const U256& a, const U256& b) const {
+    uint64_t t[6] = {0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 4; i++) {
+      uint64_t carry = 0;
+      for (int j = 0; j < 4; j++) {
+        u128 s = (u128)t[j] + (u128)a.w[i] * b.w[j] + carry;
+        t[j] = (uint64_t)s;
+        carry = (uint64_t)(s >> 64);
+      }
+      u128 s = (u128)t[4] + carry;
+      t[4] = (uint64_t)s;
+      t[5] = (uint64_t)(s >> 64);
+
+      uint64_t mfac = t[0] * n0;
+      carry = 0;
+      for (int j = 0; j < 4; j++) {
+        u128 s2 = (u128)t[j] + (u128)mfac * mod.w[j] + carry;
+        t[j] = (uint64_t)s2;
+        carry = (uint64_t)(s2 >> 64);
+      }
+      s = (u128)t[4] + carry;
+      t[4] = (uint64_t)s;
+      t[5] += (uint64_t)(s >> 64);
+      // shift right one limb
+      t[0] = t[1]; t[1] = t[2]; t[2] = t[3]; t[3] = t[4]; t[4] = t[5];
+      t[5] = 0;
+    }
+    U256 r = {{t[0], t[1], t[2], t[3]}};
+    if (t[4] || cmp(r, mod) >= 0) sub(r, r, mod);
+    return r;
+  }
+
+  U256 to_mont(const U256& a) const { return mul(a, R2); }
+
+  // x^(mod-2) in Montgomery domain (Fermat inverse for prime modulus)
+  U256 inv_mont(const U256& x) const {
+    U256 e;
+    sub(e, mod, U256{{2, 0, 0, 0}});
+    U256 one_m = to_mont(U256{{1, 0, 0, 0}});
+    U256 acc = one_m;
+    for (int i = 255; i >= 0; i--) {
+      acc = mul(acc, acc);
+      if ((e.w[i / 64] >> (i % 64)) & 1) acc = mul(acc, x);
+    }
+    return acc;
+  }
+};
+
+static U256 load_be(const uint8_t* p) {
+  U256 v;
+  for (int i = 0; i < 4; i++) {
+    uint64_t w = 0;
+    for (int j = 0; j < 8; j++) w = (w << 8) | p[8 * i + j];
+    v.w[3 - i] = w;
+  }
+  return v;
+}
+
+// 4-bit window digits, MSB-first (matches p256v3._windows)
+static void windows_of(const U256& v, int32_t* out) {
+  for (int i = 0; i < 32; i++) {
+    int byte = 31 - i;  // big-endian byte order
+    uint64_t b = (v.w[byte / 8] >> (8 * (byte % 8))) & 0xff;
+    out[2 * i] = (int32_t)(b >> 4);
+    out[2 * i + 1] = (int32_t)(b & 0xf);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Batch scalar preparation: e, r, s are [B, 32] big-endian byte rows.
+// Outputs: w1/w2 [B, 64] int32 window digits of u1 = e·s⁻¹, u2 = r·s⁻¹
+// (mod n); flags [B] uint8 with bit0 = admission ok
+// (0 < r < n ∧ 0 < s ≤ n/2), bit1 = rpn_ok (r + n < p).
+void ec_prepare(const uint8_t* e_b, const uint8_t* r_b, const uint8_t* s_b,
+                int64_t B, int32_t* w1, int32_t* w2, uint8_t* flags) {
+  if (B <= 0) return;
+  // magic static: thread-safe one-time init (ctypes releases the GIL,
+  // so concurrent first calls from prefetch threads are real)
+  static const Mont M = [] { Mont m; m.init(ORDER_N); return m; }();
+
+  U256 half_n;  // n >> 1  (n odd → floor(n/2))
+  for (int i = 0; i < 4; i++)
+    half_n.w[i] = (ORDER_N.w[i] >> 1) |
+                  (i < 3 ? (ORDER_N.w[i + 1] << 63) : 0);
+  U256 p_minus_n;
+  sub(p_minus_n, PRIME_P, ORDER_N);
+
+  U256* s_hat = new U256[B];   // ŝ = s·R (s forced to 1 when out of range)
+  U256* pref = new U256[B + 1];
+  U256 one_m = M.to_mont(U256{{1, 0, 0, 0}});
+
+  for (int64_t i = 0; i < B; i++) {
+    U256 r = load_be(r_b + 32 * i);
+    U256 s = load_be(s_b + 32 * i);
+    bool r_ok = !is_zero(r) && cmp(r, ORDER_N) < 0;
+    bool s_ok = !is_zero(s) && cmp(s, half_n) <= 0;
+    bool s_invertible = !is_zero(s) && cmp(s, ORDER_N) < 0;
+    uint8_t f = (r_ok && s_ok) ? 1 : 0;
+    if (cmp(r, p_minus_n) < 0) f |= 2;  // r + n < p
+    flags[i] = f;
+    s_hat[i] = M.to_mont(s_invertible ? s : U256{{1, 0, 0, 0}});
+  }
+
+  pref[0] = one_m;
+  for (int64_t i = 0; i < B; i++) pref[i + 1] = M.mul(pref[i], s_hat[i]);
+  U256 inv_all = M.inv_mont(pref[B]);
+  for (int64_t i = B - 1; i >= 0; i--) {
+    U256 sinv_m = M.mul(pref[i], inv_all);  // (s_i)⁻¹·R
+    inv_all = M.mul(inv_all, s_hat[i]);
+    U256 e = load_be(e_b + 32 * i);
+    U256 r = load_be(r_b + 32 * i);
+    // mont_mul(plain, x̂) = plain·x mod n — one step, no extra domain hop
+    U256 u1 = M.mul(e, sinv_m);
+    U256 u2 = M.mul(r, sinv_m);
+    windows_of(u1, w1 + 64 * i);
+    windows_of(u2, w2 + 64 * i);
+  }
+  delete[] s_hat;
+  delete[] pref;
+}
+
+}  // extern "C"

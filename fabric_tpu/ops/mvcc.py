@@ -139,12 +139,51 @@ class TxRWSet:
     range_reads: list
 
 
-def prepare_block(txs: list[TxRWSet], committed: dict, bucketed: bool = False):
-    """Build device arrays for `mvcc_validate`.
+@dataclass
+class StaticBlock:
+    """State-INDEPENDENT device arrays for one block + the recipe to
+    fill the committed-version arrays later.
 
-    committed: dict key → (block, txnum) version for present keys
-        (host bulk-preload of every read key, the analog of
-        preLoadCommittedVersionOfRSet).
+    The split exists for the commit pipeline: everything here can be
+    built in the prefetch thread while the previous block is still on
+    device; only `fill_committed` (a gather against the state DB) must
+    wait for the predecessor's state commit."""
+
+    read_keys: np.ndarray      # [T, R] int32
+    read_present: np.ndarray   # [T, R] bool
+    read_vers: np.ndarray      # [T, R, 2] uint32
+    write_keys: np.ndarray     # [T, W] int32
+    rq_lo: np.ndarray          # [T, Q] int32
+    rq_hi: np.ndarray          # [T, Q] int32
+    read_fill: list            # [(j, a, key)] for committed-array fill
+    read_key_set: set          # union of read keys
+
+    def fill_committed(self, committed: dict):
+        """→ (comm_present [T,R] bool, comm_vers [T,R,2] uint32)."""
+        T, R = self.read_keys.shape
+        comm_present = np.zeros((T, R), bool)
+        comm_vers = np.zeros((T, R, 2), np.uint32)
+        for j, a, k in self.read_fill:
+            cv = committed.get(k)
+            if cv is not None:
+                comm_present[j, a] = True
+                comm_vers[j, a] = cv
+        return comm_present, comm_vers
+
+    def device_args(self, committed: dict):
+        """Assemble the full `mvcc_validate` argument tuple (minus
+        pre_ok) in signature order."""
+        comm_present, comm_vers = self.fill_committed(committed)
+        return (
+            jnp.asarray(self.read_keys), jnp.asarray(self.read_present),
+            jnp.asarray(self.read_vers), jnp.asarray(comm_present),
+            jnp.asarray(comm_vers), jnp.asarray(self.write_keys),
+            jnp.asarray(self.rq_lo), jnp.asarray(self.rq_hi),
+        )
+
+
+def prepare_block_static(txs: list[TxRWSet], bucketed: bool = False) -> StaticBlock:
+    """Build the state-independent device arrays for `mvcc_validate`.
 
     Key ids are assigned in lexicographic key order so range bounds map
     to id intervals over the block's key universe (sufficient for
@@ -158,8 +197,11 @@ def prepare_block(txs: list[TxRWSet], committed: dict, bucketed: bool = False):
     from fabric_tpu.utils.batching import next_pow2
 
     universe = set()
+    read_key_set = set()
     for tx in txs:
-        universe.update(k for k, _ in tx.reads)
+        for k, _ in tx.reads:
+            universe.add(k)
+            read_key_set.add(k)
         universe.update(tx.writes)
     for tx in txs:
         for lo, hi in tx.range_reads:
@@ -180,11 +222,10 @@ def prepare_block(txs: list[TxRWSet], committed: dict, bucketed: bool = False):
     read_keys = np.full((T, R), -1, np.int32)
     read_present = np.zeros((T, R), bool)
     read_vers = np.zeros((T, R, 2), np.uint32)
-    comm_present = np.zeros((T, R), bool)
-    comm_vers = np.zeros((T, R, 2), np.uint32)
     write_keys = np.full((T, W), -1, np.int32)
     rq_lo = np.full((T, Q), -1, np.int32)
     rq_hi = np.full((T, Q), -1, np.int32)
+    read_fill: list = []
 
     for j, tx in enumerate(txs):
         for a, (k, ver) in enumerate(tx.reads):
@@ -192,21 +233,24 @@ def prepare_block(txs: list[TxRWSet], committed: dict, bucketed: bool = False):
             if ver is not None:
                 read_present[j, a] = True
                 read_vers[j, a] = ver
-            cv = committed.get(k)
-            if cv is not None:
-                comm_present[j, a] = True
-                comm_vers[j, a] = cv
+            read_fill.append((j, a, k))
         for a, k in enumerate(tx.writes):
             write_keys[j, a] = kid[k]
         for a, (lo, hi) in enumerate(tx.range_reads):
             rq_lo[j, a] = bisect.bisect_left(skeys, lo)
             rq_hi[j, a] = bisect.bisect_left(skeys, hi)
 
-    return (
-        jnp.asarray(read_keys), jnp.asarray(read_present), jnp.asarray(read_vers),
-        jnp.asarray(comm_present), jnp.asarray(comm_vers), jnp.asarray(write_keys),
-        jnp.asarray(rq_lo), jnp.asarray(rq_hi),
+    return StaticBlock(
+        read_keys=read_keys, read_present=read_present, read_vers=read_vers,
+        write_keys=write_keys, rq_lo=rq_lo, rq_hi=rq_hi,
+        read_fill=read_fill, read_key_set=read_key_set,
     )
+
+
+def prepare_block(txs: list[TxRWSet], committed: dict, bucketed: bool = False):
+    """Build the full device-array tuple for `mvcc_validate` (static
+    arrays + committed-version fill in one go)."""
+    return prepare_block_static(txs, bucketed=bucketed).device_args(committed)
 
 
 def mvcc_validate_block(txs: list[TxRWSet], committed: dict, pre_ok=None):

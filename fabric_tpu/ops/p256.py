@@ -417,6 +417,13 @@ def verify_host(items) -> list[bool]:
     batch to a power of two, floored at MIN_BUCKET, and runs the
     jitted limb kernel.
     """
+    if hasattr(items, "tuples"):  # SigCollector column form
+        if _KERNEL in ("v1", "v2"):
+            items = items.tuples()
+        else:
+            from fabric_tpu.ops import p256v3
+
+            return p256v3.verify_launch(items)()
     items = list(items)
     if not items:
         return []
@@ -441,6 +448,8 @@ def verify_launch(items):
         from fabric_tpu.ops import p256v3
 
         return p256v3.verify_launch(items)
+    if hasattr(items, "tuples"):
+        items = items.tuples()
     result = verify_host(items)
     return lambda: result
 

@@ -381,6 +381,19 @@ def ints_to_rns(xs) -> np.ndarray:
     return (acc.astype(np.int64) % primes).astype(np.int32)
 
 
+def bytes_to_rns(be: np.ndarray) -> np.ndarray:
+    """[B, 32] uint8 BIG-endian 256-bit values → [B, 2n] canonical
+    residues — the zero-Python-int fast lane for values the native
+    pre-parser already delivers as byte arrays (r, s, digests).  Same
+    f64 dgemm as ints_to_rns; bytes reverse to little-endian limbs."""
+    if not len(be):
+        return np.zeros((0, 2 * N_CH), np.int32)
+    le = be[:, ::-1].astype(np.float64)  # [B, 32] little-endian limbs
+    acc = le @ _pow8_table()[:32]  # [B, 2n] exact in f64
+    primes = np.array(BASE_A + BASE_B, np.int64)
+    return (acc.astype(np.int64) % primes).astype(np.int32)
+
+
 def to_rns(x: int) -> RV:
     """Single constant → broadcastable RV (numpy-backed: constants
     must stay concrete across jit traces)."""

@@ -107,6 +107,20 @@ class BlockValidationCtx:
     policy_provider: PolicyProvider
 
 
+@dataclass
+class _DevicePre:
+    """State-independent device-path inputs built at preprocess time
+    (prefetch thread): policy groups + static MVCC arrays.  `policies`
+    pins the provider the plans were compiled against — validate()
+    re-preprocesses if the channel config rotated in between."""
+
+    groups: list          # [(plan, match [E,S,P], endo_idx [E,S], tx_of [E])]
+    group_entries: list   # parallel: [(ptx, info), ...] per group
+    static: object        # mvcc_ops.StaticBlock
+    has_range: bool
+    policies: object
+
+
 class BlockValidator:
     """Validate(block) → (tx_filter, UpdateBatch, history_writes)."""
 
@@ -126,6 +140,18 @@ class BlockValidator:
         self.plugins = {"default": DefaultValidation(), **(plugins or {})}
         self.config_processor = config_processor
         self._device_pipeline = None
+        # optional phase accumulator (seconds per phase, summed across
+        # blocks) — the bench publishes it as the per-phase breakdown
+        # artifact; None = no instrumentation overhead
+        self.timings: dict | None = None
+
+    def _t(self, key: str, t0: float) -> float:
+        import time
+
+        t1 = time.perf_counter()
+        if self.timings is not None:
+            self.timings[key] = self.timings.get(key, 0.0) + (t1 - t0)
+        return t1
 
     def warmup(self, n_sigs: int = 16) -> None:
         """Compile (or load from the persistent cache) the signature
@@ -149,8 +175,10 @@ class BlockValidator:
         handle (config txs, malformed bytes) fall back to the Python
         path below, envelope by envelope — identical verdicts either
         way (tests/test_native_parse.py pins the equivalence)."""
+        from fabric_tpu.ops.p256v3 import SigCollector
+
         txs: list[ParsedTx] = []
-        items: list = []  # (digest, r, s, qx, qy)
+        items = SigCollector()  # column-form signature batch
         seen_txids: dict[str, int] = {}
         native = None
         if len(block.data.data) >= 16 and block.header.number != 0:
@@ -200,8 +228,7 @@ class BlockValidator:
                 except Exception:
                     ptx.code = C.BAD_CREATOR_SIGNATURE
                     continue
-                ptx.creator_item_idx = len(items)
-                items.append(item)
+                ptx.creator_item_idx = items.add_slow(item)
                 continue
             if ch.type != common_pb2.HeaderType.ENDORSER_TRANSACTION:
                 ptx.code = C.UNKNOWN_TX_TYPE
@@ -236,8 +263,7 @@ class BlockValidator:
             except Exception:
                 ptx.code = C.BAD_CREATOR_SIGNATURE
                 continue
-            ptx.creator_item_idx = len(items)
-            items.append(item)
+            ptx.creator_item_idx = items.add_slow(item)
 
             # endorsements + rwset
             try:
@@ -261,9 +287,8 @@ class BlockValidator:
                     except Exception:
                         continue  # unparseable endorsement: contributes nothing
                     seen_endorsers.add(e.endorser)
-                    ptx.endo_item_idx.append(len(items))
+                    ptx.endo_item_idx.append(items.add_slow(eitem))
                     ptx.endorsements.append((e.endorser, eident))
-                    items.append(eitem)
             except protoutil.TxParseError as e:
                 ptx.code = e.code
                 continue
@@ -295,20 +320,17 @@ class BlockValidator:
 
         try:
             ident = self.msp.deserialize_identity(creator)
-            qx, qy = ident.public_numbers
+            ident.public_numbers  # EC key required (raises otherwise)
         except Exception:
             ptx.code = C.BAD_CREATOR_SIGNATURE
             return
         if not ident.is_valid or not native.creator_sig_ok[i]:
             ptx.code = C.BAD_CREATOR_SIGNATURE
             return
-        ptx.creator_item_idx = len(items)
-        items.append((
-            int.from_bytes(bytes(native.payload_digest[i]), "big"),
-            int.from_bytes(bytes(native.creator_r[i]), "big"),
-            int.from_bytes(bytes(native.creator_s[i]), "big"),
-            qx, qy,
-        ))
+        ptx.creator_item_idx = items.add_fast(
+            (native.payload_digest, native.creator_r, native.creator_s),
+            i, ident,
+        )
 
         try:
             results = native.span(native.results_span, i) or b""
@@ -327,23 +349,21 @@ class BlockValidator:
                 continue  # dedup by identity (policy.go:360-363)
             try:
                 eident = self.msp.deserialize_identity(endorser)
-                eqx, eqy = eident.public_numbers
+                eident.public_numbers  # EC key required
             except Exception:
                 continue
             seen_endorsers.add(endorser)
-            ptx.endo_item_idx.append(len(items))
-            ptx.endorsements.append((endorser, eident))
-            items.append((
-                int.from_bytes(bytes(native.e_digest[j]), "big"),
-                int.from_bytes(bytes(native.e_r[j]), "big"),
-                int.from_bytes(bytes(native.e_s[j]), "big"),
-                eqx, eqy,
+            ptx.endo_item_idx.append(items.add_fast(
+                (native.e_digest, native.e_r, native.e_s), j, eident,
             ))
+            ptx.endorsements.append((endorser, eident))
 
     # -- the pipeline ------------------------------------------------------
 
     def preprocess(self, block: common_pb2.Block):
-        """Host parse + ASYNC device-verify launch for one block.
+        """Host parse + ASYNC device-verify launch + state-independent
+        device-path inputs (policy match matrices, static MVCC arrays)
+        for one block.
 
         Safe to run for block n+1 while block n is still committing
         (touches no ledger state): the peer's deliver loop and the
@@ -351,22 +371,31 @@ class BlockValidator:
         phase of the current one — the TPU-shaped analog of the
         reference's deliver prefetch + validator pool overlap
         (gossip/state/state.go:540, v20/validator.go:193)."""
+        import time
+
+        t0 = time.perf_counter()
         txs, items = self._parse(block)
+        t0 = self._t("host_parse", t0)
         fetch = p256.verify_launch(items)
+        t0 = self._t("sig_prepare_launch", t0)
+        dpre = self._device_preprocess(txs)
+        self._t("device_pre", t0)
         # the MSP manager the identities were validated against: a
         # config tx in the PREVIOUS block may rotate membership between
         # preprocess and validate — validate() detects and re-parses
-        return txs, items, fetch, self.msp
+        return txs, items, fetch, self.msp, dpre
 
     def validate(self, block: common_pb2.Block, pre=None):
         if pre is None:
             pre = self.preprocess(block)
-        if pre[3] is not self.msp:
-            # membership rotated after this block was preprocessed
-            # (committed config tx): stale identity validations must
-            # not leak into endorsement decisions — redo the parse
+        if pre[3] is not self.msp or (
+            pre[4] is not None and pre[4].policies is not self.policies
+        ):
+            # membership or policy tree rotated after this block was
+            # preprocessed (committed config tx): stale identity
+            # validations / plans must not leak — redo the parse
             pre = self.preprocess(block)
-        txs, items, fetch, _ = pre
+        txs, items, fetch, _, dpre = pre
         # parsed records for post-commit consumers (config rotation) —
         # the commit path is serialized per channel, so this is safe
         self.last_parsed = txs
@@ -384,8 +413,8 @@ class BlockValidator:
         # verify output ON DEVICE (one dispatch + one readback per
         # block); falls back to the host path for custom plugins,
         # non-v3 kernels, or consumption-unsafe blocks
-        if getattr(fetch, "device_out", None) is not None and txs:
-            result = self._validate_device(block, txs, items, fetch)
+        if getattr(fetch, "device_out", None) is not None and txs and dpre:
+            result = self._validate_device(block, txs, items, fetch, dpre)
             if result is not None:
                 return result
 
@@ -463,44 +492,36 @@ class BlockValidator:
 
     # -- fused single-sync device path ------------------------------------
 
-    def _validate_device(self, block, txs, items, handle):
-        """One-dispatch-one-readback validation (device_block): returns
-        (filter, batch, history) or None to fall back."""
+    def _device_preprocess(self, txs):
+        """State-INDEPENDENT device-path inputs: policy match matrices
+        (vectorized gather over per-identity cached principal rows) and
+        static MVCC arrays.  Runs in the prefetch thread, overlapping
+        the previous block's device time; returns None when the block
+        needs the host dispatch path (custom plugins)."""
         from fabric_tpu.ops import mvcc as mvcc_ops
-        from fabric_tpu.peer.device_block import DeviceBlockPipeline
         from fabric_tpu.utils.batching import next_pow2
 
+        if not txs or p256._KERNEL in ("v1", "v2"):
+            return None  # fused device path requires the v3 kernel
         default = self.plugins.get("default")
         if type(default).__name__ != "DefaultValidation":
             return None
 
-        # structural phase (host, deterministic — shared with fallback)
         entries = []  # (ptx, ns, info)
         for ptx in txs:
             if not ptx.undetermined or ptx.is_config:
                 continue
             infos = [self.policies.info(ns) for ns in ptx.namespaces]
             if not ptx.namespaces or any(i is None for i in infos):
-                ptx.code = C.INVALID_CHAINCODE
+                ptx.code = C.INVALID_CHAINCODE  # same verdict on both paths
                 continue
             if any((i.plugin or "default") != "default" for i in infos):
                 return None  # custom plugin in play → host dispatch path
             for ns, info in zip(ptx.namespaces, infos):
                 entries.append((ptx, ns, info))
 
-        # committed-range phantom re-execution (host state reads)
-        mvcc_txs, committed = self._mvcc_inputs(txs)
-
-        T = len(txs)
-        t_bucket = max(16, next_pow2(T))
-        structural = np.zeros(t_bucket, bool)
-        creator_idx = np.full(t_bucket, -1, np.int32)
-        for ptx in txs:
-            if ptx.undetermined and not ptx.is_config:
-                structural[ptx.idx] = True
-                creator_idx[ptx.idx] = ptx.creator_item_idx
-
-        # policy groups (by policy object), padded to buckets
+        # policy groups (by policy object), padded to buckets; match
+        # rows built once per distinct identity then gathered
         by_policy: dict[int, list] = {}
         plans: dict[int, object] = {}
         for ptx, ns, info in entries:
@@ -516,33 +537,88 @@ class BlockValidator:
             S = max(4, next_pow2(max(
                 (len(p.endorsements) for p, _ in ents), default=1) or 1))
             E = max(16, next_pow2(len(ents)))
-            match = np.zeros((E, S, P), bool)
+            pool_rows = [np.zeros(P, bool)]  # row 0 = padding (no match)
+            pool_of: dict[int, int] = {}
+            idx_mat = np.zeros((E, S), np.int32)
             endo_idx = np.full((E, S), -1, np.int32)
             tx_of = np.full(E, -1, np.int32)
             for e, (ptx, info) in enumerate(ents):
                 tx_of[e] = ptx.idx
+                if ptx.endo_item_idx:
+                    endo_idx[e, : len(ptx.endo_item_idx)] = ptx.endo_item_idx
                 for s, (ser, ident) in enumerate(ptx.endorsements):
-                    match[e, s] = default._match_row(plan, ser, ident)
-                    endo_idx[e, s] = ptx.endo_item_idx[s]
+                    pi = pool_of.get(id(ident))
+                    if pi is None:
+                        pi = pool_of[id(ident)] = len(pool_rows)
+                        pool_rows.append(default._match_row(plan, ser, ident))
+                    idx_mat[e, s] = pi
+            match = np.stack(pool_rows)[idx_mat]  # [E, S, P] gather
             groups.append((plan, match, endo_idx, tx_of))
             group_entries.append(ents)
 
-        mvcc_arrays = mvcc_ops.prepare_block(mvcc_txs, committed, bucketed=True)
-        tb_actual = int(mvcc_arrays[0].shape[0])
-        if tb_actual != t_bucket:
-            # mvcc bucket and tx bucket must agree (they both round T)
-            t_bucket = tb_actual
-            structural = np.resize(structural, t_bucket)
-            structural[T:] = False
-            creator_idx = np.resize(creator_idx, t_bucket)
-            creator_idx[T:] = -1
+        # static MVCC arrays (committed-version fill deferred to
+        # validate time — it needs the predecessor's state commit)
+        mvcc_txs = []
+        has_range = False
+        for ptx in txs:
+            if ptx.rwset is None or not ptx.undetermined:
+                mvcc_txs.append(
+                    mvcc_ops.TxRWSet(reads=[], writes=[], range_reads=[])
+                )
+                continue
+            if any(n.range_queries for n in ptx.rwset.ns.values()):
+                has_range = True
+            reads, writes, rqs = ptx.rwset.mvcc_form()
+            mvcc_txs.append(
+                mvcc_ops.TxRWSet(reads=reads, writes=writes, range_reads=rqs)
+            )
+        static = mvcc_ops.prepare_block_static(mvcc_txs, bucketed=True)
+        return _DevicePre(
+            groups=groups, group_entries=group_entries, static=static,
+            has_range=has_range, policies=self.policies,
+        )
+
+    def _validate_device(self, block, txs, items, handle, dpre):
+        """One-dispatch-one-readback validation (device_block): returns
+        (filter, batch, history) or None to fall back."""
+        import time
+
+        from fabric_tpu.peer.device_block import DeviceBlockPipeline
+
+        t0 = time.perf_counter()
+        # committed-range phantom re-execution (host state reads)
+        if dpre.has_range:
+            for ptx in txs:
+                if (
+                    ptx.undetermined and not ptx.is_config
+                    and ptx.rwset is not None
+                    and self._committed_range_phantom(ptx)
+                ):
+                    ptx.code = C.PHANTOM_READ_CONFLICT
+
+        T = len(txs)
+        t_bucket = int(dpre.static.read_keys.shape[0])
+        structural = np.zeros(t_bucket, bool)
+        creator_idx = np.full(t_bucket, -1, np.int32)
+        for ptx in txs:
+            if ptx.undetermined and not ptx.is_config:
+                structural[ptx.idx] = True
+                creator_idx[ptx.idx] = ptx.creator_item_idx
+
+        committed = self._committed_versions(dpre.static.read_key_set)
+        mvcc_arrays = dpre.static.device_args(committed)
+        t0 = self._t("state_fill", t0)
 
         if self._device_pipeline is None:
             self._device_pipeline = DeviceBlockPipeline()
         fetch2 = self._device_pipeline.run(
-            handle, creator_idx, structural, groups, mvcc_arrays, t_bucket
+            handle, creator_idx, structural, dpre.groups, mvcc_arrays,
+            t_bucket,
         )
+        t0 = self._t("stage2_dispatch", t0)
+        group_entries = dpre.group_entries
         out = fetch2()
+        t0 = self._t("device_wait", t0)
 
         # consumption-unsafe rows → exact host interpreter path
         for safe_bits, ents in zip(out["safe"], group_entries):
@@ -604,7 +680,13 @@ class BlockValidator:
                 mvcc_ops.TxRWSet(reads=reads, writes=writes, range_reads=rqs)
             )
             all_read_keys.update(k for k, _ in reads)
-        committed = {}
+        return mvcc_txs, self._committed_versions(all_read_keys)
+
+    def _committed_versions(self, all_read_keys) -> dict:
+        """Bulk-load committed versions for a set of mvcc-form keys
+        (the preLoadCommittedVersionOfRSet analog,
+        validation/validator.go:27-78)."""
+        committed: dict = {}
         if all_read_keys:
             pub_keys = [
                 (k[1], k[2]) for k in all_read_keys if k[0] == "pub"
@@ -617,7 +699,7 @@ class BlockValidator:
                     v = self.state.get_version(f"{k[1]}${k[2]}#hashed", _hex(k[3]))
                     if v is not None:
                         committed[k] = v
-        return mvcc_txs, committed
+        return committed
 
     def _committed_range_phantom(self, ptx) -> bool:
         """True iff some committed key falls inside a recorded range

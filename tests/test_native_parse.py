@@ -109,8 +109,8 @@ def test_native_vs_python_identical_verdicts(net, monkeypatch):
     # force the python path by disabling the native library
     import fabric_tpu.native as nat
 
-    monkeypatch.setattr(nat, "_lib", None)
-    monkeypatch.setattr(nat, "_lib_failed", True)
+    monkeypatch.setattr(nat, "_libs", {})
+    monkeypatch.setattr(nat, "_lib_failed", {"blockparse"})
     v2 = _validator(net)
     flt_slow, batch_slow, hist_slow = v2.validate(blk)
 

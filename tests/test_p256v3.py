@@ -152,3 +152,128 @@ def test_batch_inv_and_windows(rng):
         for d in row:
             back = (back << 4) | int(d)
         assert back == u
+
+
+def test_prepare_cols_native_matches_python():
+    """The native ec_prepare (batch inversion + window recoding +
+    admission flags in C) must be bit-exact with the Python prepare
+    path across valid, high-S, out-of-range and degenerate rows."""
+    import numpy as np
+
+    import fabric_tpu.native as nat
+    from fabric_tpu.crypto import ec_ref
+    from fabric_tpu.ops import p256v3
+
+    keys = [ec_ref.SigningKey.generate() for _ in range(3)]
+    items = []
+    for i in range(41):
+        k = keys[i % 3]
+        e = ec_ref.digest_int(b"m%d" % i)
+        r, s = k.sign_digest(e)
+        if i % 7 == 0:
+            s = ec_ref.N - s  # high-S: must reject
+        if i % 11 == 0:
+            r = ec_ref.N + 5  # out-of-range r
+        if i % 13 == 0:
+            s = 0
+        if i % 17 == 0:
+            r = ec_ref.P - ec_ref.N + 3  # rpn_ok boundary region
+        items.append((e, r, s, *k.public))
+    items.append((5, 0, 1, 0, 0))
+    c = p256v3.SigCollector()
+    for it in items:
+        c.add_slow(it)
+    cols = p256v3._assemble_cols(c)
+    pad = p256v3._bucket(len(items))
+    a_native = p256v3.prepare_cols(*cols, pad_to=pad)
+    if nat.ecprep_lib() is None:
+        import pytest
+
+        pytest.skip("no native toolchain")
+    nat._lib_failed.add("ecprep")
+    nat._libs.pop("ecprep", None)
+    try:
+        a_python = p256v3.prepare_cols(*cols, pad_to=pad)
+    finally:
+        nat._lib_failed.discard("ecprep")
+    for x, y, name in zip(
+        a_native, a_python,
+        ["qx", "qy", "r", "rpn", "w1", "w2", "rpn_ok", "pre_ok"],
+    ):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), name
+
+
+def test_sigcollector_mixed_fast_slow_rows():
+    """Interleaved fast (byte-array) and slow (tuple) rows through the
+    collector must verify identically to the all-tuple path."""
+    import numpy as np
+
+    from fabric_tpu.crypto import ec_ref
+    from fabric_tpu.ops import p256v3
+
+    class _FakeIdent:
+        def __init__(self, pub):
+            self.public_numbers = pub
+
+        @property
+        def rns_pub(self):
+            from fabric_tpu.ops import rns
+
+            res = rns.ints_to_rns(list(self.public_numbers))
+            return res[0], res[1]
+
+    keys = [ec_ref.SigningKey.generate() for _ in range(2)]
+    items = []
+    for i in range(9):
+        k = keys[i % 2]
+        e = ec_ref.digest_int(b"x%d" % i)
+        r, s = k.sign_digest(e)
+        if i == 4:
+            s = ec_ref.N - s  # invalid lane
+        items.append((e, r, s, *k.public))
+    n = len(items)
+    d_arr = np.stack([
+        np.frombuffer(int(e).to_bytes(32, "big"), np.uint8)
+        for (e, r, s, qx, qy) in items
+    ])
+    r_arr = np.stack([
+        np.frombuffer(int(r).to_bytes(32, "big"), np.uint8)
+        for (e, r, s, qx, qy) in items
+    ])
+    s_arr = np.stack([
+        np.frombuffer(int(s).to_bytes(32, "big"), np.uint8)
+        for (e, r, s, qx, qy) in items
+    ])
+    c = p256v3.SigCollector()
+    for i, it in enumerate(items):
+        if i % 3 == 0:
+            c.add_slow(it)
+        else:
+            c.add_fast((d_arr, r_arr, s_arr), i, _FakeIdent(it[3:]))
+    got = p256v3.verify_launch(c)()
+    want = p256v3.verify_host(items)
+    assert got == want
+    assert c.tuples() == items
+
+
+def test_sigcollector_oversized_r_rejected():
+    """A slow-row r or s ≥ 2^256 must be rejected, not wrapped — the
+    column path truncating mod 2^256 would WIDEN the accept set vs the
+    legacy int path (consensus divergence)."""
+    from fabric_tpu.crypto import ec_ref
+    from fabric_tpu.ops import p256v3
+
+    k = ec_ref.SigningKey.generate()
+    e = ec_ref.digest_int(b"oversize")
+    r, s = k.sign_digest(e)
+    bad = [
+        (e, r + (1 << 256), s, *k.public),
+        (e, r, s + (1 << 256), *k.public),
+        (e, r, s, *k.public),  # control: valid
+    ]
+    c = p256v3.SigCollector()
+    for it in bad:
+        c.add_slow(it)
+    got = p256v3.verify_launch(c)()
+    assert got == [False, False, True]
+    assert p256v3.verify_host(bad[:2]) == [False, False]
