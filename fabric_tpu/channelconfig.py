@@ -265,6 +265,19 @@ class Bundle:
 # Config updates (common/configtx/update.go + validator.go)
 
 
+def bundle_from_genesis(channel_id: str, genesis_block) -> "Bundle":
+    """Extract the channel config from a genesis/config block's first
+    envelope → Bundle (the join-time trust-anchor derivation both the
+    peer and the orderer's broadcast filters use)."""
+    from fabric_tpu import protoutil
+    from fabric_tpu.protos import common_pb2
+
+    env = protoutil.unmarshal(common_pb2.Envelope, genesis_block.data.data[0])
+    payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+    cfg_env = protoutil.unmarshal(configtx_pb2.ConfigEnvelope, payload.data)
+    return Bundle(channel_id, cfg_env.config)
+
+
 class ConfigUpdateError(Exception):
     pass
 

@@ -49,8 +49,13 @@ def _cmd_configtxgen(args):
                        [tuple(a) for a in o.get("anchor_peers", [])])
         for o in prof.get("application_orgs", [])
     ]
+    orderer_orgs = [
+        ctg.OrgProfile(o["msp_id"], cg.load_org_msp(o["dir"]), [])
+        for o in prof.get("orderer_orgs", [])
+    ]
     profile = ctg.Profile(
         prof["channel"], application_orgs=app_orgs,
+        orderer_orgs=orderer_orgs,
         consensus_type=prof.get("consensus", "raft"),
         raft_consenters=[tuple(c) for c in prof.get("consenters", [])],
         max_message_count=prof.get("max_message_count", 500),
@@ -62,11 +67,27 @@ def _cmd_configtxgen(args):
     print(f"wrote genesis block for {prof['channel']} to {args.output}")
 
 
+def _node_tls(cfg: dict):
+    """Node mTLS material from the JSON config's ``tls`` section:
+    {"cert": ..., "key": ..., "ca": ...} file paths (cryptogen's
+    nodes/<name>/tls layout)."""
+    t = cfg.get("tls")
+    if not t:
+        return None
+    from fabric_tpu.comm.rpc import TlsProfile
+
+    return TlsProfile.load(t["cert"], t["key"], t["ca"])
+
+
 async def _run_orderer(cfg: dict):
+    from fabric_tpu.crypto import cryptogen as cg
     from fabric_tpu.ordering.blockcutter import BatchConfig
     from fabric_tpu.ordering.node import OrdererNode
     from fabric_tpu.protos import common_pb2
 
+    signer = None
+    if cfg.get("msp_dir"):
+        signer = cg.load_signing_identity(cfg["msp_dir"], cfg["msp_id"])
     node = OrdererNode(
         cfg["id"], cfg["data_dir"],
         {k: tuple(v) for k, v in cfg.get("cluster", {}).items()},
@@ -75,6 +96,8 @@ async def _run_orderer(cfg: dict):
             max_message_count=cfg.get("max_message_count", 500),
             batch_timeout_s=cfg.get("batch_timeout_s", 0.2),
         ),
+        signer=signer,
+        tls=_node_tls(cfg),
     )
     await node.start(operations_port=cfg.get("operations_port"))
     print(f"orderer {node.id} serving on :{node.port}", flush=True)
@@ -111,6 +134,7 @@ async def _run_peer(cfg: dict):
     node = PeerNode(
         cfg["id"], cfg["data_dir"], mgr, signer, runtime,
         host=cfg.get("host", "127.0.0.1"), port=cfg.get("port", 0),
+        tls=_node_tls(cfg),
     )
     await node.start(operations_port=cfg.get("operations_port"))
     print(f"peer {node.id} serving on :{node.port}", flush=True)
@@ -157,12 +181,30 @@ async def _run_chaincode(args):
     await asyncio.Event().wait()
 
 
+def _cli_ssl(args):
+    """Client-side TLS context from the global --tls-* flags (mutual
+    when a cert/key pair is given), or None for plaintext."""
+    if not getattr(args, "tls_ca", None):
+        return None
+    from fabric_tpu.comm.rpc import make_client_tls
+
+    with open(args.tls_ca, "rb") as f:
+        ca = f.read()
+    cert = key = None
+    if getattr(args, "tls_cert", None) and getattr(args, "tls_key", None):
+        with open(args.tls_cert, "rb") as f:
+            cert = f.read()
+        with open(args.tls_key, "rb") as f:
+            key = f.read()
+    return make_client_tls(ca, cert, key)
+
+
 def _cmd_osnadmin(args):
     from fabric_tpu.comm.rpc import RpcClient
     from fabric_tpu.protos import common_pb2
 
     async def go():
-        cli = RpcClient(args.host, args.port)
+        cli = RpcClient(args.host, args.port, ssl_ctx=_cli_ssl(args))
         await cli.connect()
         blk = b""
         if args.genesis:
@@ -185,7 +227,7 @@ def _cmd_invoke(args, evaluate=False):
     signer = cg.load_signing_identity(args.msp_dir, args.msp_id)
 
     async def go():
-        gw = GatewayClient(args.host, args.port, signer)
+        gw = GatewayClient(args.host, args.port, signer, ssl_ctx=_cli_ssl(args))
         try:
             cc_args = [a.encode() for a in args.args]
             if evaluate:
@@ -222,7 +264,7 @@ def _cmd_snapshot(args):
     from fabric_tpu.comm.rpc import RpcClient
 
     async def go():
-        cli = RpcClient(args.host, args.port)
+        cli = RpcClient(args.host, args.port, ssl_ctx=_cli_ssl(args))
         await cli.connect()
         raw = await cli.unary("Snapshot", json.dumps(
             {"channel": args.channel, "out_dir": args.output}
@@ -237,7 +279,7 @@ def _cmd_discover(args):
     from fabric_tpu.comm.rpc import RpcClient
 
     async def go():
-        cli = RpcClient(args.host, args.port)
+        cli = RpcClient(args.host, args.port, ssl_ctx=_cli_ssl(args))
         await cli.connect()
         q = {"query": args.query, "channel": args.channel}
         if args.chaincode:
@@ -251,6 +293,9 @@ def _cmd_discover(args):
 
 def main(argv=None):
     p = argparse.ArgumentParser(prog="fabric-tpu")
+    p.add_argument("--tls-ca", help="trusted TLS CA bundle (enables TLS)")
+    p.add_argument("--tls-cert", help="client TLS certificate (mTLS)")
+    p.add_argument("--tls-key", help="client TLS key (mTLS)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     c = sub.add_parser("cryptogen", help="generate org crypto material")

@@ -306,3 +306,42 @@ def make_client_tls(ca_pem: bytes, cert_pem: bytes | None = None,
             kf.write(key_pem); kf.flush()
             ctx.load_cert_chain(cf.name, kf.name)
     return ctx
+
+
+class TlsProfile:
+    """One node's TLS material: its certificate/key plus the CA bundle
+    it trusts (the comm.SecureOptions analog, internal/pkg/comm).  The
+    assemblies pass this through so EVERY listener requires client
+    certs and every outbound dial presents one — mutual TLS end to end.
+    """
+
+    def __init__(self, cert_pem: bytes, key_pem: bytes, ca_pem: bytes):
+        self.cert_pem = cert_pem
+        self.key_pem = key_pem
+        self.ca_pem = ca_pem
+        self._server = None
+        self._client = None
+
+    @classmethod
+    def load(cls, cert_path: str, key_path: str, ca_path: str) -> "TlsProfile":
+        with open(cert_path, "rb") as f:
+            cert = f.read()
+        with open(key_path, "rb") as f:
+            key = f.read()
+        with open(ca_path, "rb") as f:
+            ca = f.read()
+        return cls(cert, key, ca)
+
+    def server_ctx(self) -> ssl.SSLContext:
+        if self._server is None:
+            self._server = make_server_tls(
+                self.cert_pem, self.key_pem, self.ca_pem
+            )
+        return self._server
+
+    def client_ctx(self) -> ssl.SSLContext:
+        if self._client is None:
+            self._client = make_client_tls(
+                self.ca_pem, self.cert_pem, self.key_pem
+            )
+        return self._client

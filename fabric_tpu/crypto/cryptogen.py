@@ -232,7 +232,40 @@ def write_org(org: OrgMaterial, base: str) -> str:
         for name, enr in members.items():
             write_msp_dir(os.path.join(root, group, name, "msp"),
                           enr, org.ca.cert_pem)
+    # TLS material: org TLS-CA cert + per-node server cert/key — the
+    # mTLS profile every listener/dialer loads (cryptogen tls layout)
+    os.makedirs(os.path.join(root, "tlsca"), exist_ok=True)
+    with open(os.path.join(root, "tlsca", "tlsca-cert.pem"), "wb") as f:
+        f.write(org.tls_ca.cert_pem)
+    for name, enr in org.tls.items():
+        tdir = os.path.join(root, "nodes", name, "tls")
+        os.makedirs(tdir, exist_ok=True)
+        with open(os.path.join(tdir, "server.pem"), "wb") as f:
+            f.write(enr.cert_pem)
+        with open(os.path.join(tdir, "key.pem"), "wb") as f:
+            f.write(enr.key_pem)
+        with open(os.path.join(tdir, "ca.pem"), "wb") as f:
+            f.write(org.tls_ca.cert_pem)
     return root
+
+
+def load_tls_profile(org_dir: str, node_name: str, ca_bundle: bytes | None = None):
+    """comm.rpc.TlsProfile for one node from a write_org directory.
+    ``ca_bundle``: concatenated trusted TLS-CA certs (defaults to this
+    org's own TLS CA — pass the union for cross-org networks)."""
+    import os as _os
+
+    from fabric_tpu.comm.rpc import TlsProfile
+
+    tdir = _os.path.join(org_dir, "nodes", node_name, "tls")
+    with open(_os.path.join(tdir, "server.pem"), "rb") as f:
+        cert = f.read()
+    with open(_os.path.join(tdir, "key.pem"), "rb") as f:
+        key = f.read()
+    if ca_bundle is None:
+        with open(_os.path.join(tdir, "ca.pem"), "rb") as f:
+            ca_bundle = f.read()
+    return TlsProfile(cert, key, ca_bundle)
 
 
 def load_org_msp(org_dir: str):

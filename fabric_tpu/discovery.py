@@ -69,6 +69,12 @@ class PeerInfo:
     port: int
     height: int = 0              # max across channels (legacy/display)
     heights: dict = field(default_factory=dict)  # channel -> height
+    # liveness (gossip/discovery alive/dead expiration analog): a peer
+    # is a candidate for election/dissemination only while alive.
+    # None = never probed — treated alive so static wirings (tests,
+    # fresh registries) work before the first probe round.
+    alive: bool | None = None
+    last_seen: float = 0.0
 
 
 @dataclass

@@ -69,11 +69,15 @@ class GossipService:
             chan.pvt_puller = self.pull_pvt_for(chan.id)
         return self
 
+    def _ssl(self):
+        tls = getattr(self.node, "tls", None)
+        return tls.client_ctx() if tls else None
+
     async def _client(self, host, port) -> RpcClient:
         key = (host, port)
         cli = self._clients.get(key)
         if cli is None or cli.conn is None or cli.conn.closed.is_set():
-            cli = RpcClient(host, port)
+            cli = RpcClient(host, port, ssl_ctx=self._ssl())
             await cli.connect()
             self._clients[key] = cli
         return cli
@@ -97,9 +101,13 @@ class GossipService:
         }).encode()
 
     async def probe_members(self) -> dict:
-        """Ping every registered peer; refresh alive/height state.
+        """Ping every registered peer; refresh alive/height state —
+        a failed probe marks the peer DEAD (the reference's alive/dead
+        expiration, gossip/discovery/discovery_impl.go) so election
+        and dissemination stop counting on it.
         → {(host, port): ping-result | None}."""
         out = {}
+        loop = asyncio.get_event_loop()
         for org, peers in self.node.registry.peers.items():
             for p in peers:
                 try:
@@ -110,30 +118,64 @@ class GossipService:
                     res = json.loads(raw)
                     p.heights = dict(res.get("heights", {}))
                     p.height = max(p.heights.values(), default=0)
+                    p.alive = True
+                    p.last_seen = loop.time()
                     out[(p.host, p.port)] = res
                 except Exception:
+                    p.alive = False
+                    self._clients.pop((p.host, p.port), None)
                     out[(p.host, p.port)] = None
         return out
 
     def elect_leader(self, my_org_peers: list, my_endpoint: tuple) -> bool:
         """Deterministic org-leader election: lowest (host, port) among
-        alive org peers + self wins (gossip/election analog)."""
+        ALIVE org peers + self wins (gossip/election analog).  Peers
+        whose last probe failed are excluded — a dead lowest-endpoint
+        peer must not win forever (ADVICE r3)."""
         candidates = [my_endpoint] + [
-            (p.host, p.port) for p in my_org_peers if p.height >= 0
+            (p.host, p.port) for p in my_org_peers if p.alive is not False
         ]
         return min(candidates) == my_endpoint
 
     # -- pvtdata dissemination --------------------------------------------
+
+    def _my_org(self) -> str | None:
+        signer = getattr(self.node, "signer", None)
+        return getattr(signer, "msp_id", None)
+
+    @staticmethod
+    def _members(chan, ns: str, coll: str, own_org: str | None) -> set:
+        """Eligible orgs for a collection (distributor.go:180-235
+        AccessFilter).  An UNDEFINED collection is maximally private:
+        only the endorsing org itself may hold the cleartext — never
+        'everyone', which would void the confidentiality feature."""
+        cfg = chan.collection_config(ns, coll) if chan is not None else None
+        if cfg is None:
+            return {own_org} if own_org else set()
+        return set(cfg.get("member_orgs", []))
 
     async def _on_pvt_push(self, req: bytes) -> bytes:
         q = json.loads(req)
         chan = self.node.channels.get(q["channel"])
         if chan is None:
             return b'{"status": 404}'
-        chan.transient.persist(
-            q["txid"], _dec_cleartext(q["data"]), int(q.get("height", 0))
-        )
+        # receiver-side eligibility: never STORE cleartext this org is
+        # not a collection member of, whatever the sender claims
+        my = self._my_org()
+        data = {
+            (ns, coll): kv
+            for (ns, coll), kv in _dec_cleartext(q["data"]).items()
+            if my in self._members(chan, ns, coll, my)
+        }
+        if not data:
+            return b'{"status": 403}'
+        chan.transient.persist(q["txid"], data, int(q.get("height", 0)))
         return b'{"status": 200}'
+
+    @staticmethod
+    def _pull_signable(q: dict) -> bytes:
+        core = {k: v for k, v in q.items() if k not in ("sig",)}
+        return json.dumps(core, sort_keys=True).encode()
 
     async def _on_pvt_pull(self, req: bytes) -> bytes:
         q = json.loads(req)
@@ -141,6 +183,27 @@ class GossipService:
         if chan is None:
             return b'{"status": 404}'
         ns, coll = q["ns"], q["coll"]
+        # caller eligibility: the pull is signed by the requesting
+        # peer's identity; it must be a valid channel member of a
+        # collection member org (pull.go access checks).  mTLS (comm
+        # layer) binds the transport to the same identity.
+        try:
+            ident = chan.validator.msp.deserialize_identity(
+                bytes.fromhex(q["identity"])
+            )
+            if not ident.is_valid:
+                raise ValueError("invalid identity")
+            if not ident.verify(
+                self._pull_signable(q), bytes.fromhex(q["sig"])
+            ):
+                raise ValueError("bad signature")
+            if ident.msp_id not in self._members(
+                chan, ns, coll, self._my_org()
+            ):
+                raise ValueError("org not a collection member")
+        except Exception as e:
+            log.debug("pvt pull refused: %s", e)
+            return b'{"status": 403}'
         # transient store first (endorsement-time data)
         clear = chan.transient.get(q["txid"]).get((ns, coll))
         if clear is None and "block" in q:
@@ -161,27 +224,61 @@ class GossipService:
 
     async def push_pvt(self, channel: str, txid: str, cleartext: dict,
                        height: int) -> None:
-        """Distribute endorsement-time pvt data to eligible peers
-        (distributor.go; eligibility = collection members — all
-        registry peers until collection configs narrow it)."""
-        payload = json.dumps({
-            "channel": channel, "txid": txid, "height": height,
-            "data": _enc_cleartext(cleartext),
-        }).encode()
-        for org, peers in self.node.registry.peers.items():
-            for p in peers:
+        """Distribute endorsement-time pvt data to ELIGIBLE peers only
+        (distributor.go:180-235: AccessFilter + required/maximum peer
+        counts): per collection, push to member-org peers up to
+        max_peer_count; fewer than required_peer_count successful
+        deliveries is logged as a dissemination shortfall."""
+        chan = self.node.channels.get(channel)
+        my = self._my_org()
+        for (ns, coll), kv in cleartext.items():
+            members = self._members(chan, ns, coll, my)
+            cfg = chan.collection_config(ns, coll) if chan else None
+            max_peers = int((cfg or {}).get("max_peer_count", 0) or 0)
+            required = int((cfg or {}).get("required_peer_count", 0) or 0)
+            # alive members first (probe liveness); max_peer_count caps
+            # SUCCESSFUL deliveries, not attempts — a dead peer must
+            # not consume the cap while a live member goes untried
+            targets = sorted(
+                (p for org, peers in self.node.registry.peers.items()
+                 if org in members for p in peers),
+                key=lambda p: (p.alive is False, p.host, p.port),
+            )
+            payload = json.dumps({
+                "channel": channel, "txid": txid, "height": height,
+                "data": _enc_cleartext({(ns, coll): kv}),
+            }).encode()
+            acks = 0
+            for p in targets:
+                if max_peers > 0 and acks >= max_peers:
+                    break
                 try:
                     cli = await self._client(p.host, p.port)
-                    await asyncio.wait_for(cli.unary("PvtPush", payload), 3.0)
+                    res = json.loads(await asyncio.wait_for(
+                        cli.unary("PvtPush", payload), 3.0
+                    ))
+                    if res.get("status") == 200:
+                        acks += 1
                 except Exception as e:
                     log.debug("pvt push to %s:%s failed: %s", p.host, p.port, e)
+            if acks < required:
+                log.warning(
+                    "pvt dissemination shortfall for %s/%s: %d acks, "
+                    "required %d", ns, coll, acks, required,
+                )
 
     def pull_pvt_for(self, channel: str):
+        signer = getattr(self.node, "signer", None)
+
         async def pull(txid, block_num, txnum, ns, coll):
-            req = json.dumps({
+            q = {
                 "channel": channel, "txid": txid, "block": block_num,
                 "txnum": txnum, "ns": ns, "coll": coll,
-            }).encode()
+            }
+            if signer is not None:
+                q["identity"] = signer.serialized.hex()
+                q["sig"] = signer.sign(self._pull_signable(q)).hex()
+            req = json.dumps(q).encode()
             for org, peers in self.node.registry.peers.items():
                 for p in peers:
                     try:
@@ -204,7 +301,7 @@ class GossipService:
     # -- anti-entropy state transfer ---------------------------------------
 
     async def _pull_blocks_from_peer(self, chan, host, port, stop_at: int):
-        cli = RpcClient(host, port)
+        cli = RpcClient(host, port, ssl_ctx=self._ssl())
         await cli.connect()
         try:
             stream = await cli.open_stream("DeliverBlocks")

@@ -112,27 +112,38 @@ class BlockStore:
 
     # -- index -------------------------------------------------------------
 
-    def _index_block(self, block: common_pb2.Block, seg: int, off: int) -> None:
+    def _index_block(
+        self, block: common_pb2.Block, seg: int, off: int, txids=None
+    ) -> None:
+        """txids: optional pre-parsed [(txid, tx_num)] — the commit
+        path already holds the parsed envelopes, so re-unmarshalling
+        every envelope here (3 protobuf parses per tx) is skipped."""
         self._idx.execute(
             "INSERT OR REPLACE INTO blocks VALUES (?,?,?,?)",
             (block.header.number, protoutil.block_header_hash(block.header), seg, off),
         )
         flags = protoutil.get_tx_filter(block)
-        for i, env_bytes in enumerate(block.data.data):
-            try:
-                env = protoutil.unmarshal(common_pb2.Envelope, env_bytes)
-                payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
-                ch = protoutil.unmarshal(
-                    common_pb2.ChannelHeader, payload.header.channel_header
-                )
-                txid = ch.tx_id
-            except Exception:
-                continue
-            if txid:
-                self._idx.execute(
-                    "INSERT OR IGNORE INTO txids VALUES (?,?,?,?)",
-                    (txid, block.header.number, i, flags[i] if i < len(flags) else 254),
-                )
+        if txids is None:
+            txids = []
+            for i, env_bytes in enumerate(block.data.data):
+                try:
+                    env = protoutil.unmarshal(common_pb2.Envelope, env_bytes)
+                    payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+                    ch = protoutil.unmarshal(
+                        common_pb2.ChannelHeader, payload.header.channel_header
+                    )
+                except Exception:
+                    continue
+                if ch.tx_id:
+                    txids.append((ch.tx_id, i))
+        self._idx.executemany(
+            "INSERT OR IGNORE INTO txids VALUES (?,?,?,?)",
+            [
+                (txid, block.header.number, i,
+                 flags[i] if i < len(flags) else 254)
+                for txid, i in txids if txid
+            ],
+        )
 
     # -- public API --------------------------------------------------------
 
@@ -202,7 +213,7 @@ class BlockStore:
         boot = self.bootstrap_info()
         return boot[1] if boot else None
 
-    def add_block(self, block: common_pb2.Block) -> None:
+    def add_block(self, block: common_pb2.Block, txids=None) -> None:
         if block.header.number != self.height:
             raise ValueError(
                 f"block number {block.header.number} != height {self.height}"
@@ -223,7 +234,7 @@ class BlockStore:
         self._fh.write(data)
         self._fh.flush()
         os.fsync(self._fh.fileno())
-        self._index_block(block, self._seg, off)
+        self._index_block(block, self._seg, off, txids=txids)
         self._idx.commit()
         self._last_hash = protoutil.block_header_hash(block.header)
 

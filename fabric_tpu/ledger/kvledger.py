@@ -85,6 +85,7 @@ class KVLedger:
         batch: UpdateBatch,
         history_writes: list | None = None,
         pvt_data: dict | None = None,
+        txids: list | None = None,
     ) -> None:
         num = block.header.number
         if num != self.blocks.height:
@@ -96,13 +97,53 @@ class KVLedger:
             block.metadata.metadata.append(b"")
         block.metadata.metadata[idx] = commit_hash
 
-        self.blocks.add_block(block)
+        self.blocks.add_block(block, txids=txids)
         if pvt_data:
             self.pvtdata.commit_block(num, pvt_data)
         self.state.apply_updates(batch, (num, 0))
         if self.history is not None and history_writes:
             self.history.commit_block(num, history_writes)
+        self._purge_expired_pvt(num)
         self._commit_hash = commit_hash
+
+    def _purge_expired_pvt(self, num: int) -> None:
+        """BTL expiry at the block boundary (pvtstatepurgemgmt analog):
+        expired collections leave the pvtdata store AND the private
+        state — both the cleartext namespace and the key-hash
+        namespace (the hashes on the public rwset stay in the block
+        history, but live state must not outlive block_to_live)."""
+        import hashlib
+
+        from fabric_tpu.ledger.pvtdata import decode_kv
+        from fabric_tpu.ledger.statedb import UpdateBatch
+
+        purged = self.pvtdata.purge_expired(num)
+        if not purged:
+            return
+        batch = UpdateBatch()
+        for blk_n, txnum, ns, coll, rwset in purged:
+            try:
+                kv = decode_kv(rwset)
+            except Exception:
+                continue
+            hns = f"{ns}${coll}"
+            for key in kv:
+                # only purge if the LIVE state still carries this (or an
+                # older) write: a later re-write has its own, later BTL
+                # horizon and must survive (per-key expiry semantics of
+                # pvtstatepurgemgmt)
+                vv = self.state.get_state(hns, key)
+                if vv is None or vv.version[0] > blk_n:
+                    continue
+                batch.delete(hns, key, (num, 0))
+                kh = hashlib.sha256(
+                    key.encode() if isinstance(key, str) else key
+                ).hexdigest()
+                batch.delete(f"{hns}#hashed", kh, (num, 0))
+        if batch.updates:
+            # re-assert the block's savepoint (passing None would reset
+            # it on the mem backend and force a full recovery replay)
+            self.state.apply_updates(batch, (num, 0))
 
     # -- recovery (kv_ledger.go:357 recoverDBs) ---------------------------
 

@@ -7,7 +7,24 @@ missing-data bookkeeping for the reconciler (gossip/privdata).
 
 from __future__ import annotations
 
+import json
 import sqlite3
+
+
+def encode_kv(kv: dict) -> bytes:
+    """{key: value|None} → canonical stored/wire JSON bytes (hex
+    values) — THE pvt cleartext encoding, shared by the pvtdata store
+    payloads, gossip push/pull, and the reconciler."""
+    return json.dumps(
+        {k: (v.hex() if v is not None else None) for k, v in kv.items()},
+        sort_keys=True,
+    ).encode()
+
+
+def decode_kv(raw) -> dict:
+    data = json.loads(raw)
+    return {k: (bytes.fromhex(v) if v is not None else None)
+            for k, v in data.items()}
 
 
 class PvtDataStore:
@@ -70,14 +87,22 @@ class PvtDataStore:
         )
         self._conn.commit()
 
-    def purge_expired(self, current_block: int) -> int:
+    def purge_expired(self, current_block: int) -> list:
         """BTL expiry (analog pvtstatepurgemgmt): drop pvt data whose
-        expiry block has passed."""
-        cur = self._conn.execute(
-            "DELETE FROM pvt WHERE expiry > 0 AND expiry <= ?", (current_block,)
-        )
-        self._conn.commit()
-        return cur.rowcount
+        expiry block has passed.  Returns the purged rows
+        [(block, txnum, ns, coll, rwset)] so the ledger can also erase
+        the corresponding private STATE (cleartext + key-hash spaces)."""
+        rows = list(self._conn.execute(
+            "SELECT block, txnum, ns, coll, rwset FROM pvt"
+            " WHERE expiry > 0 AND expiry <= ?", (current_block,)
+        ))
+        if rows:
+            self._conn.execute(
+                "DELETE FROM pvt WHERE expiry > 0 AND expiry <= ?",
+                (current_block,),
+            )
+            self._conn.commit()
+        return rows
 
     def close(self):
         self._conn.close()

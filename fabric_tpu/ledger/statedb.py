@@ -97,13 +97,24 @@ class VersionedDB:
 
 
 class MemVersionedDB(VersionedDB):
+    """In-memory backend.  Range/query iteration takes a lock against
+    concurrent apply_updates: the commit pipeline overlaps the
+    predecessor's state commit (committer thread) with the next
+    block's launch, whose range re-execution walks these structures —
+    per-key read SEMANTICS under that overlap are handled by the
+    validator's overlay, the lock only guards the dict/cache
+    iteration itself."""
+
     def __init__(self):
+        import threading
+
         self._data: dict = {}  # (ns,key) -> VersionedValue
         self._sorted_cache: dict = {}  # ns -> sorted key list (invalidated on write)
         self._savepoint: Version | None = None
+        self._lock = threading.Lock()
 
     def get_state(self, ns, key):
-        return self._data.get((ns, key))
+        return self._data.get((ns, key))  # dict.get is atomic under the GIL
 
     def _sorted_keys(self, ns):
         keys = self._sorted_cache.get(ns)
@@ -113,27 +124,33 @@ class MemVersionedDB(VersionedDB):
         return keys
 
     def iter_all(self):
-        for k in sorted(self._data):
-            yield k, self._data[k]
+        with self._lock:
+            rows = [(k, self._data[k]) for k in sorted(self._data)]
+        yield from rows
 
     def get_state_range(self, ns, start, end, limit=0):
-        keys = self._sorted_keys(ns)
-        i = bisect_left(keys, start)
-        n = 0
-        while i < len(keys) and (not end or keys[i] < end):
-            yield keys[i], self._data[(ns, keys[i])]
-            i += 1
-            n += 1
-            if limit and n >= limit:
-                return
+        with self._lock:  # materialize under the lock, then yield
+            keys = self._sorted_keys(ns)
+            i = bisect_left(keys, start)
+            rows = []
+            while i < len(keys) and (not end or keys[i] < end):
+                vv = self._data.get((ns, keys[i]))
+                if vv is not None:
+                    rows.append((keys[i], vv))
+                i += 1
+                if limit and len(rows) >= limit:
+                    break
+        yield from rows
 
     def execute_query(self, ns, query, limit=0):
         """CouchDB-selector-style equality matching over JSON values."""
         sel = query.get("selector", {})
+        with self._lock:  # copy only the key list under the lock
+            keys = list(self._sorted_keys(ns))
         n = 0
-        for key in self._sorted_keys(ns):
-            vv = self._data[(ns, key)]
-            if vv.value is None:
+        for key in keys:
+            vv = self._data.get((ns, key))  # atomic under the GIL
+            if vv is None or vv.value is None:
                 continue
             try:
                 doc = json.loads(vv.value)
@@ -146,13 +163,15 @@ class MemVersionedDB(VersionedDB):
                     return
 
     def apply_updates(self, batch, savepoint):
-        for (ns, key), vv in batch.items():
-            if vv.value is None:
-                self._data.pop((ns, key), None)
-            else:
-                self._data[(ns, key)] = vv
-            self._sorted_cache.pop(ns, None)
-        self._savepoint = savepoint
+        with self._lock:
+            for (ns, key), vv in batch.items():
+                if vv.value is None:
+                    self._data.pop((ns, key), None)
+                else:
+                    self._data[(ns, key)] = vv
+                self._sorted_cache.pop(ns, None)
+        if savepoint is not None:
+            self._savepoint = savepoint
 
     def savepoint(self):
         return self._savepoint

@@ -23,15 +23,20 @@ _libs: dict = {}       # name → CDLL
 _lib_failed: set = set()
 
 
+_FLAGS = ["-O3", "-shared", "-fPIC", "-std=c++17"]
+
+
 def _build(src: str, so: str) -> bool:
     os.makedirs(os.path.dirname(so), exist_ok=True)
     tmp = f"{so}.{os.getpid()}.tmp"
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp],
+            ["g++", *_FLAGS, src, "-o", tmp],
             check=True, capture_output=True, timeout=120,
         )
         os.replace(tmp, so)  # atomic: concurrent builders can't corrupt
+        with open(so + ".flags", "w") as f:
+            f.write(" ".join(_FLAGS))
         return True
     except Exception as e:
         log.warning("native %s build failed (%s); using Python path", src, e)
@@ -39,6 +44,18 @@ def _build(src: str, so: str) -> bool:
             os.unlink(tmp)
         except OSError:
             pass
+        return False
+
+
+def _fresh(src: str, so: str) -> bool:
+    """Artifact is current iff newer than the source AND built with the
+    current flag set (a flag change must invalidate cached .so files)."""
+    if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+        return False
+    try:
+        with open(so + ".flags") as f:
+            return f.read() == " ".join(_FLAGS)
+    except OSError:
         return False
 
 
@@ -51,10 +68,7 @@ def _load(name: str):
         return None
     src = os.path.join(_DIR, f"{name}.cpp")
     so = os.path.join(_DIR, "_build", f"lib{name}.so")
-    fresh = os.path.exists(so) and (
-        os.path.getmtime(so) >= os.path.getmtime(src)
-    )
-    if not fresh and not _build(src, so):
+    if not _fresh(src, so) and not _build(src, so):
         _lib_failed.add(name)
         return None
     try:
@@ -72,6 +86,15 @@ def blockparse_lib():
     lib = _load("blockparse")
     if lib is not None:
         lib.parse_block.restype = ctypes.c_int64
+    return lib
+
+
+def mvccprep_lib():
+    """→ ctypes CDLL with mvcc_prep (rwset wire parse + key interning
+    into flat arrays), or None (Python fallback)."""
+    lib = _load("mvccprep")
+    if lib is not None:
+        lib.mvcc_prep.restype = ctypes.c_int64
     return lib
 
 

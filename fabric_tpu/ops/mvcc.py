@@ -157,6 +157,7 @@ class StaticBlock:
     rq_hi: np.ndarray          # [T, Q] int32
     read_fill: list            # [(j, a, key)] for committed-array fill
     read_key_set: set          # union of read keys
+    _jnp: tuple = None         # uploaded static arrays (see upload())
 
     def fill_committed(self, committed: dict):
         """→ (comm_present [T,R] bool, comm_vers [T,R,2] uint32)."""
@@ -170,15 +171,28 @@ class StaticBlock:
                 comm_vers[j, a] = cv
         return comm_present, comm_vers
 
+    def upload(self) -> None:
+        """Push the state-independent arrays to device NOW — called
+        from the prefetch thread so launch-time H2D is only the two
+        committed-version arrays (tunnel transfers are latency-bound,
+        so moving them off the critical path matters more than their
+        size suggests)."""
+        if self._jnp is None:
+            self._jnp = (
+                jnp.asarray(self.read_keys), jnp.asarray(self.read_present),
+                jnp.asarray(self.read_vers), jnp.asarray(self.write_keys),
+                jnp.asarray(self.rq_lo), jnp.asarray(self.rq_hi),
+            )
+
     def device_args(self, committed: dict):
         """Assemble the full `mvcc_validate` argument tuple (minus
         pre_ok) in signature order."""
         comm_present, comm_vers = self.fill_committed(committed)
+        self.upload()
+        a = self._jnp
         return (
-            jnp.asarray(self.read_keys), jnp.asarray(self.read_present),
-            jnp.asarray(self.read_vers), jnp.asarray(comm_present),
-            jnp.asarray(comm_vers), jnp.asarray(self.write_keys),
-            jnp.asarray(self.rq_lo), jnp.asarray(self.rq_hi),
+            a[0], a[1], a[2], jnp.asarray(comm_present),
+            jnp.asarray(comm_vers), a[3], a[4], a[5],
         )
 
 
@@ -251,6 +265,84 @@ def prepare_block(txs: list[TxRWSet], committed: dict, bucketed: bool = False):
     """Build the full device-array tuple for `mvcc_validate` (static
     arrays + committed-version fill in one go)."""
     return prepare_block_static(txs, bucketed=bucketed).device_args(committed)
+
+
+@dataclass
+class VecStaticBlock(StaticBlock):
+    """StaticBlock variant fed by the native mvcc_prep flat arrays:
+    committed-version fill is a numpy gather over per-unique-key
+    arrays instead of a per-read Python loop.  Key-id ORDER is
+    arbitrary (hash interning) — valid because blocks with range
+    queries never take this path (mvccprep.cpp forces the Python
+    fallback for them)."""
+
+    r_rows: np.ndarray = None   # [nr] tx row per flat read
+    r_cols: np.ndarray = None   # [nr] slot per flat read
+    r_uid: np.ndarray = None    # [nr] unique-key id per flat read
+    u_composite: list = None    # [n_keys] composite mvcc keys
+
+    def fill_committed(self, committed: dict):
+        U = len(self.u_composite)
+        up = np.zeros(U, bool)
+        uv = np.zeros((U, 2), np.uint32)
+        for u, k in enumerate(self.u_composite):
+            cv = committed.get(k)
+            if cv is not None:
+                up[u] = True
+                uv[u] = cv
+        T, R = self.read_keys.shape
+        comm_present = np.zeros((T, R), bool)
+        comm_vers = np.zeros((T, R, 2), np.uint32)
+        if len(self.r_rows):
+            comm_present[self.r_rows, self.r_cols] = up[self.r_uid]
+            comm_vers[self.r_rows, self.r_cols] = uv[self.r_uid]
+        return comm_present, comm_vers
+
+
+def prepare_block_from_flat(n_txs: int, rwp, composite_keys: list) -> VecStaticBlock:
+    """Native mvcc_prep flat arrays → device-static arrays with pure
+    numpy scatters (no per-read Python loop).  ``composite_keys``:
+    [n_keys] mvcc-form keys for state lookups."""
+    from fabric_tpu.utils.batching import next_pow2
+
+    Tb = max(16, next_pow2(max(1, n_txs)))
+    nr, nw = rwp.n_reads, rwp.n_writes
+    rc = rwp.r_count[:n_txs]
+    wc = rwp.w_count[:n_txs]
+    R = next_pow2(max(1, int(rc.max()) if n_txs else 1))
+    W = next_pow2(max(1, int(wc.max()) if n_txs else 1))
+
+    read_keys = np.full((Tb, R), -1, np.int32)
+    read_present = np.zeros((Tb, R), bool)
+    read_vers = np.zeros((Tb, R, 2), np.uint32)
+    write_keys = np.full((Tb, W), -1, np.int32)
+    rq_lo = np.full((Tb, 1), -1, np.int32)
+    rq_hi = np.full((Tb, 1), -1, np.int32)
+
+    if nr:
+        r_rows = np.repeat(np.arange(n_txs), rc).astype(np.intp)
+        r_cols = (np.arange(nr) - np.repeat(rwp.r_start[:n_txs], rc)).astype(np.intp)
+        r_uid = rwp.r_uid[:nr]
+        read_keys[r_rows, r_cols] = r_uid
+        read_present[r_rows, r_cols] = rwp.r_has_ver[:nr].astype(bool)
+        read_vers[r_rows, r_cols] = rwp.r_ver[:nr].astype(np.uint32)
+    else:
+        r_rows = np.zeros(0, np.intp)
+        r_cols = np.zeros(0, np.intp)
+        r_uid = np.zeros(0, np.int32)
+    if nw:
+        w_rows = np.repeat(np.arange(n_txs), wc).astype(np.intp)
+        w_cols = (np.arange(nw) - np.repeat(rwp.w_start[:n_txs], wc)).astype(np.intp)
+        write_keys[w_rows, w_cols] = rwp.w_uid[:nw]
+
+    read_key_set = {composite_keys[u] for u in np.unique(r_uid)} if nr else set()
+    return VecStaticBlock(
+        read_keys=read_keys, read_present=read_present, read_vers=read_vers,
+        write_keys=write_keys, rq_lo=rq_lo, rq_hi=rq_hi,
+        read_fill=[], read_key_set=read_key_set,
+        r_rows=r_rows, r_cols=r_cols, r_uid=r_uid,
+        u_composite=composite_keys,
+    )
 
 
 def mvcc_validate_block(txs: list[TxRWSet], committed: dict, pre_ok=None):

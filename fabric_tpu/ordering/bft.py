@@ -64,9 +64,11 @@ def _signable(msg: dict) -> bytes:
 class _SlotState:
     payload: bytes | None = None
     pre_prepared: bool = False
+    view: int = -1                                    # pre-prepare's view
     prepares: dict = field(default_factory=dict)      # node -> digest
     prepare_msgs: dict = field(default_factory=dict)  # node -> signed msg
-    commits: dict = field(default_factory=dict)
+    commits: dict = field(default_factory=dict)       # node -> (view, digest)
+    commit_msgs: dict = field(default_factory=dict)   # node -> signed msg
     committed: bool = False
 
 
@@ -98,6 +100,7 @@ class BFTNode:
         self.slots: dict[int, _SlotState] = {}
         self.view_changes: dict[int, dict] = {}  # new_view -> {node: vc}
         self._applied_digest: dict[int, str] = {}  # seq -> payload digest
+        self._commit_proofs: dict[int, list] = {}  # seq -> quorum COMMITs
         self._applied_ev: dict[int, asyncio.Event] = {}
         self._progress_task: asyncio.Task | None = None
         self._pending_since: float | None = None
@@ -151,7 +154,22 @@ class BFTNode:
     def _verify(self, msg: dict) -> bool:
         sender = msg.get("from")
         if sender == self.id:
-            return True
+            # a NETWORK message claiming to be from this very node
+            # (loopback passes verified=True and never lands here) —
+            # e.g. a byzantine leader fabricating a prepare "by us"
+            # inside a view-change certificate.  Verify against our own
+            # identity instead of rubber-stamping.
+            if self.signer is None:
+                return True
+            sig = msg.get("sig")
+            if not sig:
+                return False
+            try:
+                return self.signer.identity.verify(
+                    _signable(msg), bytes.fromhex(sig)
+                )
+            except Exception:
+                return False
         ver = self.verifiers.get(sender)
         if ver is None:
             # dev mode: no verifier registry → accept (tests);
@@ -221,12 +239,31 @@ class BFTNode:
         seq = msg["seq"]
         if seq <= self.last_applied:
             return
-        slot = self._slot(seq)
         payload = bytes.fromhex(msg["payload"])
+        # new-view re-proposal discipline: after a justified view
+        # change, the first seqs are RESERVED for the certified
+        # prepared entries every replica re-derived from the 2f+1
+        # VIEW-CHANGEs (PBFT §4.4) — a new leader that substitutes a
+        # different payload there (or drops one, shifting later
+        # payloads into its slot) is refused
+        exp = getattr(self, "_expected_repro", None)
+        if exp:
+            want = exp.get(seq)
+            if want is not None:
+                if want != _digest(payload):
+                    log.warning(
+                        "%s: view %d leader %s violated the new-view "
+                        "re-proposal set at seq %d — refusing",
+                        self.id, self.view, msg["from"], seq,
+                    )
+                    return
+                del exp[seq]
+        slot = self._slot(seq)
         if slot.pre_prepared and slot.payload != payload:
             return  # equivocating leader: keep the first, view change fixes
         slot.payload = payload
         slot.pre_prepared = True
+        slot.view = self.view
         self._pending_since = self._pending_since or asyncio.get_event_loop().time()
         self._bcast({
             "type": PREPARE, "from": self.id, "view": self.view,
@@ -244,14 +281,25 @@ class BFTNode:
         d = _digest(slot.payload)
         if sum(1 for v in slot.prepares.values() if v == d) >= self.quorum \
                 and self.id not in slot.commits:
-            self._bcast({
+            commit = {
                 "type": COMMIT, "from": self.id, "view": self.view,
                 "seq": msg["seq"], "digest": d,
-            })
+            }
+            if self.signer is not None:
+                # identity rides along (excluded from the signed bytes)
+                # so deliver-side quorum verification can resolve the
+                # sender without a consenter-identity registry
+                commit["from_cert"] = self.signer.serialized.hex()
+            self._bcast(commit)
 
     def _on_commit(self, msg):
+        # commits are STORED regardless of view (a lagging replica must
+        # not discard votes it can only count after catching up); the
+        # PBFT committed predicate — 2f+1 commits matching the view the
+        # slot was pre-prepared in — is enforced at counting time
         slot = self._slot(msg["seq"])
-        slot.commits[msg["from"]] = msg["digest"]
+        slot.commits[msg["from"]] = (msg.get("view"), msg["digest"])
+        slot.commit_msgs[msg["from"]] = msg
         self._try_apply()
 
     def _try_apply(self):
@@ -261,21 +309,78 @@ class BFTNode:
             if slot is None or slot.payload is None or slot.committed:
                 return
             d = _digest(slot.payload)
-            if sum(1 for v in slot.commits.values() if v == d) < self.quorum:
+            votes = [
+                n for n, (v, dg) in slot.commits.items()
+                if dg == d and v == slot.view
+            ]
+            if len(votes) < self.quorum:
                 return
             slot.committed = True
-            entry = Entry(term=self.view, index=seq, data=slot.payload)
+            entry = Entry(term=slot.view, index=seq, data=slot.payload)
+            # persist the quorum COMMIT proof BEFORE the WAL entry: on
+            # restart the WAL replay re-materializes the block, and a
+            # proof lost to a crash window would leave that block
+            # unverifiable at every peer forever
+            proof = [
+                slot.commit_msgs[n] for n in votes if n in slot.commit_msgs
+            ]
+            self._persist_proof(seq, proof)
             self.wal.append([entry])
             self._applied_digest[seq] = d
+            self._commit_proofs[seq] = proof
             if len(self._applied_digest) > 4096:
                 for old in sorted(self._applied_digest)[:2048]:
                     del self._applied_digest[old]
+                for old in sorted(self._commit_proofs)[:2048]:
+                    self._commit_proofs.pop(old, None)
             self.last_applied = seq
             self._pending_since = None
             self.apply_cb(entry)
             ev = self._applied_ev.pop(seq, None)
             if ev:
                 ev.set()
+
+    def _proof_path(self, seq: int) -> str:
+        import os
+
+        d = os.path.join(self.wal.dir, "proofs")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"{seq}.json")
+
+    def _persist_proof(self, seq: int, proof: list) -> None:
+        import os
+
+        path = self._proof_path(seq)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(proof, f)
+        os.replace(tmp, path)
+        # prune far-stale proof files (blocks are materialized at
+        # apply time, so anything this old is long since embedded)
+        if seq > 8192 and seq % 512 == 0:
+            import glob
+
+            for old in glob.glob(os.path.join(self.wal.dir, "proofs", "*.json")):
+                try:
+                    if int(os.path.basename(old).split(".")[0]) < seq - 8192:
+                        os.unlink(old)
+                except (ValueError, OSError):
+                    pass
+
+    def commit_proof(self, seq: int) -> list | None:
+        """The 2f+1 signed COMMIT messages that committed ``seq`` —
+        the quorum attestation the block carries to peers (SmartBFT's
+        signature aggregation, chain.go:360).  Survives restart via the
+        WAL-side proof files (a WAL replay must re-materialize blocks
+        WITH their attestation)."""
+        got = self._commit_proofs.get(seq)
+        if got is not None:
+            return got
+        try:
+            with open(self._proof_path(seq)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
 
     # -- view change -------------------------------------------------------
 
@@ -359,30 +464,18 @@ class BFTNode:
         if len(vcs) > self.f and nv not in getattr(self, "_vc_sent", set()):
             self._start_view_change(nv)
         if len(vcs) >= self.quorum and self.peers[nv % self.n] == self.id:
-            # I lead the new view: install + re-propose entries that
-            # carry a VALID prepare certificate, preferring the
-            # highest-view certificate per sequence (PBFT new-view)
+            # I lead the new view: install + re-propose the certified
+            # prepared entries; the NEW_VIEW carries the 2f+1 signed
+            # VIEW-CHANGE messages as justification so every replica
+            # re-derives (and will enforce) the same re-proposal set
             self._install_view(nv)
-            repro: dict[int, tuple[int, bytes]] = {}
-            for vc in vcs.values():
-                for seq_s, info in vc.get("prepared", {}).items():
-                    seq = int(seq_s)
-                    if seq <= self.last_applied:
-                        continue
-                    payload = bytes.fromhex(info["payload"])
-                    cview = int(info.get("view", 0))
-                    if not self._cert_valid(seq, payload, info.get("cert", [])):
-                        continue
-                    cur = repro.get(seq)
-                    if cur is None or cview > cur[0]:
-                        repro[seq] = (cview, payload)
+            base, repro = self._derive_reproposals(vcs.values())
             self._bcast({
                 "type": NEW_VIEW, "from": self.id, "view": nv,
-                "vc_count": len(vcs),
+                "vcs": dict(vcs),
             })
-            self.next_seq = self.last_applied + 1
-            for seq in sorted(repro):
-                payload = repro[seq][1]
+            self.next_seq = base
+            for _old_seq, payload in repro:
                 s = self.next_seq
                 self.next_seq += 1
                 self._bcast({
@@ -390,14 +483,80 @@ class BFTNode:
                     "seq": s, "payload": payload.hex(),
                 })
 
+    def _derive_reproposals(self, vcs) -> tuple:
+        """→ (base_seq, certified prepared entries) a new view MUST
+        re-propose: per sequence above the quorum's highest claimed
+        last_applied, the highest-view entry backed by a valid 2f+1
+        prepare certificate, in old-sequence order (PBFT §4.4).
+
+        EVERYTHING here derives from the view-change set itself — never
+        from this node's own last_applied — so the leader and every
+        replica verifying the NEW_VIEW compute the SAME (base, repro)
+        mapping even when their application states diverge.  A node
+        whose last_applied lags base has a gap it can only close by
+        catch-up (see the raft follower-chain work); a byzantine node
+        inflating its claimed last_applied can stall liveness (the next
+        timeout re-elects) but never safety."""
+        vcs = list(vcs)
+        L = max((int(vc.get("last_applied", 0)) for vc in vcs), default=0)
+        repro: dict[int, tuple[int, bytes]] = {}
+        for vc in vcs:
+            for seq_s, info in vc.get("prepared", {}).items():
+                seq = int(seq_s)
+                if seq <= L:
+                    continue  # committed somewhere per the quorum claims
+                try:
+                    payload = bytes.fromhex(info["payload"])
+                    cview = int(info.get("view", 0))
+                except (KeyError, ValueError, TypeError):
+                    continue
+                if not self._cert_valid(seq, payload, info.get("cert", [])):
+                    continue
+                cur = repro.get(seq)
+                if cur is None or cview > cur[0]:
+                    repro[seq] = (cview, payload)
+        return L + 1, [(seq, repro[seq][1]) for seq in sorted(repro)]
+
     def _on_new_view(self, msg):
-        if msg["view"] > self.view and msg["from"] == self.peers[msg["view"] % self.n]:
-            self._install_view(msg["view"])
+        """Install a higher view ONLY on proof: the NEW_VIEW must carry
+        2f+1 correctly signed VIEW-CHANGE messages for that view.  The
+        replica re-derives the certified re-proposal set from them and
+        _on_pre_prepare enforces that the new leader neither drops nor
+        substitutes a certified prepared entry (reference: SmartBFT's
+        view-change verification, orderer/consensus/smartbft/
+        verifier.go; PBFT §4.4)."""
+        v = msg["view"]
+        if v <= self.view or msg["from"] != self.peers[v % self.n]:
+            return
+        valid = {}
+        for node, vc in (msg.get("vcs") or {}).items():
+            if not isinstance(vc, dict) or vc.get("type") != VIEW_CHANGE:
+                continue
+            if vc.get("from") != node or vc.get("new_view") != v:
+                continue
+            if self._verify(vc):
+                valid[node] = vc
+        if len(valid) < self.quorum:
+            log.warning(
+                "%s: NEW_VIEW %d from %s lacks a 2f+1 view-change "
+                "justification — refusing to install",
+                self.id, v, msg["from"],
+            )
+            return
+        base, repro = self._derive_reproposals(valid.values())
+        self._install_view(v)
+        self._expected_repro = {
+            base + off: _digest(payload)
+            for off, (_seq, payload) in enumerate(repro)
+        }
 
     def _install_view(self, view: int):
         self.view = view
         self._vc_target = view
         self._pending_since = None
+        # stale reservations from an earlier view change must not block
+        # this view's sequences (set fresh by the new-view handler)
+        self._expected_repro = {}
         # drop uncommitted slot votes from the old view (re-proposals
         # will rebuild them under the new view's sequences)
         for seq in list(self.slots):

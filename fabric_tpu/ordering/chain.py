@@ -31,13 +31,21 @@ from fabric_tpu.protos import common_pb2
 
 
 class MsgProcessor:
-    """Broadcast admission: size cap, optional signature policy check
-    (sigfilter/sizefilter analogs)."""
+    """Broadcast admission: size cap + the signature filter
+    (sigfilter/sizefilter analogs, orderer/common/msgprocessor).
 
-    def __init__(self, config: BatchConfig, msp_manager=None, policy=None):
+    ``policy_eval(signed_data_list) -> bool`` evaluates the channel's
+    /Channel/Writers policy (wired from the genesis bundle by
+    join_channel); with only an MSP manager the filter degrades to a
+    bare valid-identity signature check; with neither (dev assemblies)
+    admission is size-only."""
+
+    def __init__(self, config: BatchConfig, msp_manager=None, policy=None,
+                 policy_eval=None):
         self.config = config
         self.msp = msp_manager
         self.policy = policy
+        self.policy_eval = policy_eval
 
     def check(self, env_bytes: bytes) -> str | None:
         """→ None if admitted, else reject reason."""
@@ -45,7 +53,15 @@ class MsgProcessor:
             return "empty envelope"
         if len(env_bytes) > self.config.absolute_max_bytes:
             return "message too large"
-        if self.msp is not None and self.policy is not None:
+        if self.policy_eval is not None:
+            try:
+                env = protoutil.unmarshal(common_pb2.Envelope, env_bytes)
+                sd = protoutil.envelope_as_signed_data(env)
+                if not self.policy_eval([sd]):
+                    return "Writers policy not satisfied"
+            except Exception as e:
+                return f"bad envelope: {e}"
+        elif self.msp is not None and self.policy is not None:
             try:
                 env = protoutil.unmarshal(common_pb2.Envelope, env_bytes)
                 sd = protoutil.envelope_as_signed_data(env)
@@ -70,6 +86,7 @@ class OrderingChain:
         self.config = config or BatchConfig()
         self.cutter = BlockCutter(self.config)
         self.msgproc = msgproc or MsgProcessor(self.config)
+        self.signer = signer  # block attestation (blockwriter.go)
         self.blocks = BlockStore(f"{data_dir}/chains")
         if self.blocks.height == 0 and genesis_block is not None:
             self.blocks.add_block(genesis_block)
@@ -194,13 +211,23 @@ class OrderingChain:
         for env in batch:
             blk.data.data.append(env)
         blk = protoutil.finalize_block(blk)
-        # orderer metadata: consensus term/index for forensic parity
+        # orderer metadata: consensus term/index; for BFT, the 2f+1
+        # signed COMMIT proof binding (view, seq, digest) — the quorum
+        # attestation peers check at deliver (verifier_assembler.go)
         idx = common_pb2.BlockMetadataIndex.ORDERER
         while len(blk.metadata.metadata) <= idx:
             blk.metadata.metadata.append(b"")
-        blk.metadata.metadata[idx] = json.dumps(
-            {"term": entry.term, "index": entry.index}
-        ).encode()
+        meta = {"term": entry.term, "index": entry.index}
+        proof_of = getattr(self.raft, "commit_proof", None)
+        if proof_of is not None:
+            proof = proof_of(entry.index)
+            if proof is not None:
+                meta["bft_proof"] = proof
+        blk.metadata.metadata[idx] = json.dumps(meta).encode()
+        # sign the assembled block: deliver-side verification against
+        # the channel's BlockValidation policy depends on it
+        if self.signer is not None:
+            protoutil.sign_block(blk, self.signer)
         self.blocks.add_block(blk)
         self._height_changed.set()
         self._height_changed = asyncio.Event()

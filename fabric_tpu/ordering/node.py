@@ -33,7 +33,8 @@ class OrdererNode:
                  host: str = "127.0.0.1", port: int = 0,
                  batch_config: BatchConfig | None = None,
                  msp_manager=None, consensus: str = "raft",
-                 signer=None, verifiers=None, view_timeout: float = 2.0):
+                 signer=None, verifiers=None, view_timeout: float = 2.0,
+                 tls=None):
         self.id = node_id
         self.dir = data_dir
         self.cluster = dict(cluster)  # node_id -> (host, port)
@@ -46,8 +47,11 @@ class OrdererNode:
         self.signer = signer
         self.verifiers = verifiers or {}
         self.view_timeout = view_timeout
+        self.tls = tls  # comm.rpc.TlsProfile: mTLS on every surface
         self.chains: dict[str, OrderingChain] = {}
-        self.server = RpcServer(host, port)
+        self.server = RpcServer(
+            host, port, ssl_ctx=tls.server_ctx() if tls else None
+        )
         self._peer_clients: dict[str, RpcClient] = {}
         self._bg: set = set()  # strong refs: GC destroys weakly-held tasks
 
@@ -69,7 +73,10 @@ class OrdererNode:
             addr = self.cluster[peer_id]
 
             async def connect():
-                cli = RpcClient(*addr)
+                cli = RpcClient(
+                    *addr,
+                    ssl_ctx=self.tls.client_ctx() if self.tls else None,
+                )
                 await cli.connect()
                 return cli
 
@@ -106,12 +113,36 @@ class OrdererNode:
                      start: bool = True) -> OrderingChain:
         if channel_id in self.chains:
             return self.chains[channel_id]
+        # broadcast signature filter: with a genesis config the channel
+        # Writers policy gates every submitted envelope (sigfilter,
+        # orderer/common/msgprocessor/standardchannel.go:100); dev
+        # channels without a genesis degrade to size-only admission
+        msgproc = MsgProcessor(self.batch_config, self.msp)
+        if genesis_block is not None:
+            try:
+                from fabric_tpu.channelconfig import bundle_from_genesis
+
+                bundle = bundle_from_genesis(channel_id, genesis_block)
+                msgproc = MsgProcessor(
+                    self.batch_config, bundle.msp_manager,
+                    policy_eval=lambda sds: bundle.policy_manager.evaluate(
+                        "/Channel/Writers", sds
+                    ),
+                )
+            except Exception:
+                import logging
+
+                logging.getLogger("fabric_tpu.orderer").exception(
+                    "%s: genesis config unusable for the broadcast "
+                    "signature filter on %s — size-only admission",
+                    self.id, channel_id,
+                )
         chain = OrderingChain(
             channel_id, self.id, list(self.cluster),
             data_dir=f"{self.dir}/{channel_id}",
             send_cb=self._send(channel_id),
             config=self.batch_config,
-            msgproc=MsgProcessor(self.batch_config, self.msp),
+            msgproc=msgproc,
             genesis_block=genesis_block,
             consensus=self.consensus, signer=self.signer,
             verifiers=self.verifiers, view_timeout=self.view_timeout,
@@ -242,15 +273,16 @@ class BroadcastClient:
     """Client-side submit with leader-redirect retry (the SDK-facing
     behavior the reference gets from leader forwarding)."""
 
-    def __init__(self, endpoints: list[tuple[str, int]]):
+    def __init__(self, endpoints: list[tuple[str, int]], ssl_ctx=None):
         self.endpoints = list(endpoints)
+        self.ssl_ctx = ssl_ctx
         self._clients: dict[tuple[str, int], RpcClient] = {}
 
     async def _client(self, addr) -> RpcClient:
         addr = tuple(addr)
         cli = self._clients.get(addr)
         if cli is None:
-            cli = RpcClient(*addr)
+            cli = RpcClient(*addr, ssl_ctx=self.ssl_ctx)
             await cli.connect()
             self._clients[addr] = cli
         return cli
@@ -295,11 +327,12 @@ class BroadcastClient:
 class DeliverClient:
     """Pull a block stream from an orderer (peer side)."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, ssl_ctx=None):
         self.addr = (host, port)
+        self.ssl_ctx = ssl_ctx
 
     async def blocks(self, channel: str, start: int = 0, stop: int | None = None):
-        cli = RpcClient(*self.addr)
+        cli = RpcClient(*self.addr, ssl_ctx=self.ssl_ctx)
         await cli.connect()
         try:
             st = await cli.open_stream("Deliver")

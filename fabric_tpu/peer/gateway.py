@@ -65,7 +65,11 @@ class Gateway:
             )
 
     async def _endorse_remote(self, host, port, req: bytes):
-        cli = RpcClient(host, port)
+        cli = RpcClient(
+            host, port,
+            ssl_ctx=self.node.tls.client_ctx()
+            if getattr(self.node, "tls", None) else None,
+        )
         await cli.connect()
         try:
             raw = await cli.unary("Endorse", req)
@@ -153,7 +157,11 @@ class Gateway:
             raise GatewayError(503, "no orderers known for channel")
         from fabric_tpu.ordering.node import BroadcastClient
 
-        cli = BroadcastClient(list(addrs))
+        cli = BroadcastClient(
+            list(addrs),
+            ssl_ctx=self.node.tls.client_ctx()
+            if getattr(self.node, "tls", None) else None,
+        )
         try:
             res = await cli.broadcast(channel, env_bytes)
         finally:
@@ -260,14 +268,15 @@ class GatewayClient:
     fabric-gateway client analog): sign → endorse → sign → submit →
     await commit."""
 
-    def __init__(self, host: str, port: int, signer):
+    def __init__(self, host: str, port: int, signer, ssl_ctx=None):
         self.host, self.port = host, port
         self.signer = signer
+        self.ssl_ctx = ssl_ctx
         self._cli: RpcClient | None = None
 
     async def _client(self) -> RpcClient:
         if self._cli is None:
-            self._cli = RpcClient(self.host, self.port)
+            self._cli = RpcClient(self.host, self.port, ssl_ctx=self.ssl_ctx)
             await self._cli.connect()
         return self._cli
 

@@ -48,6 +48,11 @@ class ChaincodeDefinition:
     #         {"ref": "<channel application policy name>"}
     policy: dict = field(default_factory=lambda: {"ref": "Endorsement"})
     init_required: bool = False
+    # collections: {name: {"member_orgs": [msp_id...],
+    #   "required_peer_count": int, "max_peer_count": int, "btl": int}}
+    # — the StaticCollectionConfig surface (peer/collection.proto:
+    # member_orgs_policy, required/maximum peer counts, block_to_live)
+    collections: dict = field(default_factory=dict)
 
     def to_bytes(self) -> bytes:
         return json.dumps(
@@ -57,6 +62,7 @@ class ChaincodeDefinition:
                 "plugin": self.plugin,
                 "policy": self.policy,
                 "init_required": self.init_required,
+                "collections": self.collections,
             },
             sort_keys=True,
         ).encode()
@@ -69,6 +75,7 @@ class ChaincodeDefinition:
             plugin=d.get("plugin", "default"),
             policy=d.get("policy", {"ref": "Endorsement"}),
             init_required=bool(d.get("init_required", False)),
+            collections=d.get("collections", {}),
         )
 
 
@@ -149,6 +156,7 @@ class LifecycleContract(Contract):
         cd = ChaincodeDefinition(
             name=nm, sequence=seq, plugin=params.get("plugin", "default"),
             policy=policy, init_required=bool(params.get("init_required")),
+            collections=params.get("collections", {}),
         )
         stub.put_state(definition_key(nm), cd.to_bytes())
         stub.set_event("CommitChaincodeDefinition", nm.encode())
@@ -202,6 +210,19 @@ class LifecyclePolicyProvider:
         if ast is None:
             return None
         return NamespaceInfo(policy=ast, plugin=cd.plugin)
+
+    def collection(self, namespace: str, coll: str) -> dict | None:
+        """Collection config from the committed definition (the
+        distributor/coordinator's eligibility + BTL source,
+        gossip/privdata/distributor.go:180-235) or None if the
+        namespace/collection is undefined."""
+        vv = self.state.get_state(LIFECYCLE_NS, definition_key(namespace))
+        if vv is None:
+            return None
+        try:
+            return ChaincodeDefinition.from_bytes(vv.value).collections.get(coll)
+        except Exception:
+            return None
 
     def _resolve_policy(self, spec: dict):
         if "sig" in spec:

@@ -160,6 +160,13 @@ class PeerChannel:
     def height(self) -> int:
         return self.ledger.blocks.height
 
+    def collection_config(self, ns: str, coll: str) -> dict | None:
+        """Collection config (member orgs, peer counts, BTL) from the
+        channel's policy provider — lifecycle-backed when a definition
+        is committed, static otherwise; None = undefined."""
+        fn = getattr(self.validator.policies, "collection", None)
+        return fn(ns, coll) if fn else None
+
     def make_endorser(self, msp, signer, runtime):
         """Endorser over THIS channel's state, system chaincodes and
         ACLs — the single construction point shared by the Endorse RPC
@@ -191,10 +198,17 @@ class PeerChannel:
 
         reg = global_registry()
         loop = asyncio.get_event_loop()
+
+        def _verify_and_validate(b):
+            # signature + attestation checks are ECDSA-heavy: keep them
+            # off the event loop with the rest of validation
+            self.verify_block_signature(b)
+            return self.validator.validate(b)
+
         async with self.commit_lock:
             t0 = _time.perf_counter()
             flt, batch, history = await loop.run_in_executor(
-                None, self.validator.validate, block
+                None, _verify_and_validate, block
             )
             t1 = _time.perf_counter()
             # pvt phase (StoreBlock, coordinator.go:190-220): cleartext
@@ -209,8 +223,17 @@ class PeerChannel:
                     batch.delete(hns, key, ver)
                 else:
                     batch.put(hns, key, value, ver)
+            def _expiry(ns, coll):
+                # BTL from the collection config: expiringBlk =
+                # committingBlk + btl + 1 (pvtdatapolicy.BTLPolicy) —
+                # the data stays queryable for btl FULL blocks past its
+                # commit, then purge_expired erases store + pvt state
+                btl = int((self.collection_config(ns, coll) or {})
+                          .get("btl", 0) or 0)
+                return block.header.number + btl + 1 if btl > 0 else 0
+
             pvt_store = {
-                (txnum, ns, coll): (encode_kv(kv), 0)
+                (txnum, ns, coll): (encode_kv(kv), _expiry(ns, coll))
                 for txnum, colls in pvt.store_data.items()
                 for (ns, coll), kv in colls.items()
             }
@@ -310,13 +333,146 @@ class PeerChannel:
                     self.id, ptx.idx, block.header.number,
                 )
 
+    def verify_block_signature(self, block) -> None:
+        """VerifyBlock at deliver (block_verification.go:243): a block
+        arriving from ANY source — deliver stream, anti-entropy pull —
+        must carry orderer signatures satisfying the channel's
+        /Channel/Orderer/BlockValidation policy before it may commit.
+        Without this, one compromised orderer (or an impostor peer) can
+        fork peers by serving divergent, individually well-formed
+        blocks.  The genesis block is the trust anchor (verified
+        out-of-band by the joining admin), and channels whose config
+        carries no orderer orgs (dev/test assemblies) have no identity
+        set to verify against — both skip."""
+        if block.header.number == 0:
+            return
+        bundle = getattr(self.processor, "bundle", None)
+        if bundle is None:
+            return
+        ordg = bundle.config.channel_group.groups.get("Orderer")
+        if ordg is None or not ordg.groups:
+            return  # no orderer identity set configured
+        from fabric_tpu.channelconfig import SignedData
+
+        signed = [
+            SignedData(identity=c, data=d, signature=s)
+            for c, d, s in protoutil.block_signed_data(block)
+        ]
+        if not signed or not bundle.policy_manager.evaluate(
+            "/Channel/Orderer/BlockValidation", signed
+        ):
+            raise ValueError(
+                f"block {block.header.number}: orderer block-signature "
+                "verification failed (BlockValidation policy not met)"
+            )
+        self._verify_bft_attestation(block, bundle)
+
+    def _verify_bft_attestation(self, block, bundle) -> None:
+        """For BFT channels a single orderer signature is NOT enough —
+        one byzantine orderer could sign a forged block.  The block's
+        consensus metadata must carry the 2f+1 signed COMMIT messages
+        for (view, seq, digest-of-batch), each by a distinct, valid
+        orderer-org identity, with the digest recomputed from the
+        block's own envelopes and seq strictly increasing along the
+        chain (reference: BFT quorum attestations,
+        common/deliverclient/block_verification.go:278)."""
+        import hashlib
+        import json as _json
+
+        from fabric_tpu.protos import orderer_pb2
+
+        ct = bundle.orderer_value("ConsensusType", orderer_pb2.ConsensusType)
+        if ct is None or ct.type != "bft":
+            return
+        meta = orderer_pb2.RaftConfigMetadata()
+        meta.ParseFromString(ct.metadata)
+        n = len(meta.consenters)
+        quorum = 2 * ((n - 1) // 3) + 1 if n else 1
+
+        idx = common_pb2.BlockMetadataIndex.ORDERER
+        try:
+            omd = _json.loads(bytes(block.metadata.metadata[idx]))
+            proof = omd["bft_proof"]
+            seq = int(omd["index"])
+        except Exception:
+            raise ValueError(
+                f"block {block.header.number}: missing BFT commit proof"
+            )
+        payload = _json.dumps(
+            [bytes(e).hex() for e in block.data.data]
+        ).encode()
+        want_digest = hashlib.sha256(payload).hexdigest()
+
+        from fabric_tpu.ordering.bft import COMMIT, _signable
+
+        # votes count only from the CONSENTER SET (identities pinned in
+        # the channel config), deduped by identity — not by the
+        # unauthenticated "from" label: a single compromised identity
+        # cannot fabricate 2f+1 votes by inventing sender names, and no
+        # non-consenter identity (app orgs, orderer-org admins/users)
+        # can vote at all.  Channels whose config predates consenter
+        # identities fall back to orderer-ORG membership.
+        consenter_ids = {
+            bytes(c.identity) for c in meta.consenters if c.identity
+        }
+        ordg = bundle.config.channel_group.groups.get("Orderer")
+        orderer_orgs = set(ordg.groups) if ordg is not None else set()
+        voters = set()  # distinct identity bytes
+        for m in proof:
+            if not isinstance(m, dict) or m.get("type") != COMMIT:
+                continue
+            if m.get("digest") != want_digest or int(m.get("seq", -1)) != seq:
+                continue
+            cert = m.get("from_cert")
+            sig = m.get("sig")
+            if not cert or not sig:
+                continue
+            try:
+                raw_cert = bytes.fromhex(cert)
+                if raw_cert in voters:
+                    continue
+                if consenter_ids:
+                    if raw_cert not in consenter_ids:
+                        continue
+                ident = bundle.msp_manager.deserialize_identity(raw_cert)
+                if not ident.is_valid or ident.msp_id not in orderer_orgs:
+                    continue
+                if not ident.verify(_signable(m), bytes.fromhex(sig)):
+                    continue
+            except Exception:
+                continue
+            voters.add(raw_cert)
+        if len(voters) < quorum:
+            raise ValueError(
+                f"block {block.header.number}: BFT attestation has "
+                f"{len(voters)} valid commits, quorum is {quorum}"
+            )
+        # seq monotonicity along the chain: a replayed proof from an
+        # older batch cannot attest a later block
+        prev_seq = getattr(self, "_last_bft_seq", None)
+        if prev_seq is None and block.header.number >= 2:
+            try:
+                prev = self.ledger.blocks.get_block(block.header.number - 1)
+                prev_seq = int(_json.loads(
+                    bytes(prev.metadata.metadata[idx])
+                )["index"])
+            except Exception:
+                prev_seq = None
+        if prev_seq is not None and seq <= prev_seq:
+            raise ValueError(
+                f"block {block.header.number}: BFT proof seq {seq} does "
+                f"not advance past predecessor's {prev_seq}"
+            )
+        self._last_bft_seq = seq
+
     async def run_deliver(self, orderer_addr: tuple[str, int]):
         """Pull blocks from the orderer starting at our height and
         commit them in order; reconnects forever (deliver client
         failover is caller-side: pass a different address)."""
         import contextlib
 
-        dc = DeliverClient(*orderer_addr)
+        dc = DeliverClient(*orderer_addr,
+                           ssl_ctx=getattr(self, "client_ssl", None))
         async with contextlib.aclosing(dc.blocks(self.id, start=self.height)) as gen:
             async for blk in gen:
                 if blk.header.number < self.height:
@@ -388,14 +544,17 @@ class PeerChannel:
 class PeerNode:
     def __init__(self, node_id: str, data_dir: str, msp_manager, signer,
                  runtime: ChaincodeRuntime | None = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0, tls=None):
         self.id = node_id
         self.dir = data_dir
         self.msp = msp_manager
         self.signer = signer
         self.runtime = runtime or ChaincodeRuntime()
+        self.tls = tls  # comm.rpc.TlsProfile: mTLS on every surface
         self.channels: dict[str, PeerChannel] = {}
-        self.server = RpcServer(host, port)
+        self.server = RpcServer(
+            host, port, ssl_ctx=tls.server_ctx() if tls else None
+        )
         from fabric_tpu.discovery import PeerRegistry
 
         self.registry = PeerRegistry()  # org → endorsing peers (gateway/discovery)
@@ -410,6 +569,7 @@ class PeerNode:
             policy_provider, state_db, config_processor,
             genesis_block=genesis_block, snapshot_dir=snapshot_dir,
         )
+        ch.client_ssl = self.tls.client_ctx() if self.tls else None
         self.channels[channel_id] = ch
         gsvc = getattr(self, "gossip_service", None)
         if gsvc is not None:

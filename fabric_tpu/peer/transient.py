@@ -12,20 +12,9 @@ import json
 import sqlite3
 
 
-def encode_kv(kv: dict) -> bytes:
-    """{key: value|None} → canonical stored/wire JSON bytes (hex
-    values) — THE pvt cleartext encoding, shared by the pvtdata store
-    payloads, gossip push/pull, and the reconciler."""
-    return json.dumps(
-        {k: (v.hex() if v is not None else None) for k, v in kv.items()},
-        sort_keys=True,
-    ).encode()
-
-
-def decode_kv(raw) -> dict:
-    data = json.loads(raw)
-    return {k: (bytes.fromhex(v) if v is not None else None)
-            for k, v in data.items()}
+# canonical pvt cleartext encoding lives with the store; re-exported
+# here for the peer-layer callers
+from fabric_tpu.ledger.pvtdata import decode_kv, encode_kv  # noqa: F401
 
 
 class TransientStore:

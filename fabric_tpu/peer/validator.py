@@ -66,6 +66,10 @@ class NamespaceInfo:
 
     policy: object  # crypto.policy AST
     plugin: str = "default"
+    # {coll: {"member_orgs": [...], "required_peer_count": int,
+    #  "max_peer_count": int, "btl": int}} — static assemblies;
+    # lifecycle-backed providers read the committed definition instead
+    collections: dict = field(default_factory=dict)
 
 
 class PolicyProvider:
@@ -79,6 +83,15 @@ class PolicyProvider:
     def info(self, namespace: str) -> NamespaceInfo | None:
         return self.infos.get(namespace) or self.default
 
+    def collection(self, namespace: str, coll: str) -> dict | None:
+        """Collection config for (namespace, coll), or None when
+        undefined — undefined collections are treated as
+        maximally-private (own org only) by the dissemination layer."""
+        info = self.info(namespace)
+        if info is None:
+            return None
+        return getattr(info, "collections", {}).get(coll)
+
 
 @dataclass
 class ParsedTx:
@@ -88,15 +101,34 @@ class ParsedTx:
     channel: str = ""
     creator: bytes = b""
     namespaces: tuple = ()
-    rwset: TxRWSet | None = None
     endorsements: list = field(default_factory=list)  # (endorser_serialized, item)
     creator_item_idx: int = -1
     endo_item_idx: list = field(default_factory=list)
     is_config: bool = False
+    rwset_bytes: bytes | None = None  # lazy wire form (native fast path)
+    _rwset: object = None
 
     @property
     def undetermined(self) -> bool:
         return self.code == C.NOT_VALIDATED
+
+    @property
+    def rwset(self):
+        """Parsed rwset; LAZY when the native fast path supplied flat
+        arrays instead (only the rare host-fallback paths ever touch
+        this).  A parse failure here is unreachable for txs the native
+        parser validated, but fails closed (BAD_RWSET) regardless."""
+        if self._rwset is None and self.rwset_bytes is not None:
+            try:
+                self._rwset = TxRWSet.from_bytes(self.rwset_bytes)
+            except Exception:
+                self.code = C.BAD_RWSET
+                self._rwset = TxRWSet()
+        return self._rwset
+
+    @rwset.setter
+    def rwset(self, value):
+        self._rwset = value
 
 
 @dataclass
@@ -105,6 +137,25 @@ class BlockValidationCtx:
     sig_valid: np.ndarray  # [n_items] bool, global signature batch
     msp_manager: object
     policy_provider: PolicyProvider
+
+
+@dataclass
+class PendingBlock:
+    """A launched-but-not-synced block: the handle between
+    validate_launch and validate_finish.  ``txids`` feeds the NEXT
+    block's extra_txids; the triple is produced by validate_finish."""
+
+    block: object
+    txs: list
+    items: object
+    fetch: object          # p256 VerifyHandle
+    dpre: object           # _DevicePre or None
+    overlay: object = None  # predecessor UpdateBatch (in-flight commit)
+    fetch2: object = None   # stage-2 packed fetch, set by _launch_device
+
+    @property
+    def txids(self) -> set:
+        return {ptx.txid for ptx in self.txs if ptx.txid}
 
 
 @dataclass
@@ -119,6 +170,9 @@ class _DevicePre:
     static: object        # mvcc_ops.StaticBlock
     has_range: bool
     policies: object
+    rwp: object = None    # native mvcc_prep flat arrays (fast blocks)
+    ns_names: list = None
+    ukeys: list = None    # decoded unique key strings (shared w/ fill)
 
 
 class BlockValidator:
@@ -181,16 +235,19 @@ class BlockValidator:
         items = SigCollector()  # column-form signature batch
         seen_txids: dict[str, int] = {}
         native = None
-        if len(block.data.data) >= 16 and block.header.number != 0:
+        # config/genesis envelopes come back ok=0 from the native walk
+        # and take the Python path per envelope — no number gate needed
+        if len(block.data.data) >= 16:
             try:
                 from fabric_tpu.native import blockparse as nbp
 
                 native = nbp.parse_envelopes(list(block.data.data))
             except Exception:
                 native = None
+        fast_ctx = self._fast_ctx(native) if native is not None else None
         for i, env_bytes in enumerate(block.data.data):
-            if native is not None and native.ok[i]:
-                self._parse_fast(i, native, txs, items, seen_txids)
+            if fast_ctx is not None and fast_ctx["ok"][i]:
+                self._parse_fast(i, fast_ctx, txs, items, seen_txids)
                 continue
             ptx = ParsedTx(idx=i)
             txs.append(ptx)
@@ -295,22 +352,97 @@ class BlockValidator:
             except Exception:
                 ptx.code = C.BAD_RWSET
                 continue
-        return txs, items
 
-    def _parse_fast(self, i: int, native, txs, items, seen_txids) -> None:
+        # rwsets of native-fast endorser txs: ONE C call parses, interns
+        # keys, and emits flat arrays; txs it cannot cover (ranges,
+        # hashed collections, malformed, non-UTF8) take the Python
+        # parser tx by tx
+        rwp = None
+        if native is not None:
+            use = np.zeros(len(txs), bool)
+            for ptx in txs:
+                if (
+                    native.ok[ptx.idx] and ptx.undetermined
+                    and not ptx.is_config
+                ):
+                    use[ptx.idx] = True
+            if use.any():
+                try:
+                    from fabric_tpu.native import mvccprep_py
+
+                    rwp = mvccprep_py.prep(native, use)
+                except Exception:
+                    rwp = None
+                ns_names = rwp.ns_names() if rwp is not None else None
+                for ptx in txs:
+                    i = ptx.idx
+                    if not use[i]:
+                        continue
+                    if rwp is not None and rwp.status[i] == 0:
+                        s = int(rwp.tx_ns_start[i])
+                        c = int(rwp.tx_ns_count[i])
+                        ptx.namespaces = tuple(sorted(
+                            ns_names[j] for j in rwp.ns_ids_flat[s:s + c]
+                        ))
+                        ptx.rwset_bytes = (
+                            native.span(native.results_span, i) or b""
+                        )
+                    else:
+                        self._py_rwset(ptx, native)
+        return txs, items, rwp
+
+    def _py_rwset(self, ptx, native) -> None:
+        """Python rwset parse for one native-fast tx the flat path
+        cannot cover — identical verdicts to the pure-Python path."""
+        try:
+            results = native.span(native.results_span, ptx.idx) or b""
+            ptx.rwset = TxRWSet.from_bytes(results)
+            ptx.namespaces = tuple(sorted(ptx.rwset.ns))
+        except Exception:
+            ptx.code = C.BAD_RWSET
+
+    @staticmethod
+    def _fast_ctx(native) -> dict:
+        """Hoist the native arrays the per-tx loop touches into plain
+        Python lists ONCE per block — numpy scalar indexing inside a
+        1000-iteration loop costs more than the work it guards."""
+        return {
+            "native": native,
+            "blob": native.blob,
+            "ok": native.ok.tolist(),
+            "txid": native.txid_span.tolist(),
+            "channel": native.channel_span.tolist(),
+            "creator": native.creator_span.tolist(),
+            "txid_digest": [bytes(d).hex() for d in native.txid_digest],
+            "creator_sig_ok": native.creator_sig_ok.tolist(),
+            "endo_start": native.endo_start.tolist(),
+            "endo_count": native.endo_count.tolist(),
+            "e_span": native.e_endorser_span.tolist(),
+            "e_ok": native.e_ok.tolist(),
+            "c_arrs": (native.payload_digest, native.creator_r,
+                       native.creator_s),
+            "e_arrs": (native.e_digest, native.e_r, native.e_s),
+        }
+
+    def _parse_fast(self, i: int, ctx, txs, items, seen_txids) -> None:
         """Native-pre-parsed endorser tx → ParsedTx + signature items;
         check order mirrors the Python path exactly."""
         ptx = ParsedTx(idx=i)
         txs.append(ptx)
-        txid_b = native.span(native.txid_span, i)
-        channel_b = native.span(native.channel_span, i)
-        creator = native.span(native.creator_span, i) or b""
+        blob = ctx["blob"]
+        to, tl = ctx["txid"][i]
+        co, cl = ctx["creator"][i]
+        ho, hl = ctx["channel"][i]
+        txid_b = blob[to:to + tl] if to >= 0 else None
+        creator = blob[co:co + cl] if co >= 0 else b""
         ptx.txid = txid_b.decode("utf-8", "replace") if txid_b else ""
-        ptx.channel = channel_b.decode("utf-8", "replace") if channel_b else ""
+        ptx.channel = (
+            blob[ho:ho + hl].decode("utf-8", "replace") if ho >= 0 else ""
+        )
         ptx.creator = creator
 
         # txid binding: tx_id == sha256(nonce ‖ creator) hex
-        if not ptx.txid or ptx.txid != bytes(native.txid_digest[i]).hex():
+        if not ptx.txid or ptx.txid != ctx["txid_digest"][i]:
             ptx.code = C.BAD_PROPOSAL_TXID
             return
         if ptx.txid in seen_txids:
@@ -324,38 +456,32 @@ class BlockValidator:
         except Exception:
             ptx.code = C.BAD_CREATOR_SIGNATURE
             return
-        if not ident.is_valid or not native.creator_sig_ok[i]:
+        if not ident.is_valid or not ctx["creator_sig_ok"][i]:
             ptx.code = C.BAD_CREATOR_SIGNATURE
             return
-        ptx.creator_item_idx = items.add_fast(
-            (native.payload_digest, native.creator_r, native.creator_s),
-            i, ident,
-        )
+        ptx.creator_item_idx = items.add_fast(ctx["c_arrs"], i, ident)
 
-        try:
-            results = native.span(native.results_span, i) or b""
-            ptx.rwset = TxRWSet.from_bytes(results)
-            ptx.namespaces = tuple(sorted(ptx.rwset.ns))
-        except Exception:
-            ptx.code = C.BAD_RWSET
-            return
+        # rwset handling is deferred: the native mvcc_prep pass after
+        # the envelope loop parses all rwsets in one C call (or the
+        # Python fallback parses per tx) — see _parse
         seen_endorsers: set[bytes] = set()
-        base = int(native.endo_start[i])
-        for j in range(base, base + int(native.endo_count[i])):
-            endorser = native.span(native.e_endorser_span, j)
-            if not native.e_ok[j] or endorser is None:
+        e_span, e_ok, e_arrs = ctx["e_span"], ctx["e_ok"], ctx["e_arrs"]
+        deserialize = self.msp.deserialize_identity
+        base = ctx["endo_start"][i]
+        for j in range(base, base + ctx["endo_count"][i]):
+            eo, el = e_span[j]
+            if not e_ok[j] or eo < 0:
                 continue  # unparseable endorsement contributes nothing
+            endorser = blob[eo:eo + el]
             if endorser in seen_endorsers:
                 continue  # dedup by identity (policy.go:360-363)
             try:
-                eident = self.msp.deserialize_identity(endorser)
+                eident = deserialize(endorser)
                 eident.public_numbers  # EC key required
             except Exception:
                 continue
             seen_endorsers.add(endorser)
-            ptx.endo_item_idx.append(items.add_fast(
-                (native.e_digest, native.e_r, native.e_s), j, eident,
-            ))
+            ptx.endo_item_idx.append(items.add_fast(e_arrs, j, eident))
             ptx.endorsements.append((endorser, eident))
 
     # -- the pipeline ------------------------------------------------------
@@ -374,11 +500,11 @@ class BlockValidator:
         import time
 
         t0 = time.perf_counter()
-        txs, items = self._parse(block)
+        txs, items, rwp = self._parse(block)
         t0 = self._t("host_parse", t0)
         fetch = p256.verify_launch(items)
         t0 = self._t("sig_prepare_launch", t0)
-        dpre = self._device_preprocess(txs)
+        dpre = self._device_preprocess(txs, rwp)
         self._t("device_pre", t0)
         # the MSP manager the identities were validated against: a
         # config tx in the PREVIOUS block may rotate membership between
@@ -386,6 +512,39 @@ class BlockValidator:
         return txs, items, fetch, self.msp, dpre
 
     def validate(self, block: common_pb2.Block, pre=None):
+        return self.validate_finish(self.validate_launch(block, pre=pre))
+
+    def validate_launch(
+        self, block: common_pb2.Block, pre=None, overlay=None,
+        extra_txids=None,
+    ):
+        """Run every pre-device-sync step for one block — structural
+        codes, dup checks, committed-version fill, stage-2 dispatch —
+        and return a PendingBlock; ``validate_finish`` syncs the device
+        and produces (filter, batch, history).
+
+        ``overlay``: the UpdateBatch of the PREDECESSOR block whose
+        ledger commit may still be in flight on a committer thread —
+        its writes override committed-version lookups (and range
+        re-execution), so this block launches without waiting for the
+        predecessor's fsync.  ``extra_txids``: txids of in-flight
+        predecessors for the duplicate-txid check (their block-store
+        index insert may not have landed yet).
+
+        Pipelined callers must SERIALIZE around blocks that rotate
+        validation inputs — config blocks (MSP/policy object rotation)
+        and blocks writing the ``_lifecycle`` namespace (state-backed
+        chaincode definitions feed the preprocess-time policy plans):
+        commit such a predecessor fully, then launch with overlay=None.
+        Launching with a lifecycle-writing overlay raises — a stale
+        plan here would fork a pipelined peer from a serial one."""
+        if overlay is not None and any(
+            k[0] == "_lifecycle" for k in overlay.updates
+        ):
+            raise ValueError(
+                "pipelined launch across a lifecycle-writing block: "
+                "commit the predecessor before launching this block"
+            )
         if pre is None:
             pre = self.preprocess(block)
         if pre[3] is not self.msp or (
@@ -400,27 +559,44 @@ class BlockValidator:
         # the commit path is serialized per channel, so this is safe
         self.last_parsed = txs
 
-        # dup txid vs committed ledger (deferred from preprocess)
-        if self.blocks is not None:
+        # dup txid vs committed ledger + in-flight predecessors
+        # (deferred from preprocess)
+        if self.blocks is not None or extra_txids:
             for ptx in txs:
-                if (
-                    ptx.undetermined and not ptx.is_config
-                    and self.blocks.tx_exists(ptx.txid)
+                if ptx.undetermined and not ptx.is_config and (
+                    (extra_txids is not None and ptx.txid in extra_txids)
+                    or (self.blocks is not None
+                        and self.blocks.tx_exists(ptx.txid))
                 ):
                     ptx.code = C.DUPLICATE_TXID
 
+        pending = PendingBlock(
+            block=block, txs=txs, items=items, fetch=fetch, dpre=dpre,
+            overlay=overlay,
+        )
         # fused single-sync device path: policy + MVCC consume the
         # verify output ON DEVICE (one dispatch + one readback per
         # block); falls back to the host path for custom plugins,
         # non-v3 kernels, or consumption-unsafe blocks
         if getattr(fetch, "device_out", None) is not None and txs and dpre:
-            result = self._validate_device(block, txs, items, fetch, dpre)
+            pending.fetch2 = self._launch_device(
+                block, txs, fetch, dpre, overlay
+            )
+        return pending
+
+    def validate_finish(self, pending: "PendingBlock"):
+        """Sync the device stage-2 of a launched block and produce the
+        (filter, batch, history) triple."""
+        if pending.fetch2 is not None:
+            result = self._finish_device(pending)
             if result is not None:
                 return result
+        return self._validate_host(
+            pending.block, pending.txs, pending.items, pending.fetch,
+            overlay=pending.overlay,
+        )
 
-        return self._validate_host(block, txs, items, fetch)
-
-    def _validate_host(self, block, txs, items, fetch):
+    def _validate_host(self, block, txs, items, fetch, overlay=None):
         # phase 1a: one batched ECDSA verify for the whole block
         sig_valid = np.asarray(fetch(), bool) if items else np.zeros(0, bool)
 
@@ -471,7 +647,7 @@ class BlockValidator:
                     ptx.code = C.ENDORSEMENT_POLICY_FAILURE
 
         # phase 2: MVCC over the whole block
-        mvcc_txs, committed = self._mvcc_inputs(txs)
+        mvcc_txs, committed = self._mvcc_inputs(txs, overlay=overlay)
         pre_ok = np.array([ptx.undetermined for ptx in txs], bool)
         if txs:
             valid, conflict, phantom = mvcc_ops.mvcc_validate_block(
@@ -492,12 +668,15 @@ class BlockValidator:
 
     # -- fused single-sync device path ------------------------------------
 
-    def _device_preprocess(self, txs):
+    def _device_preprocess(self, txs, rwp=None):
         """State-INDEPENDENT device-path inputs: policy match matrices
         (vectorized gather over per-identity cached principal rows) and
         static MVCC arrays.  Runs in the prefetch thread, overlapping
         the previous block's device time; returns None when the block
-        needs the host dispatch path (custom plugins)."""
+        needs the host dispatch path (custom plugins).  When the native
+        mvcc_prep covered every undetermined endorser tx (``rwp``),
+        the static arrays come from numpy scatters over its flat
+        output instead of per-read Python loops."""
         from fabric_tpu.ops import mvcc as mvcc_ops
         from fabric_tpu.utils.batching import next_pow2
 
@@ -553,11 +732,37 @@ class BlockValidator:
                         pool_rows.append(default._match_row(plan, ser, ident))
                     idx_mat[e, s] = pi
             match = np.stack(pool_rows)[idx_mat]  # [E, S, P] gather
-            groups.append((plan, match, endo_idx, tx_of))
+            # upload NOW (prefetch thread): launch-time H2D over the
+            # tunnel is latency-bound and sits on the critical path
+            import jax.numpy as jnp
+
+            groups.append((
+                plan, jnp.asarray(match), jnp.asarray(endo_idx),
+                jnp.asarray(tx_of),
+            ))
             group_entries.append(ents)
 
         # static MVCC arrays (committed-version fill deferred to
         # validate time — it needs the predecessor's state commit)
+        flat_ok = rwp is not None and all(
+            (not ptx.undetermined) or ptx.is_config
+            or rwp.status[ptx.idx] == 0
+            for ptx in txs
+        )
+        if flat_ok:
+            ns_names = rwp.ns_names()
+            ukeys = rwp.ukey_strs()
+            composite = [
+                ("pub", ns_names[rwp.ns_of_ukey[u]], ukeys[u])
+                for u in range(rwp.n_keys)
+            ]
+            static = mvcc_ops.prepare_block_from_flat(len(txs), rwp, composite)
+            static.upload()
+            return _DevicePre(
+                groups=groups, group_entries=group_entries, static=static,
+                has_range=False, policies=self.policies,
+                rwp=rwp, ns_names=ns_names, ukeys=ukeys,
+            )
         mvcc_txs = []
         has_range = False
         for ptx in txs:
@@ -573,30 +778,34 @@ class BlockValidator:
                 mvcc_ops.TxRWSet(reads=reads, writes=writes, range_reads=rqs)
             )
         static = mvcc_ops.prepare_block_static(mvcc_txs, bucketed=True)
+        static.upload()
         return _DevicePre(
             groups=groups, group_entries=group_entries, static=static,
             has_range=has_range, policies=self.policies,
         )
 
-    def _validate_device(self, block, txs, items, handle, dpre):
-        """One-dispatch-one-readback validation (device_block): returns
-        (filter, batch, history) or None to fall back."""
+    def _launch_device(self, block, txs, handle, dpre, overlay=None):
+        """Host-side device-path launch: range re-execution, structural
+        arrays, committed-version fill (+ overlay), stage-2 dispatch.
+        Returns the packed-output fetch."""
         import time
 
         from fabric_tpu.peer.device_block import DeviceBlockPipeline
 
         t0 = time.perf_counter()
-        # committed-range phantom re-execution (host state reads)
+        # committed-range phantom re-execution (host state reads, plus
+        # the in-flight predecessor's writes when pipelined)
         if dpre.has_range:
             for ptx in txs:
                 if (
                     ptx.undetermined and not ptx.is_config
                     and ptx.rwset is not None
-                    and self._committed_range_phantom(ptx)
+                    and (self._committed_range_phantom(ptx, overlay)
+                         or (overlay is not None
+                             and _overlay_range_phantom(ptx, overlay)))
                 ):
                     ptx.code = C.PHANTOM_READ_CONFLICT
 
-        T = len(txs)
         t_bucket = int(dpre.static.read_keys.shape[0])
         structural = np.zeros(t_bucket, bool)
         creator_idx = np.full(t_bucket, -1, np.int32)
@@ -605,7 +814,9 @@ class BlockValidator:
                 structural[ptx.idx] = True
                 creator_idx[ptx.idx] = ptx.creator_item_idx
 
-        committed = self._committed_versions(dpre.static.read_key_set)
+        committed = self._committed_versions(
+            dpre.static.read_key_set, overlay=overlay
+        )
         mvcc_arrays = dpre.static.device_args(committed)
         t0 = self._t("state_fill", t0)
 
@@ -615,49 +826,105 @@ class BlockValidator:
             handle, creator_idx, structural, dpre.groups, mvcc_arrays,
             t_bucket,
         )
-        t0 = self._t("stage2_dispatch", t0)
-        group_entries = dpre.group_entries
-        out = fetch2()
+        self._t("stage2_dispatch", t0)
+        return fetch2
+
+    def _finish_device(self, pending: "PendingBlock"):
+        """Consume the stage-2 packed output: final codes, filter,
+        update batch.  Returns None to fall back to the host path
+        (consumption-unsafe policy rows)."""
+        import time
+
+        block, txs = pending.block, pending.txs
+        dpre = pending.dpre
+        t0 = time.perf_counter()
+        out = pending.fetch2()
         t0 = self._t("device_wait", t0)
 
         # consumption-unsafe rows → exact host interpreter path
-        for safe_bits, ents in zip(out["safe"], group_entries):
+        for safe_bits, ents in zip(out["safe"], dpre.group_entries):
             if not np.all(safe_bits[: len(ents)]):
                 return None
 
+        # one pass over txs for the final code assignment (same check
+        # order as the reference: creator sig → config → policy → mvcc)
         sig_valid = out["sig_valid"]
-        for ptx in txs:
-            if ptx.undetermined and ptx.creator_item_idx >= 0:
-                if not (
-                    ptx.creator_item_idx < len(sig_valid)
-                    and sig_valid[ptx.creator_item_idx]
-                ):
-                    ptx.code = C.BAD_CREATOR_SIGNATURE
-        for ptx in txs:
-            if ptx.is_config and ptx.undetermined:
-                ptx.code = self._validate_config(block, ptx)
-        for ptx in txs:
-            if not ptx.undetermined or ptx.is_config:
-                continue
-            if not out["policy_ok"][ptx.idx]:
-                ptx.code = C.ENDORSEMENT_POLICY_FAILURE
+        n_sig = len(sig_valid)
+        policy_ok, valid, phantom = out["policy_ok"], out["valid"], out["phantom"]
         for ptx in txs:
             if not ptx.undetermined:
                 continue
-            if ptx.is_config or out["valid"][ptx.idx]:
+            ci = ptx.creator_item_idx
+            if ci >= 0 and not (ci < n_sig and sig_valid[ci]):
+                ptx.code = C.BAD_CREATOR_SIGNATURE
+                continue
+            if ptx.is_config:
+                ptx.code = self._validate_config(block, ptx)
+                continue
+            i = ptx.idx
+            if not policy_ok[i]:
+                ptx.code = C.ENDORSEMENT_POLICY_FAILURE
+            elif valid[i]:
                 ptx.code = C.VALID
             else:
                 ptx.code = (
-                    C.PHANTOM_READ_CONFLICT
-                    if out["phantom"][ptx.idx]
+                    C.PHANTOM_READ_CONFLICT if phantom[i]
                     else C.MVCC_READ_CONFLICT
                 )
 
         tx_filter = bytes(ptx.code for ptx in txs)
-        batch, history = self._build_updates(block.header.number, txs)
+        if dpre.rwp is not None:
+            batch, history = self._build_updates_flat(
+                block.header.number, txs, dpre.rwp, dpre.ns_names,
+                dpre.ukeys,
+            )
+        else:
+            batch, history = self._build_updates(block.header.number, txs)
+        self._t("postprocess", t0)
         return tx_filter, batch, history
 
-    def _mvcc_inputs(self, txs):
+    def _build_updates_flat(self, block_num: int, txs, rwp, ns_names, ukeys):
+        """Update batch + history from the native flat write arrays —
+        byte-identical output (incl. per-tx (ns, key) sort order) to
+        _build_updates over parsed rwsets.  Key strings come from the
+        already-decoded unique-key table (``ukeys``)."""
+        from fabric_tpu.ledger.statedb import VersionedValue
+
+        batch = UpdateBatch()
+        updates = batch.updates
+        history = []
+        blob = rwp.blob
+        w_uid = rwp.w_uid.tolist()
+        w_is_del = rwp.w_is_del.tolist()
+        w_val_span = rwp.w_val_span[:, 0].tolist(), rwp.w_val_span[:, 1].tolist()
+        ns_of = rwp.ns_of_ukey.tolist()
+        w_start = rwp.w_start.tolist()
+        w_count = rwp.w_count.tolist()
+        vo_l, vl_l = w_val_span
+        for ptx in txs:
+            if ptx.code != C.VALID:
+                continue
+            i = ptx.idx
+            s, c = w_start[i], w_count[i]
+            if not c:
+                continue
+            rows = []
+            for k in range(s, s + c):
+                uid = w_uid[k]
+                if w_is_del[k]:
+                    val = None
+                else:
+                    vo = vo_l[k]
+                    val = blob[vo:vo + vl_l[k]] if vo >= 0 else b""
+                rows.append((ns_names[ns_of[uid]], ukeys[uid], val))
+            rows.sort(key=lambda t: (t[0], t[1]))
+            ver = (block_num, i)
+            for ns, key, val in rows:
+                updates[(ns, key)] = VersionedValue(val, None, ver)
+                history.append((ns, key, i))
+        return batch, history
+
+    def _mvcc_inputs(self, txs, overlay=None):
         mvcc_txs = []
         all_read_keys = set()
         for ptx in txs:
@@ -671,7 +938,9 @@ class BlockValidator:
             # validation/validator.go:205-247, combined_iterator.go:44).
             # Per-result version staleness rides the normal read checks;
             # in-block writers ride the id-interval kernel check.
-            if self._committed_range_phantom(ptx):
+            if self._committed_range_phantom(ptx, overlay) or (
+                overlay is not None and _overlay_range_phantom(ptx, overlay)
+            ):
                 ptx.code = C.PHANTOM_READ_CONFLICT
                 mvcc_txs.append(mvcc_ops.TxRWSet(reads=[], writes=[], range_reads=[]))
                 continue
@@ -680,12 +949,18 @@ class BlockValidator:
                 mvcc_ops.TxRWSet(reads=reads, writes=writes, range_reads=rqs)
             )
             all_read_keys.update(k for k, _ in reads)
-        return mvcc_txs, self._committed_versions(all_read_keys)
+        return mvcc_txs, self._committed_versions(all_read_keys, overlay=overlay)
 
-    def _committed_versions(self, all_read_keys) -> dict:
+    def _committed_versions(self, all_read_keys, overlay=None) -> dict:
         """Bulk-load committed versions for a set of mvcc-form keys
         (the preLoadCommittedVersionOfRSet analog,
-        validation/validator.go:27-78)."""
+        validation/validator.go:27-78).
+
+        ``overlay`` is the predecessor block's UpdateBatch whose ledger
+        commit may still be applying concurrently: its entries OVERRIDE
+        whatever the racy state read returned — per-key reads are
+        atomic and the override is exactly the value the in-flight
+        apply will land, so the result equals a serialized read."""
         committed: dict = {}
         if all_read_keys:
             pub_keys = [
@@ -699,18 +974,40 @@ class BlockValidator:
                     v = self.state.get_version(f"{k[1]}${k[2]}#hashed", _hex(k[3]))
                     if v is not None:
                         committed[k] = v
+            if overlay is not None:
+                for k in all_read_keys:
+                    bk = (
+                        (k[1], k[2]) if k[0] == "pub"
+                        else (f"{k[1]}${k[2]}#hashed", _hex(k[3]))
+                    )
+                    vv = overlay.updates.get(bk)
+                    if vv is None:
+                        continue
+                    if vv.value is None:  # delete
+                        committed.pop(k, None)
+                    else:
+                        committed[k] = vv.version
         return committed
 
-    def _committed_range_phantom(self, ptx) -> bool:
+    def _committed_range_phantom(self, ptx, overlay=None) -> bool:
         """True iff some committed key falls inside a recorded range
         query but is missing from its recorded results (end_key == ''
-        means unbounded, per the reference's open-ended iterators)."""
+        means unbounded, per the reference's open-ended iterators).
+
+        Under pipelining the state walk may still see keys the
+        IN-FLIGHT predecessor deleted — those are subtracted via the
+        overlay (the insert arm is _overlay_range_phantom)."""
         for ns_name, n in ptx.rwset.ns.items():
             for start, end, results in n.range_queries:
                 recorded = {k for k, _ in results}
                 for key, _vv in self.state.get_state_range(ns_name, start, end):
-                    if key not in recorded:
-                        return True
+                    if key in recorded:
+                        continue
+                    if overlay is not None:
+                        ov = overlay.updates.get((ns_name, key))
+                        if ov is not None and ov.value is None:
+                            continue  # predecessor deleted it
+                    return True
         return False
 
     def _validate_config(self, block, ptx) -> int:
@@ -831,6 +1128,22 @@ class DefaultValidation(ValidationPlugin):
                     m = M[t, : len(ptx.endorsements)]
                     out[idx] = bool(pol.evaluate(policy, m))
         return out
+
+
+def _overlay_range_phantom(ptx, overlay) -> bool:
+    """True iff a write of the in-flight predecessor block falls inside
+    one of this tx's recorded range queries but is missing from its
+    recorded results — the overlay arm of the committed-range
+    re-execution (deleted keys ride the per-result read checks)."""
+    for ns_name, n in ptx.rwset.ns.items():
+        for start, end, results in n.range_queries:
+            recorded = {k for k, _ in results}
+            for (ns, key), vv in overlay.updates.items():
+                if ns != ns_name or vv.value is None:
+                    continue
+                if key >= start and (not end or key < end) and key not in recorded:
+                    return True
+    return False
 
 
 def _sig_item(ident: Identity, message: bytes, der_sig: bytes):

@@ -174,6 +174,54 @@ def finalize_block(blk: common_pb2.Block) -> common_pb2.Block:
 
 
 # ---------------------------------------------------------------------------
+# Block attestation (reference: blockwriter addBlockSignature,
+# orderer/common/multichannel/blockwriter.go; verify side
+# common/deliverclient/block_verification.go:243 VerifyBlock)
+
+
+def sign_block(blk: common_pb2.Block, signer) -> None:
+    """Append the orderer's signature to the SIGNATURES metadata.
+
+    Signed bytes = metadata.value ‖ signature_header ‖ header_hash —
+    binding the signature to THIS block's header (and therefore, via
+    data_hash and previous_hash, to its content and chain position).
+    """
+    import os as _os
+
+    idx = common_pb2.BlockMetadataIndex.SIGNATURES
+    md = common_pb2.Metadata()
+    if len(blk.metadata.metadata) > idx and blk.metadata.metadata[idx]:
+        md.ParseFromString(blk.metadata.metadata[idx])
+    sh = common_pb2.SignatureHeader(
+        creator=signer.serialized, nonce=_os.urandom(24)
+    ).SerializeToString()
+    sig = signer.sign(md.value + sh + block_header_hash(blk.header))
+    md.signatures.add(signature_header=sh, signature=sig)
+    while len(blk.metadata.metadata) <= idx:
+        blk.metadata.metadata.append(b"")
+    blk.metadata.metadata[idx] = md.SerializeToString()
+
+
+def block_signed_data(blk: common_pb2.Block) -> list:
+    """SIGNATURES metadata → [(creator_identity_bytes, signed_bytes,
+    signature)] for policy evaluation at deliver time."""
+    idx = common_pb2.BlockMetadataIndex.SIGNATURES
+    if len(blk.metadata.metadata) <= idx or not blk.metadata.metadata[idx]:
+        return []
+    md = common_pb2.Metadata()
+    md.ParseFromString(blk.metadata.metadata[idx])
+    hh = block_header_hash(blk.header)
+    out = []
+    for ms in md.signatures:
+        try:
+            sh = unmarshal(common_pb2.SignatureHeader, ms.signature_header)
+        except Exception:
+            continue
+        out.append((sh.creator, md.value + ms.signature_header + hh, ms.signature))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Transaction extraction (the commit pipeline's parse path)
 
 

@@ -104,13 +104,18 @@ def genesis_config(profile: Profile) -> configtx_pb2.Config:
 
     ordg = root.groups["Orderer"]
     ordg.mod_policy = "Admins"
+    consenters = []
+    for c in profile.raft_consenters:
+        # (host, port) or (host, port, serialized_identity) — BFT
+        # channels need the identity to pin the attestation voter set
+        rc = orderer_pb2.RaftConsenter(host=c[0], port=c[1])
+        if len(c) > 2 and c[2]:
+            rc.identity = c[2]
+        consenters.append(rc)
     ordg.values["ConsensusType"].value = orderer_pb2.ConsensusType(
         type=profile.consensus_type,
         metadata=orderer_pb2.RaftConfigMetadata(
-            consenters=[
-                orderer_pb2.RaftConsenter(host=h, port=p)
-                for h, p in profile.raft_consenters
-            ]
+            consenters=consenters
         ).SerializeToString(),
     ).SerializeToString()
     ordg.values["BatchSize"].value = orderer_pb2.BatchSize(

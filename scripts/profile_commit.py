@@ -17,7 +17,7 @@ def main(n_tx=1000):
     from fabric_tpu.ops import p256
     for rep in range(3):
         t0 = time.perf_counter()
-        txs, items = v._parse(blk)
+        txs, items, _rwp = v._parse(blk)
         t1 = time.perf_counter()
         sig_valid = np.asarray(p256.verify_host(items), bool)
         t2 = time.perf_counter()

@@ -1,0 +1,298 @@
+"""Block attestation: orderers sign assembled blocks
+(blockwriter.go addBlockSignature analog) and peers verify delivered
+blocks against the channel's /Channel/Orderer/BlockValidation policy
+before commit (common/deliverclient/block_verification.go:243) — a
+forged, stripped, or impostor-signed block must never commit."""
+
+import asyncio
+
+import pytest
+
+from fabric_tpu import protoutil as pu
+from fabric_tpu.crypto import cryptogen
+from fabric_tpu.ledger.rwset import TxRWSet
+from fabric_tpu.peer import txassembly as txa
+from fabric_tpu.peer.node import PeerChannel
+from fabric_tpu.protos import transaction_pb2
+from fabric_tpu.tools import configtxgen as cg
+
+C = transaction_pb2.TxValidationCode
+CHANNEL = "attchan"
+CC = "attcc"
+
+
+@pytest.fixture(scope="module")
+def material():
+    org1 = cryptogen.generate_org("Org1MSP", "org1.example.com", peers=1, users=1)
+    oorg = cryptogen.generate_org(
+        "OrdererMSP", "example.com", peers=0, orderers=2, users=0
+    )
+    profile = cg.Profile(
+        CHANNEL,
+        application_orgs=[cg.OrgProfile(org1.msp_id, org1.msp())],
+        orderer_orgs=[cg.OrgProfile(oorg.msp_id, oorg.msp())],
+    )
+    return {
+        "org1": org1,
+        "genesis": cg.genesis_block(profile),
+        "client": cryptogen.signing_identity(org1, "User1@org1.example.com"),
+        "peer": cryptogen.signing_identity(org1, "peer0.org1.example.com"),
+        "orderer": cryptogen.signing_identity(oorg, "orderer0.example.com"),
+        "orderer2": cryptogen.signing_identity(oorg, "orderer1.example.com"),
+    }
+
+
+def _block(material, num, prev, n_tx=1):
+    envs = []
+    for i in range(n_tx):
+        _, _, prop = txa.create_signed_proposal(
+            material["client"], CHANNEL, CC, [b"i"]
+        )
+        tx = TxRWSet()
+        tx.ns_rwset(CC).writes[f"k{num}_{i}"] = b"v"
+        rw = tx.to_proto().SerializeToString()
+        resps = [txa.create_proposal_response(prop, rw, material["peer"], CC)]
+        envs.append(txa.assemble_transaction(prop, resps, material["client"]))
+    blk = pu.new_block(num, prev)
+    for e in envs:
+        blk.data.data.append(e.SerializeToString())
+    return pu.finalize_block(blk)
+
+
+def test_peer_rejects_unsigned_and_forged_blocks(material, tmp_path):
+    ch = PeerChannel(
+        CHANNEL, str(tmp_path / "peer"), genesis_block=material["genesis"]
+    )
+    prev = pu.block_header_hash(ch.ledger.blocks.get_block(0).header)
+
+    # unsigned block → rejected before the commit pipeline runs
+    blk = _block(material, 1, prev)
+    with pytest.raises(ValueError, match="BlockValidation"):
+        asyncio.run(ch.commit_block(blk))
+
+    # signed by a NON-orderer identity (an app-org client) → rejected
+    blk2 = _block(material, 1, prev)
+    pu.sign_block(blk2, material["client"])
+    with pytest.raises(ValueError, match="BlockValidation"):
+        asyncio.run(ch.commit_block(blk2))
+
+    # properly signed by the orderer org's node → commits
+    blk3 = _block(material, 1, prev)
+    pu.sign_block(blk3, material["orderer"])
+    flt = asyncio.run(ch.commit_block(blk3))
+    assert len(flt) == 1
+    assert ch.height == 2
+
+    # a signature from ANOTHER block must not transplant: take block
+    # 3's valid signature metadata onto a different (forged) block
+    prev2 = pu.block_header_hash(ch.ledger.blocks.get_block(1).header)
+    forged = _block(material, 2, prev2, n_tx=2)
+    idx = blk3.metadata.metadata[0]
+    forged.metadata.metadata[0] = idx  # transplanted SIGNATURES entry
+    with pytest.raises(ValueError, match="BlockValidation"):
+        asyncio.run(ch.commit_block(forged))
+
+    # tampering the header after signing invalidates the signature
+    tampered = _block(material, 2, prev2)
+    pu.sign_block(tampered, material["orderer"])
+    tampered.header.previous_hash = b"\x00" * 32
+    with pytest.raises(ValueError, match="BlockValidation"):
+        asyncio.run(ch.commit_block(tampered))
+
+
+def test_ordering_chain_signs_materialized_blocks(material, tmp_path):
+    """The consenter's block assembly signs every cut block; the
+    signature satisfies the channel policy the peers enforce."""
+    from fabric_tpu.channelconfig import Bundle, SignedData
+    from fabric_tpu.ordering.blockcutter import BatchConfig
+    from fabric_tpu.ordering.chain import OrderingChain
+    from fabric_tpu.protos import configtx_pb2, common_pb2
+
+    async def drive():
+        chain = OrderingChain(
+            CHANNEL, "o0", ["o0"], str(tmp_path / "o0"),
+            send_cb=lambda *_: None,
+            config=BatchConfig(max_message_count=1, batch_timeout_s=0.05),
+            genesis_block=material["genesis"],
+            signer=material["orderer"],
+        )
+        chain.start()
+        try:
+            _, _, prop = txa.create_signed_proposal(
+                material["client"], CHANNEL, CC, [b"i"]
+            )
+            env = txa.assemble_transaction(
+                prop,
+                [txa.create_proposal_response(
+                    prop, TxRWSet().to_proto().SerializeToString(),
+                    material["peer"], CC)],
+                material["client"],
+            )
+            for _ in range(200):
+                r = await chain.broadcast(env.SerializeToString())
+                if r["status"] == 200:
+                    break
+                await asyncio.sleep(0.05)
+            assert r["status"] == 200
+            assert chain.height == 2
+            return chain.blocks.get_block(1)
+        finally:
+            chain.stop()
+
+    loop = asyncio.new_event_loop()
+    try:
+        blk = loop.run_until_complete(asyncio.wait_for(drive(), 30))
+    finally:
+        loop.close()
+
+    sets = pu.block_signed_data(blk)
+    assert len(sets) == 1
+    # the signature satisfies the channel's BlockValidation policy
+    env = pu.unmarshal(common_pb2.Envelope, material["genesis"].data.data[0])
+    payload = pu.unmarshal(common_pb2.Payload, env.payload)
+    cfg_env = pu.unmarshal(configtx_pb2.ConfigEnvelope, payload.data)
+    bundle = Bundle(CHANNEL, cfg_env.config)
+    signed = [
+        SignedData(identity=c, data=d, signature=s) for c, d, s in sets
+    ]
+    assert bundle.policy_manager.evaluate(
+        "/Channel/Orderer/BlockValidation", signed
+    )
+
+
+def test_peer_requires_bft_quorum_attestation(material, tmp_path):
+    """On a BFT channel, one orderer signature is not enough: the block
+    must carry 2f+1 signed COMMITs binding (seq, digest-of-batch), by
+    distinct valid orderer identities, with monotone seq."""
+    import hashlib
+    import json
+
+    from fabric_tpu.ordering.bft import _signable
+
+    org1 = material["org1"]
+    oorg = cryptogen.generate_org(
+        "OrdererMSP", "bft.example.com", peers=0, orderers=7, users=0
+    )
+    signers = [
+        cryptogen.signing_identity(oorg, f"orderer{i}.bft.example.com")
+        for i in range(7)
+    ]
+    profile = cg.Profile(
+        CHANNEL,
+        application_orgs=[cg.OrgProfile(org1.msp_id, org1.msp())],
+        orderer_orgs=[cg.OrgProfile(oorg.msp_id, oorg.msp())],
+        consensus_type="bft",
+        # consenter identities pinned: ONLY signers[0..3] may vote
+        raft_consenters=[
+            ("h", i + 1, signers[i].serialized) for i in range(4)
+        ],
+    )
+    ch = PeerChannel(
+        CHANNEL, str(tmp_path / "bftpeer"), genesis_block=cg.genesis_block(profile)
+    )
+    prev = pu.block_header_hash(ch.ledger.blocks.get_block(0).header)
+
+    def mk_signed(num, seq, n_sigs=3, digest=None, with_proof=True,
+                  sign_from=0):
+        blk = _block(material, num, prev)
+        payload = json.dumps(
+            [bytes(e).hex() for e in blk.data.data]
+        ).encode()
+        d = digest or hashlib.sha256(payload).hexdigest()
+        meta = {"term": 0, "index": seq}
+        if with_proof:
+            proof = []
+            for i in range(sign_from, sign_from + n_sigs):
+                m = {"type": "bft_commit", "from": f"o{i}", "view": 0,
+                     "seq": seq, "digest": d}
+                m["sig"] = signers[i].sign(_signable(m)).hex()
+                m["from_cert"] = signers[i].serialized.hex()
+                proof.append(m)
+            meta["bft_proof"] = proof
+        from fabric_tpu.protos import common_pb2 as cpb
+
+        idx = cpb.BlockMetadataIndex.ORDERER
+        while len(blk.metadata.metadata) <= idx:
+            blk.metadata.metadata.append(b"")
+        blk.metadata.metadata[idx] = json.dumps(meta).encode()
+        pu.sign_block(blk, signers[0])
+        return blk
+
+    # signed but NO quorum proof → rejected
+    with pytest.raises(ValueError, match="BFT"):
+        asyncio.run(ch.commit_block(mk_signed(1, 1, with_proof=False)))
+    # only 2 of quorum-3 commits → rejected
+    with pytest.raises(ValueError, match="quorum"):
+        asyncio.run(ch.commit_block(mk_signed(1, 1, n_sigs=2)))
+    # digest not binding THIS block's batch → rejected
+    with pytest.raises(ValueError, match="quorum"):
+        asyncio.run(ch.commit_block(mk_signed(1, 1, digest="ab" * 32)))
+    # valid orderer-ORG identities that are NOT consenters → rejected
+    with pytest.raises(ValueError, match="quorum"):
+        asyncio.run(ch.commit_block(mk_signed(1, 1, sign_from=4)))
+    # proper 2f+1 attestation → commits
+    flt = asyncio.run(ch.commit_block(mk_signed(1, 1)))
+    assert len(flt) == 1
+    assert ch.height == 2
+    # a later block reusing an old (non-advancing) seq → rejected
+    prev = pu.block_header_hash(ch.ledger.blocks.get_block(1).header)
+    with pytest.raises(ValueError, match="advance"):
+        asyncio.run(ch.commit_block(mk_signed(2, 1)))
+    flt = asyncio.run(ch.commit_block(mk_signed(2, 2)))
+    assert ch.height == 3
+
+
+def test_single_identity_cannot_forge_bft_quorum(material, tmp_path):
+    """One compromised orderer identity fabricating 2f+1 COMMITs under
+    distinct invented sender names must NOT satisfy the attestation:
+    votes are deduped by identity, not by the unauthenticated 'from'."""
+    import hashlib
+    import json
+
+    from fabric_tpu.ordering.bft import _signable
+
+    org1 = material["org1"]
+    oorg = cryptogen.generate_org(
+        "OrdererMSP", "forge.example.com", peers=0, orderers=4, users=0
+    )
+    evil = cryptogen.signing_identity(oorg, "orderer0.forge.example.com")
+    profile = cg.Profile(
+        CHANNEL,
+        application_orgs=[cg.OrgProfile(org1.msp_id, org1.msp())],
+        orderer_orgs=[cg.OrgProfile(oorg.msp_id, oorg.msp())],
+        consensus_type="bft",
+        raft_consenters=[("h", 1), ("h", 2), ("h", 3), ("h", 4)],
+    )
+    ch = PeerChannel(
+        CHANNEL, str(tmp_path / "forgepeer"),
+        genesis_block=cg.genesis_block(profile),
+    )
+    prev = pu.block_header_hash(ch.ledger.blocks.get_block(0).header)
+    blk = _block(material, 1, prev)
+    payload = json.dumps([bytes(e).hex() for e in blk.data.data]).encode()
+    d = hashlib.sha256(payload).hexdigest()
+    proof = []
+    for i in range(3):  # distinct names, SAME identity
+        m = {"type": "bft_commit", "from": f"fake{i}", "view": 0,
+             "seq": 1, "digest": d}
+        m["sig"] = evil.sign(_signable(m)).hex()
+        m["from_cert"] = evil.serialized.hex()
+        proof.append(m)
+    # and an app-org member's votes must not count either
+    for i in range(2):
+        m = {"type": "bft_commit", "from": f"app{i}", "view": 0,
+             "seq": 1, "digest": d}
+        m["sig"] = material["client"].sign(_signable(m)).hex()
+        m["from_cert"] = material["client"].serialized.hex()
+        proof.append(m)
+    from fabric_tpu.protos import common_pb2 as cpb
+
+    idx = cpb.BlockMetadataIndex.ORDERER
+    while len(blk.metadata.metadata) <= idx:
+        blk.metadata.metadata.append(b"")
+    blk.metadata.metadata[idx] = json.dumps(
+        {"term": 0, "index": 1, "bft_proof": proof}
+    ).encode()
+    pu.sign_block(blk, evil)
+    with pytest.raises(ValueError, match="quorum"):
+        asyncio.run(ch.commit_block(blk))

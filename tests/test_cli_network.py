@@ -67,10 +67,26 @@ def _wait_port(port, timeout=60):
 def test_cli_network(tmp_path):
     crypto = str(tmp_path / "crypto")
     res = _cli("cryptogen", "--org", "Org1MSP:org1.example.com",
-               "--org", "Org2MSP:org2.example.com", "--output", crypto)
+               "--org", "Org2MSP:org2.example.com",
+               "--org", "OrdererMSP:ord.example.com",
+               "--orderers", "1", "--output", crypto)
     assert res.returncode == 0, res.stderr
     org1 = f"{crypto}/org1.example.com"
     org2 = f"{crypto}/org2.example.com"
+    ordorg = f"{crypto}/ord.example.com"
+
+    # one trusted TLS-CA bundle across the network: every listener
+    # demands a client cert and every dial presents one (mutual TLS)
+    ca_bundle = str(tmp_path / "tls-ca-bundle.pem")
+    with open(ca_bundle, "wb") as bf:
+        for od in (org1, org2, ordorg):
+            with open(f"{od}/tlsca/tlsca-cert.pem", "rb") as cf:
+                bf.write(cf.read())
+
+    def tls_cfg(org_dir, node):
+        tdir = f"{org_dir}/nodes/{node}/tls"
+        return {"cert": f"{tdir}/server.pem", "key": f"{tdir}/key.pem",
+                "ca": ca_bundle}
 
     profile = {
         "channel": CHANNEL,
@@ -78,6 +94,9 @@ def test_cli_network(tmp_path):
             {"msp_id": "Org1MSP", "dir": org1},
             {"msp_id": "Org2MSP", "dir": org2},
         ],
+        # orderer org in the genesis config: peers verify every
+        # delivered block's signature against BlockValidation
+        "orderer_orgs": [{"msp_id": "OrdererMSP", "dir": ordorg}],
         "max_message_count": 1, "batch_timeout_ms": 100,
     }
     prof_path = str(tmp_path / "profile.json")
@@ -96,6 +115,9 @@ def test_cli_network(tmp_path):
         "id": "o0", "data_dir": str(tmp_path / "o0"), "port": ord_port,
         "cluster": {"o0": ["127.0.0.1", ord_port]},
         "max_message_count": 1, "batch_timeout_s": 0.1,
+        "msp_id": "OrdererMSP",
+        "msp_dir": f"{ordorg}/nodes/orderer0.ord.example.com/msp",
+        "tls": tls_cfg(ordorg, "orderer0.ord.example.com"),
         "channels": [{"name": CHANNEL, "genesis": genesis}],
     }
 
@@ -104,6 +126,7 @@ def test_cli_network(tmp_path):
             "id": pid, "data_dir": str(tmp_path / pid), "port": port,
             "msp_id": msp_id,
             "msp_dir": f"{org_dir}/nodes/peer0.{os.path.basename(org_dir)}/msp",
+            "tls": tls_cfg(org_dir, f"peer0.{os.path.basename(org_dir)}"),
             "org_msps": [org1, org2],
             "chaincodes": [{"name": CC, "host": "127.0.0.1", "port": cc_port}],
             "peers": [{"msp_id": other_msp, "host": "127.0.0.1",
@@ -134,6 +157,11 @@ def test_cli_network(tmp_path):
         assert _wait_port(p1_port) and _wait_port(p2_port)
 
         user_msp = f"{org1}/users/User1@org1.example.com/msp"
+        cli_tls = ("--tls-ca", ca_bundle,
+                   "--tls-cert",
+                   f"{org1}/nodes/peer0.org1.example.com/tls/server.pem",
+                   "--tls-key",
+                   f"{org1}/nodes/peer0.org1.example.com/tls/key.pem")
 
         # chaincode lifecycle: approve from EACH org, then commit — the
         # reference's approve/commit flow driven through the gateway
@@ -141,14 +169,14 @@ def test_cli_network(tmp_path):
         for msp_id, org_dir in (("Org1MSP", org1), ("Org2MSP", org2)):
             u = f"{org_dir}/users/User1@{os.path.basename(org_dir)}/msp"
             res = _cli(
-                "invoke", "--port", str(p1_port), "--channel", CHANNEL,
+                *cli_tls, "invoke", "--port", str(p1_port), "--channel", CHANNEL,
                 "--chaincode", "_lifecycle", "--msp-dir", u,
                 "--msp-id", msp_id, "approve", CC, "1", spec, timeout=600,
             )
             assert res.returncode == 0, res.stdout + res.stderr
             assert json.loads(res.stdout.strip().splitlines()[-1])["code"] == 0
         res = _cli(
-            "invoke", "--port", str(p1_port), "--channel", CHANNEL,
+            *cli_tls, "invoke", "--port", str(p1_port), "--channel", CHANNEL,
             "--chaincode", "_lifecycle", "--msp-dir", user_msp,
             "--msp-id", "Org1MSP", "commit", CC, "1", spec, timeout=300,
         )
@@ -158,7 +186,7 @@ def test_cli_network(tmp_path):
         # invoke through the gateway CLI (endorse across BOTH orgs per
         # the committed definition's Endorsement-ref policy)
         res = _cli(
-            "invoke", "--port", str(p1_port), "--channel", CHANNEL,
+            *cli_tls, "invoke", "--port", str(p1_port), "--channel", CHANNEL,
             "--chaincode", CC, "--msp-dir", user_msp, "--msp-id", "Org1MSP",
             "put", "city", "lucerne", timeout=600,
         )
@@ -167,7 +195,7 @@ def test_cli_network(tmp_path):
         assert out["code_name"] == "VALID", out
 
         res = _cli(
-            "query", "--port", str(p2_port), "--channel", CHANNEL,
+            *cli_tls, "query", "--port", str(p2_port), "--channel", CHANNEL,
             "--chaincode", CC, "--msp-dir", user_msp, "--msp-id", "Org1MSP",
             "get", "city", timeout=300,
         )
@@ -175,7 +203,8 @@ def test_cli_network(tmp_path):
         out = json.loads(res.stdout.strip().splitlines()[-1])
         assert out["payload"] == "lucerne", out
 
-        res = _cli("discover", "--port", str(p1_port), "--channel", CHANNEL,
+        res = _cli(*cli_tls, "discover", "--port", str(p1_port),
+                   "--channel", CHANNEL,
                    "--query", "endorsers", "--chaincode", CC)
         desc = json.loads(res.stdout.strip().splitlines()[-1])
         assert desc["status"] == 200

@@ -69,7 +69,19 @@ async def _mknet(tmp_path, n_peers=2):
         rt.register(CC, KVContract())
         node = PeerNode(f"p{i}", str(tmp_path / f"p{i}"), mgr, signers[i], rt)
         await node.start()
-        prov = PolicyProvider({CC: NamespaceInfo(policy=policy)})
+        # collA spans both orgs; collPriv is Org1-only (the eligibility
+        # filter under test); undefined collections disseminate nowhere
+        prov = PolicyProvider({CC: NamespaceInfo(policy=policy, collections={
+            "collA": {"member_orgs": ["Org1MSP", "Org2MSP"],
+                      "required_peer_count": 1, "max_peer_count": 0,
+                      "btl": 0},
+            "collB": {"member_orgs": ["Org1MSP", "Org2MSP"],
+                      "required_peer_count": 0, "max_peer_count": 0,
+                      "btl": 0},
+            "collPriv": {"member_orgs": ["Org1MSP"],
+                         "required_peer_count": 0, "max_peer_count": 0,
+                         "btl": 0},
+        })})
         ch = node.join_channel(CHANNEL, prov)
         peers.append(node)
     for i, node in enumerate(peers):
@@ -248,6 +260,184 @@ def test_anti_entropy_catchup(tmp_path):
             me = ("127.0.0.1", p0.port)
             others = [PeerInfo("Org1MSP", "127.0.0.1", p1.port, height=3)]
             assert gs.elect_leader(others, me) == (me < ("127.0.0.1", p1.port))
+        finally:
+            for p in peers:
+                await p.stop()
+            await orderer.stop()
+
+    run(scenario())
+
+
+def test_non_member_org_never_holds_cleartext(tmp_path):
+    """collPriv is Org1-only: endorsement-time distribution must skip
+    Org2's peer, a push targeting it must be refused, and a pull by an
+    Org2 identity must be denied — collection confidentiality
+    (distributor.go AccessFilter; ADVICE r3 high)."""
+    async def scenario():
+        orderer, peers, client = await _mknet(tmp_path)
+        p0, p1 = peers  # p0 = Org1 peer, p1 = Org2 peer
+        try:
+            p0.channels[CHANNEL].start_deliver([("127.0.0.1", orderer.port)])
+            p1.channels[CHANNEL].start_deliver([("127.0.0.1", orderer.port)])
+            p0.channels[CHANNEL].validator.warmup()
+
+            from fabric_tpu.comm.rpc import RpcClient
+            from fabric_tpu.protos import proposal_pb2
+
+            signed, tx_id, prop = txa.create_signed_proposal(
+                client, CHANNEL, CC,
+                [b"put_private", b"collPriv", b"top-secret"],
+                transient={"value": b"classified"},
+            )
+            cli = RpcClient("127.0.0.1", p0.port)
+            await cli.connect()
+            raw = await cli.unary("Endorse", signed.SerializeToString())
+            pr = proposal_pb2.ProposalResponse()
+            pr.ParseFromString(raw)
+            assert pr.response.status == 200
+
+            # p0 (member) holds the cleartext; p1 (non-member) must not
+            assert p0.channels[CHANNEL].transient.get(tx_id)
+            await asyncio.sleep(0.5)  # give any (wrong) push time to land
+            assert not p1.channels[CHANNEL].transient.get(tx_id)
+
+            # a direct PvtPush of collPriv data at p1 is refused
+            import json as _json
+
+            push = _json.dumps({
+                "channel": CHANNEL, "txid": tx_id, "height": 0,
+                "data": {f"{CC}\x00collPriv": {"top-secret": b"x".hex()}},
+            }).encode()
+            cli1 = RpcClient("127.0.0.1", p1.port)
+            await cli1.connect()
+            res = _json.loads(await cli1.unary("PvtPush", push))
+            assert res["status"] == 403
+            assert not p1.channels[CHANNEL].transient.get(tx_id)
+
+            # commit the tx; p0 gets the pvt state, p1 records missing
+            # and CANNOT reconcile it (its pulls are denied by org)
+            env = txa.assemble_transaction(prop, [pr], client)
+            bc = BroadcastClient([("127.0.0.1", orderer.port)])
+            assert (await bc.broadcast(
+                CHANNEL, env.SerializeToString()))["status"] == 200
+            await bc.close()
+            assert await _wait(
+                lambda: p0.channels[CHANNEL].height >= 1
+                and p1.channels[CHANNEL].height >= 1, 20)
+            vv = p0.channels[CHANNEL].ledger.state.get_state(
+                f"{CC}$collPriv", "top-secret")
+            assert vv is not None and vv.value == b"classified"
+            assert p1.channels[CHANNEL].ledger.state.get_state(
+                f"{CC}$collPriv", "top-secret") is None
+
+            # p1's signed pull is refused by p0 (org not a member)
+            pull = p1.gossip_service.pull_pvt_for(CHANNEL)
+            got = await pull(tx_id, 0, 0, CC, "collPriv")
+            assert got is None
+        finally:
+            for p in peers:
+                await p.stop()
+            await orderer.stop()
+
+    run(scenario())
+
+
+def test_btl_expiry_purges_state_and_store(tmp_path):
+    """block_to_live: pvt data (store rows + cleartext state + hashed
+    state) is purged once its BTL elapses (pvtstatepurgemgmt +
+    pvtdatastorage expiry)."""
+    import hashlib
+
+    async def scenario():
+        orderer, peers, client = await _mknet(tmp_path)
+        p0, p1 = peers
+        try:
+            # tighten collA to btl=1: data expires 1 block after commit
+            for p in peers:
+                prov = p.channels[CHANNEL].validator.policies
+                prov.infos[CC].collections["collA"]["btl"] = 1
+            p0.channels[CHANNEL].start_deliver([("127.0.0.1", orderer.port)])
+            p0.channels[CHANNEL].validator.warmup()
+
+            from fabric_tpu.comm.rpc import RpcClient
+            from fabric_tpu.protos import proposal_pb2
+
+            signed, tx_id, prop = txa.create_signed_proposal(
+                client, CHANNEL, CC, [b"put_private", b"collA", b"ttl-key"],
+                transient={"value": b"ephemeral"},
+            )
+            cli = RpcClient("127.0.0.1", p0.port)
+            await cli.connect()
+            raw = await cli.unary("Endorse", signed.SerializeToString())
+            pr = proposal_pb2.ProposalResponse()
+            pr.ParseFromString(raw)
+            assert pr.response.status == 200
+            env = txa.assemble_transaction(prop, [pr], client)
+            bc = BroadcastClient([("127.0.0.1", orderer.port)])
+            assert (await bc.broadcast(
+                CHANNEL, env.SerializeToString()))["status"] == 200
+
+            ch0 = p0.channels[CHANNEL]
+            assert await _wait(lambda: ch0.height >= 1, 20)
+            blk_n = ch0.height - 1
+            assert ch0.ledger.state.get_state(
+                f"{CC}$collA", "ttl-key") is not None
+            assert ch0.ledger.pvtdata.get_pvt_data(blk_n)
+
+            # drive 3 more (public) blocks past the BTL horizon
+            # (expiringBlk = committingBlk + btl + 1: data committed at
+            # block 1 with btl=1 expires when block 3 commits)
+            for i in range(3):
+                s2, t2, prop2 = txa.create_signed_proposal(
+                    client, CHANNEL, CC, [b"put", f"pub{i}".encode(), b"v"]
+                )
+                cli2 = RpcClient("127.0.0.1", p0.port)
+                await cli2.connect()
+                raw2 = await cli2.unary("Endorse", s2.SerializeToString())
+                await cli2.close()
+                pr2 = proposal_pb2.ProposalResponse()
+                pr2.ParseFromString(raw2)
+                assert pr2.response.status == 200, pr2.response.message
+                env2 = txa.assemble_transaction(prop2, [pr2], client)
+                assert (await bc.broadcast(
+                    CHANNEL, env2.SerializeToString()))["status"] == 200
+            await bc.close()
+            assert await _wait(lambda: ch0.height >= 4, 20)
+
+            # expired: store row gone, cleartext state gone, hash gone
+            assert not ch0.ledger.pvtdata.get_pvt_data(blk_n)
+            assert ch0.ledger.state.get_state(
+                f"{CC}$collA", "ttl-key") is None
+            kh = hashlib.sha256(b"ttl-key").hexdigest()
+            assert ch0.ledger.state.get_state(
+                f"{CC}$collA#hashed", kh) is None
+        finally:
+            for p in peers:
+                await p.stop()
+            await orderer.stop()
+
+    run(scenario())
+
+
+def test_dead_peer_excluded_from_election(tmp_path):
+    """A peer whose probe failed must not win the org-leader election
+    (liveness, gossip/discovery alive/dead expiration; ADVICE r3)."""
+    async def scenario():
+        orderer, peers, client = await _mknet(tmp_path)
+        p0, p1 = peers
+        try:
+            gs = p0.gossip_service
+            # register a bogus (dead) lowest-endpoint peer in p0's org
+            dead = PeerInfo("Org1MSP", "127.0.0.1", 1)
+            p0.registry.add(dead)
+            me = ("127.0.0.1", p0.port)
+            org_peers = p0.registry.peers.get("Org1MSP", [])
+            # before any probe the dead peer still counts (alive=None)
+            assert not gs.elect_leader(org_peers, me)
+            await gs.probe_members()
+            assert dead.alive is False
+            # after the failed probe it is excluded → we win
+            assert gs.elect_leader(org_peers, me)
         finally:
             for p in peers:
                 await p.stop()

@@ -197,7 +197,9 @@ def test_pvtdata_store_roundtrip_and_expiry(tmp_path):
         pvt_data={(0, "ns", "collA"): (b"pvt-rwset", 5)},
     )
     assert led.pvtdata.get_pvt_data(0) == {(0, "ns", "collA"): b"pvt-rwset"}
-    assert led.pvtdata.purge_expired(4) == 0
-    assert led.pvtdata.purge_expired(5) == 1
+    assert led.pvtdata.purge_expired(4) == []
+    purged = led.pvtdata.purge_expired(5)
+    assert [r[:4] for r in purged] == [(0, 0, "ns", "collA")]
+    assert purged[0][4] == b"pvt-rwset"
     assert led.pvtdata.get_pvt_data(0) == {}
     led.close()

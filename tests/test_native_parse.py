@@ -191,3 +191,22 @@ def test_native_adversarial_lengths(net):
     for i in range(len(cases)):
         assert out.ok[i] == 0
     assert out.ok[len(cases)] == 1  # sane envelope still parses
+
+
+def test_native_sha256_length_boundaries():
+    """The native SHA-256 (SHA-NI fast path where available) must match
+    hashlib across every padding boundary and multi-block length."""
+    import ctypes
+    import os
+
+    import fabric_tpu.native as nat
+
+    lib = nat.blockparse_lib()
+    if lib is None:
+        pytest.skip("no native toolchain")
+    out = (ctypes.c_uint8 * 32)()
+    for n in [0, 1, 3, 54, 55, 56, 57, 63, 64, 65, 118, 119, 120, 121,
+              127, 128, 129, 1000, 4096]:
+        data = os.urandom(n)
+        lib.sha256_test(ctypes.c_char_p(data), ctypes.c_int64(n), out)
+        assert bytes(out) == hashlib.sha256(data).digest(), n
