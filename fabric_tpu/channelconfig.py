@@ -189,7 +189,12 @@ class Bundle:
         def walk(g: configtx_pb2.ConfigGroup):
             if "MSP" in g.values:
                 cfg = protoutil.unmarshal(configtx_pb2.MSPConfig, g.values["MSP"].value)
-                mgr.add(MSP.from_proto(cfg))
+                if cfg.type == 1:  # IDEMIX (msp/idemix.go)
+                    from fabric_tpu.crypto.idemix import IdemixMSP
+
+                    mgr.add(IdemixMSP.from_config(cfg.config))
+                else:
+                    mgr.add(MSP.from_proto(cfg))
             for child in g.groups.values():
                 walk(child)
         walk(root)

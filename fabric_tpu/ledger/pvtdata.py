@@ -42,6 +42,12 @@ class PvtDataStore:
             " block INTEGER, txnum INTEGER, ns TEXT, coll TEXT, eligible INTEGER,"
             " PRIMARY KEY (block, txnum, ns, coll))"
         )
+        # purge_expired runs on EVERY commit: without this partial
+        # index it would table-scan rows that mostly have expiry=0
+        self._conn.execute(
+            "CREATE INDEX IF NOT EXISTS pvt_expiry ON pvt(expiry)"
+            " WHERE expiry > 0"
+        )
 
     def commit_block(self, block_num: int, data: dict, missing: list | None = None):
         """data: {(txnum, ns, coll): (rwset_bytes, expiry_block)} —

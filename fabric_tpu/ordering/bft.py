@@ -354,6 +354,10 @@ class BFTNode:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(proof, f)
+            f.flush()
+            os.fsync(f.fileno())  # the WAL append that follows is
+            # fsynced; the proof must be durable FIRST or a crash
+            # window leaves a replayed block permanently unattestable
         os.replace(tmp, path)
         # prune far-stale proof files (blocks are materialized at
         # apply time, so anything this old is long since embedded)
@@ -448,7 +452,10 @@ class BFTNode:
                 continue
             if m.get("from") in senders:
                 continue
-            if m.get("from") == self.id or self._verify(m):
+            # NO self bypass: a fabricated unsigned PREPARE claiming to
+            # be "ours" must not strengthen a certificate (_verify
+            # checks self-attributed messages against our own identity)
+            if self._verify(m):
                 senders.add(m.get("from"))
         return len(senders) >= self.quorum
 
@@ -492,13 +499,21 @@ class BFTNode:
         EVERYTHING here derives from the view-change set itself — never
         from this node's own last_applied — so the leader and every
         replica verifying the NEW_VIEW compute the SAME (base, repro)
-        mapping even when their application states diverge.  A node
+        mapping even when their application states diverge.  The base
+        is the (f+1)-th LARGEST claimed last_applied: at least one
+        honest node vouches for it (a single byzantine consenter
+        inflating its claim cannot move it), and sequential commitment
+        makes every honestly-committed entry above it a certified
+        prefix that re-lands on its original sequence numbers.  A node
         whose last_applied lags base has a gap it can only close by
-        catch-up (see the raft follower-chain work); a byzantine node
-        inflating its claimed last_applied can stall liveness (the next
-        timeout re-elects) but never safety."""
+        catch-up (see the raft follower-chain work)."""
         vcs = list(vcs)
-        L = max((int(vc.get("last_applied", 0)) for vc in vcs), default=0)
+        claims = sorted(
+            (int(vc.get("last_applied", 0)) for vc in vcs), reverse=True
+        )
+        L = claims[self.f] if len(claims) > self.f else (
+            claims[-1] if claims else 0
+        )
         repro: dict[int, tuple[int, bytes]] = {}
         for vc in vcs:
             for seq_s, info in vc.get("prepared", {}).items():

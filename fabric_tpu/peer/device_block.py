@@ -97,8 +97,17 @@ def build_stage2(t_bucket: int, n_sig: int, group_sigs: tuple):
         g = len(group_sigs)
         groups = rest[: 3 * g]
         mvcc_arrays = rest[3 * g :]
-        svF = jnp.concatenate([sig_valid, jnp.zeros((1,), bool)])
-        creator_ok = svF[jnp.where(creator_idx >= 0, creator_idx, sig_valid.shape[0])]
+        # two sentinel lanes past the batch: n_sig = missing creator
+        # (False), n_sig+1 = HOST-verified creator (True — idemix
+        # identities have no batch lane; validator encodes them as -2)
+        svF = jnp.concatenate([
+            sig_valid, jnp.zeros((1,), bool), jnp.ones((1,), bool),
+        ])
+        ns = sig_valid.shape[0]
+        creator_ok = svF[jnp.where(
+            creator_idx >= 0, creator_idx,
+            jnp.where(creator_idx == -2, ns + 1, ns),
+        )]
 
         policy_ok = jnp.ones(t_bucket + 1, jnp.int8)
         safes = []

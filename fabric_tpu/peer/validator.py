@@ -107,6 +107,10 @@ class ParsedTx:
     is_config: bool = False
     rwset_bytes: bytes | None = None  # lazy wire form (native fast path)
     _rwset: object = None
+    # creator verified HOST-side (idemix/anonymous creators carry no EC
+    # key for the batch lane): creator_item_idx stays -1 and the device
+    # path maps the tx to the always-true sentinel signature lane
+    host_creator_ok: bool = False
 
     @property
     def undetermined(self) -> bool:
@@ -247,8 +251,10 @@ class BlockValidator:
         fast_ctx = self._fast_ctx(native) if native is not None else None
         for i, env_bytes in enumerate(block.data.data):
             if fast_ctx is not None and fast_ctx["ok"][i]:
-                self._parse_fast(i, fast_ctx, txs, items, seen_txids)
-                continue
+                if self._parse_fast(i, fast_ctx, txs, items, seen_txids):
+                    continue
+                # fast path bowed out (e.g. an idemix creator whose
+                # proof is not a DER signature): python path below
             ptx = ParsedTx(idx=i)
             txs.append(ptx)
             if not env_bytes:
@@ -315,12 +321,26 @@ class BlockValidator:
             if not ident.is_valid:
                 ptx.code = C.BAD_CREATOR_SIGNATURE
                 continue
+            item = None
             try:
                 item = _sig_item(ident, env.payload, env.signature)
             except Exception:
-                ptx.code = C.BAD_CREATOR_SIGNATURE
-                continue
-            ptx.creator_item_idx = items.add_slow(item)
+                # identities without an EC public key (idemix anonymous
+                # creators, msp/idemix.go) verify HOST-side: each
+                # signature is a zero-knowledge presentation proof the
+                # batch kernel has no lane for
+                host_ok = False
+                if ident.is_valid and not hasattr(ident, "cert"):
+                    try:
+                        host_ok = ident.verify(env.payload, env.signature)
+                    except Exception:
+                        host_ok = False
+                if not host_ok:
+                    ptx.code = C.BAD_CREATOR_SIGNATURE
+                    continue
+                ptx.host_creator_ok = True
+            if item is not None:
+                ptx.creator_item_idx = items.add_slow(item)
 
             # endorsements + rwset
             try:
@@ -424,9 +444,12 @@ class BlockValidator:
             "e_arrs": (native.e_digest, native.e_r, native.e_s),
         }
 
-    def _parse_fast(self, i: int, ctx, txs, items, seen_txids) -> None:
+    def _parse_fast(self, i: int, ctx, txs, items, seen_txids) -> bool:
         """Native-pre-parsed endorser tx → ParsedTx + signature items;
-        check order mirrors the Python path exactly."""
+        check order mirrors the Python path exactly.  Returns False
+        (after unwinding its partial state) when the envelope needs the
+        Python path after all — anonymous-credential creators have no
+        DER signature for the native splitter."""
         ptx = ParsedTx(idx=i)
         txs.append(ptx)
         blob = ctx["blob"]
@@ -444,21 +467,31 @@ class BlockValidator:
         # txid binding: tx_id == sha256(nonce ‖ creator) hex
         if not ptx.txid or ptx.txid != ctx["txid_digest"][i]:
             ptx.code = C.BAD_PROPOSAL_TXID
-            return
+            return True
         if ptx.txid in seen_txids:
             ptx.code = C.DUPLICATE_TXID
-            return
+            return True
         seen_txids[ptx.txid] = i
 
         try:
             ident = self.msp.deserialize_identity(creator)
-            ident.public_numbers  # EC key required (raises otherwise)
         except Exception:
             ptx.code = C.BAD_CREATOR_SIGNATURE
-            return
+            return True
+        try:
+            ident.public_numbers  # EC key required for the batch lane
+        except Exception:
+            if ident.is_valid and not hasattr(ident, "cert"):
+                # idemix creator: unwind and let the Python path do the
+                # host-side proof verification
+                txs.pop()
+                del seen_txids[ptx.txid]
+                return False
+            ptx.code = C.BAD_CREATOR_SIGNATURE
+            return True
         if not ident.is_valid or not ctx["creator_sig_ok"][i]:
             ptx.code = C.BAD_CREATOR_SIGNATURE
-            return
+            return True
         ptx.creator_item_idx = items.add_fast(ctx["c_arrs"], i, ident)
 
         # rwset handling is deferred: the native mvcc_prep pass after
@@ -483,6 +516,7 @@ class BlockValidator:
             seen_endorsers.add(endorser)
             ptx.endo_item_idx.append(items.add_fast(e_arrs, j, eident))
             ptx.endorsements.append((endorser, eident))
+        return True
 
     # -- the pipeline ------------------------------------------------------
 
@@ -812,7 +846,9 @@ class BlockValidator:
         for ptx in txs:
             if ptx.undetermined and not ptx.is_config:
                 structural[ptx.idx] = True
-                creator_idx[ptx.idx] = ptx.creator_item_idx
+                creator_idx[ptx.idx] = (
+                    -2 if ptx.host_creator_ok else ptx.creator_item_idx
+                )  # -2 = host-verified (idemix) → always-true lane
 
         committed = self._committed_versions(
             dpre.static.read_key_set, overlay=overlay
