@@ -120,11 +120,17 @@ def _bench_sha256():
     }
 
 
-def _build_commit_network(n_tx: int, n_blocks: int = 1):
+def _build_commit_network(n_tx: int, n_blocks: int = 1,
+                          invalid_frac: float = 0.0):
     """3 orgs, 2-of-3 endorsement policy, a STREAM of ``n_blocks``
     blocks of n_tx signed txs each, reading seeded keys and writing
     fresh ones — the BASELINE.json config-#2 workload (1000-tx blocks
-    through the validator, 2-of-3 ECDSA-P256)."""
+    through the validator, 2-of-3 ECDSA-P256).
+
+    ``invalid_frac``: fraction of txs made invalid (half broken
+    creator signatures, half stale reads) — the commit path pays for
+    failures too, and the perf number must survive adversarial
+    traffic."""
     from fabric_tpu import protoutil as pu
     from fabric_tpu.crypto import cryptogen, policy as pol
     from fabric_tpu.crypto.msp import MSPManager
@@ -157,6 +163,10 @@ def _build_commit_network(n_tx: int, n_blocks: int = 1):
             seed.put(CC, f"seed{b}_{i:05d}", b"genesis", (1, 0))
             seed.put(CC, f"ro{b}_{i:05d}", b"genesis", (1, 0))
 
+    import math
+
+    stride = math.inf if invalid_frac <= 0 else max(2, round(1 / invalid_frac))
+    n_invalid_per_block = 0 if stride == math.inf else len(range(0, n_tx, int(stride)))
     blocks, prev = [], b""
     for b in range(n_blocks):
         envs = []
@@ -164,14 +174,24 @@ def _build_commit_network(n_tx: int, n_blocks: int = 1):
             _, _, prop = txa.create_signed_proposal(client, CHANNEL, CC, [b"invoke"])
             tx = TxRWSet()
             ns = tx.ns_rwset(CC)
-            ns.reads[f"seed{b}_{i:05d}"] = (1, 0)
+            bad = stride != math.inf and i % int(stride) == 0
+            # alternate the failure mode by slot (i is a stride
+            # multiple, so parity of i itself would never alternate)
+            bad_stale = bad and (i // int(stride)) % 2 == 1
+            if bad_stale:
+                ns.reads[f"seed{b}_{i:05d}"] = (9, 9)  # stale → conflict
+            else:
+                ns.reads[f"seed{b}_{i:05d}"] = (1, 0)
             ns.reads[f"ro{b}_{i:05d}"] = (1, 0)  # never written in-block
             ns.writes[f"w{b}_{i:05d}"] = b"value-%d" % i
             ns.writes[f"seed{b}_{i:05d}"] = b"updated"
             rw = tx.to_proto().SerializeToString()
             two = (peers[i % 3], peers[(i + 1) % 3])  # rotating 2-of-3
             resps = [txa.create_proposal_response(prop, rw, e, CC) for e in two]
-            envs.append(txa.assemble_transaction(prop, resps, client))
+            env = txa.assemble_transaction(prop, resps, client)
+            if bad and not bad_stale:
+                env.signature = env.signature[:-4] + bytes(4)  # bad creator
+            envs.append(env)
         blk = pu.new_block(b, prev)
         for env in envs:
             blk.data.data.append(env.SerializeToString())
@@ -187,7 +207,7 @@ def _build_commit_network(n_tx: int, n_blocks: int = 1):
     def fresh_validator(state):
         return BlockValidator(mgr, prov, state)
 
-    return blocks, fresh_state, fresh_validator, mgr, prov, CC
+    return blocks, fresh_state, fresh_validator, mgr, prov, CC, n_invalid_per_block
 
 
 def _serial_baseline_validate(blk, mgr, prov, state):
@@ -267,7 +287,8 @@ def _serial_baseline_validate(blk, mgr, prov, state):
     return bytes(codes), updates
 
 
-def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5):
+def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5,
+                        invalid_frac: float = 0.0):
     """North-star metric (BASELINE.json): sustained validated tx/s per
     peer on a stream of 1000-tx blocks with a 2-of-3 ECDSA-P256
     endorsement policy, through BlockValidator + KVLedger.commit_block,
@@ -283,9 +304,11 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5):
     from fabric_tpu.ledger.kvledger import KVLedger
     from fabric_tpu.protos import common_pb2
 
-    blocks, fresh_state, fresh_validator, mgr, prov, _ = _build_commit_network(
-        n_tx, n_blocks
+    (blocks, fresh_state, fresh_validator, mgr, prov, _,
+     n_invalid) = _build_commit_network(
+        n_tx, n_blocks, invalid_frac=invalid_frac
     )
+    expected_valid = (n_tx - n_invalid) * n_blocks
 
     def copy_blocks():
         out = []
@@ -374,7 +397,9 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5):
         runs.append((dt, nv, tm))
     tpu_s = min(dt for dt, _, _ in runs)
     total = n_tx * n_blocks
-    assert runs[0][1] == total, f"expected all {total} valid, got {runs[0][1]}"
+    assert runs[0][1] == expected_valid, (
+        f"expected {expected_valid} valid, got {runs[0][1]}"
+    )
 
     # per-phase breakdown artifact (ms/block of the fastest run) so the
     # next bottleneck is measured, not guessed
@@ -421,12 +446,12 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5):
 
     cpu_runs = [run_cpu() for _ in range(2)]
     cpu_s = min(dt for dt, _ in cpu_runs)
-    assert cpu_runs[0][1] == total
+    assert cpu_runs[0][1] == expected_valid
 
     tpu_rate = total / tpu_s
     cpu_rate = total / cpu_s
     return {
-        "metric": f"validated_tx_per_sec_block{n_tx}",
+        "metric": f"validated_tx_per_sec_block{n_tx}" + ("_mixed" if invalid_frac else ""),
         "value": round(tpu_rate, 1),
         "unit": "tx/s",
         "vs_baseline": round(tpu_rate / cpu_rate, 3),
@@ -435,6 +460,10 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5):
 
 _BENCHES = {
     "block_commit": _bench_block_commit,
+    # adversarial-traffic variant: ~10% invalid lanes (bad creator
+    # sigs + stale reads) — the throughput number must survive
+    # failure-bearing blocks, not just happy-path streams
+    "block_commit_mixed": lambda: _bench_block_commit(invalid_frac=0.1),
     "p256_verify": _bench_p256_verify,
     "sha256": _bench_sha256,
 }

@@ -355,6 +355,25 @@ def main(argv=None):
                    choices=["peers", "config", "endorsers"])
     c.add_argument("--chaincode")
 
+    c = sub.add_parser("configtxlator",
+                       help="config proto<->JSON + update deltas")
+    c.add_argument("action",
+                   choices=["proto_decode", "proto_encode", "compute_update"])
+    c.add_argument("--type", help="message type, e.g. common.Config")
+    c.add_argument("--input", help="input file (proto or JSON)")
+    c.add_argument("--original", help="compute_update: original config pb")
+    c.add_argument("--updated", help="compute_update: updated config pb")
+    c.add_argument("--channel", help="compute_update: channel id")
+    c.add_argument("--output", help="output file (default stdout)")
+
+    c = sub.add_parser("node",
+                       help="offline channel ops on a STOPPED peer")
+    c.add_argument("action",
+                   choices=["reset", "rollback", "unjoin", "rebuild-dbs"])
+    c.add_argument("--channel-dir", required=True)
+    c.add_argument("--block-number", type=int,
+                   help="rollback: last block to keep")
+
     args = p.parse_args(argv)
     if args.cmd == "cryptogen":
         _cmd_cryptogen(args)
@@ -383,6 +402,53 @@ def main(argv=None):
         _cmd_snapshot(args)
     elif args.cmd == "discover":
         _cmd_discover(args)
+    elif args.cmd == "configtxlator":
+        _cmd_configtxlator(args)
+    elif args.cmd == "node":
+        _cmd_nodeops(args)
+
+
+def _cmd_configtxlator(args):
+    from fabric_tpu.tools import configtxlator as ctl
+
+    def out(data: bytes):
+        if args.output:
+            with open(args.output, "wb") as f:
+                f.write(data)
+        else:
+            sys.stdout.buffer.write(data)
+            if not data.endswith(b"\n"):
+                sys.stdout.buffer.write(b"\n")
+
+    if args.action == "proto_decode":
+        with open(args.input, "rb") as f:
+            out(ctl.proto_decode(args.type, f.read()).encode())
+    elif args.action == "proto_encode":
+        with open(args.input, "rb") as f:
+            out(ctl.proto_encode(args.type, f.read().decode()))
+    else:  # compute_update
+        with open(args.original, "rb") as f:
+            original = f.read()
+        with open(args.updated, "rb") as f:
+            updated = f.read()
+        out(ctl.compute_update(args.channel, original, updated))
+
+
+def _cmd_nodeops(args):
+    from fabric_tpu.tools import nodeops
+
+    if args.action == "reset":
+        res = nodeops.reset(args.channel_dir)
+    elif args.action == "rebuild-dbs":
+        res = nodeops.rebuild_dbs(args.channel_dir)
+    elif args.action == "unjoin":
+        res = nodeops.unjoin(args.channel_dir)
+    else:  # rollback
+        if args.block_number is None:
+            print("rollback requires --block-number", file=sys.stderr)
+            sys.exit(2)
+        res = nodeops.rollback(args.channel_dir, args.block_number)
+    print(json.dumps(res))
 
 
 if __name__ == "__main__":

@@ -83,7 +83,18 @@ class OperationsServer:
             n = int(headers.get("content-length", "0") or "0")
             if n:
                 body = await reader.readexactly(n)
-            status, ctype, payload = self._route(method, path, body)
+            routed = self._route(method, path, body)
+            if callable(routed):  # async route (live profiling window)
+                try:
+                    text = await routed()
+                    status, ctype, payload = 200, "text/plain", text.encode()
+                except Exception as e:
+                    status, ctype, payload = (
+                        500, "application/json",
+                        json.dumps({"error": str(e)}).encode(),
+                    )
+            else:
+                status, ctype, payload = routed
             writer.write(
                 b"HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
                 b"Content-Length: %d\r\nConnection: close\r\n\r\n"
@@ -129,6 +140,62 @@ class OperationsServer:
                     return 400, "application/json", json.dumps(
                         {"error": str(e)}
                     ).encode()
+        if path.startswith("/debug/"):
+            return self._route_debug(path)
+        return 404, "application/json", b'{"error": "not found"}'
+
+    def _route_debug(self, path: str):
+        """Live profiling surface (the reference's peer.profile pprof
+        server, internal/peer/node/start.go:861-876, translated to the
+        Python runtime): /debug/stacks dumps every thread's stack;
+        /debug/profile?seconds=N runs cProfile over the live process
+        and returns the cumulative-time report."""
+        import sys
+        import traceback
+        from urllib.parse import parse_qs, urlparse
+
+        parsed = urlparse(path)
+        if parsed.path == "/debug/stacks":
+            import threading
+
+            names = {t.ident: t.name for t in threading.enumerate()}
+            out = []
+            for tid, frame in sys._current_frames().items():
+                out.append(f"--- thread {names.get(tid, tid)} ({tid}) ---")
+                out.extend(
+                    line.rstrip()
+                    for line in traceback.format_stack(frame)
+                )
+            return 200, "text/plain", "\n".join(out).encode()
+        if parsed.path == "/debug/profile":
+            # NOTE: blocks THIS request for the sampling window; other
+            # connections keep being served (per-connection tasks)
+            import cProfile
+            import io
+            import pstats
+            import time as _time
+
+            try:
+                seconds = float(
+                    parse_qs(parsed.query).get("seconds", ["5"])[0]
+                )
+            except ValueError:
+                return 400, "application/json", b'{"error": "bad seconds"}'
+            seconds = max(0.1, min(seconds, 60.0))
+
+            prof = cProfile.Profile()
+
+            async def run():
+                prof.enable()
+                await asyncio.sleep(seconds)
+                prof.disable()
+                buf = io.StringIO()
+                pstats.Stats(prof, stream=buf).sort_stats(
+                    "cumulative"
+                ).print_stats(50)
+                return buf.getvalue()
+
+            return run  # the connection handler awaits coroutine routes
         return 404, "application/json", b'{"error": "not found"}'
 
 

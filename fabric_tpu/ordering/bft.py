@@ -95,8 +95,10 @@ class BFTNode:
         self.view_timeout = view_timeout
 
         self.view = 0
-        self.next_seq = 1          # leader's next sequence to assign
-        self.last_applied = 0
+        # a compacted WAL restarts with everything <= snap_index
+        # materialized by the chain already
+        self.next_seq = wal.snap_index + 1  # leader's next sequence
+        self.last_applied = wal.snap_index
         self.slots: dict[int, _SlotState] = {}
         self.view_changes: dict[int, dict] = {}  # new_view -> {node: vc}
         self._applied_digest: dict[int, str] = {}  # seq -> payload digest
@@ -370,6 +372,14 @@ class BFTNode:
                         os.unlink(old)
                 except (ValueError, OSError):
                     pass
+
+    def update_peers(self, peers: list[str]) -> None:
+        """Consenter-set change from a committed config block: refresh
+        the membership and the derived fault/quorum thresholds."""
+        self.peers = sorted(set(peers) | {self.id})
+        self.n = len(self.peers)
+        self.f = (self.n - 1) // 3
+        self.quorum = 2 * self.f + 1
 
     def commit_proof(self, seq: int) -> list | None:
         """The 2f+1 signed COMMIT messages that committed ``seq`` —

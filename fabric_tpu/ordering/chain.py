@@ -81,12 +81,20 @@ class OrderingChain:
                  msgproc: MsgProcessor | None = None,
                  genesis_block: common_pb2.Block | None = None,
                  consensus: str = "raft", signer=None, verifiers=None,
-                 view_timeout: float = 2.0):
+                 view_timeout: float = 2.0, block_puller=None,
+                 on_consenters=None, wal_retention: int = 256):
         self.channel = channel_id
         self.config = config or BatchConfig()
         self.cutter = BlockCutter(self.config)
         self.msgproc = msgproc or MsgProcessor(self.config)
         self.signer = signer  # block attestation (blockwriter.go)
+        # block_puller(channel, start, stop) → async iterator of
+        # serialized blocks from cluster peers (snapshot catch-up);
+        # on_consenters({id: (host, port)}) → transport re-wiring after
+        # a committed consenter-set change
+        self.block_puller = block_puller
+        self.on_consenters = on_consenters
+        self.wal_retention = wal_retention
         self.blocks = BlockStore(f"{data_dir}/chains")
         if self.blocks.height == 0 and genesis_block is not None:
             self.blocks.add_block(genesis_block)
@@ -105,30 +113,54 @@ class OrderingChain:
             self.raft = RaftNode(
                 node_id, peers, WAL(f"{data_dir}/wal"),
                 apply_cb=self._apply, send_cb=send_cb,
+                catchup_cb=self._on_snapshot_hint,
             )
         self.consenter = self.raft  # canonical name; raft kept for compat
-        self._applied_batches = 0
-        self._recovered_batches = 0
+        self._offset = 0  # block number of raft entry 1, set at start()
+        self._catchup_task: asyncio.Task | None = None
         self._timer_task: asyncio.Task | None = None
         self._height_changed = asyncio.Event()
 
     # -- lifecycle ----------------------------------------------------------
 
+    def _derive_offset(self) -> int:
+        """Block number of raft entry 1.  Batch blocks carry ORDERER
+        consensus metadata; a genesis/config block 0 doesn't — that
+        distinguishes the two layouts (re-derived after catch-up too,
+        in case block 0 arrived out-of-band)."""
+        if self.blocks.height == 0:
+            return 0
+        idx = common_pb2.BlockMetadataIndex.ORDERER
+        b0 = self.blocks.get_block(0)
+        has_meta = len(b0.metadata.metadata) > idx and b0.metadata.metadata[idx]
+        return 0 if has_meta else 1
+
     def start(self):
-        # Re-derive how many raft entries are already materialized as
-        # blocks so WAL replay doesn't re-append them.  Batch blocks
-        # carry ORDERER consensus metadata; a genesis/config block 0
-        # doesn't — that distinguishes the two layouts on restart.
-        h = self.blocks.height
-        offset = 0
-        if h > 0:
-            idx = common_pb2.BlockMetadataIndex.ORDERER
-            b0 = self.blocks.get_block(0)
-            has_meta = len(b0.metadata.metadata) > idx and b0.metadata.metadata[idx]
-            offset = 0 if has_meta else 1
-        self._recovered_batches = max(0, h - offset)
-        self._applied_batches = 0
+        # Map raft entry indices to block numbers so WAL replay skips
+        # entries already materialized.
+        self._offset = self._derive_offset()
+        # committed membership changes must survive restart: the WAL
+        # replay skips already-materialized entries (including config
+        # blocks), so re-derive the consenter set from the chain
+        self._reapply_config_membership()
         self.raft.start()
+
+    def _reapply_config_membership(self) -> None:
+        """Scan the chain tip-down for the most recent CONFIG block
+        carrying a consenter set and re-apply it — restart replay and
+        snapshot catch-up bypass _apply for materialized blocks, and a
+        reverted membership would diverge from the cluster."""
+        for num in range(self.blocks.height - 1, -1, -1):
+            blk = self.blocks.get_block(num)
+            if blk is None:
+                return
+            if self._maybe_reconfigure(list(blk.data.data)):
+                return
+
+    @property
+    def _materialized(self) -> int:
+        """Highest raft entry index already materialized as a block."""
+        return max(0, self.blocks.height - self._offset)
 
     def stop(self):
         self.raft.stop()
@@ -137,6 +169,18 @@ class OrderingChain:
         self.blocks.close()
 
     # -- broadcast ----------------------------------------------------------
+
+    @staticmethod
+    def _is_config(env_bytes: bytes) -> bool:
+        try:
+            env = protoutil.unmarshal(common_pb2.Envelope, env_bytes)
+            payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+            ch = protoutil.unmarshal(
+                common_pb2.ChannelHeader, payload.header.channel_header
+            )
+            return ch.type == common_pb2.HeaderType.CONFIG
+        except Exception:
+            return False
 
     async def broadcast(self, env_bytes: bytes) -> dict:
         """→ {status} or {status, info/redirect}."""
@@ -150,7 +194,15 @@ class OrderingChain:
                 self.raft.note_client_request()
             return {"status": 503, "info": "not leader",
                     "leader": self.raft.leader_id}
-        batches, pending = self.cutter.ordered(env_bytes)
+        if self._is_config(env_bytes):
+            # config messages cut into their OWN single-envelope block
+            # (standardchannel.go): pending normal traffic flushes
+            # first, and the apply path only scans 1-envelope batches
+            # for consenter changes
+            batches = [b for b in (self.cutter.cut(),) if b] + [[env_bytes]]
+            pending = False
+        else:
+            batches, pending = self.cutter.ordered(env_bytes)
         last_index = None
         for batch in batches:
             last_index = self._propose_batch(batch)
@@ -197,9 +249,8 @@ class OrderingChain:
 
     def _apply(self, entry: Entry):
         batch = [bytes.fromhex(h) for h in json.loads(entry.data.decode())]
-        self._applied_batches += 1
-        if self._applied_batches <= self._recovered_batches:
-            return  # already materialized before restart
+        if entry.index <= self._materialized:
+            return  # already materialized (restart replay / catch-up)
         prev = (
             protoutil.block_header_hash(
                 self.blocks.get_block(self.blocks.height - 1).header
@@ -231,6 +282,158 @@ class OrderingChain:
         self.blocks.add_block(blk)
         self._height_changed.set()
         self._height_changed = asyncio.Event()
+        # consenter-set changes ride committed CONFIG envelopes
+        # (etcdraft reconfiguration, chain.go:1115)
+        self._maybe_reconfigure(batch)
+        # WAL compaction at the retention boundary: everything this far
+        # back lives in the block store (etcdraft/storage.go)
+        cadence = max(1, min(64, self.wal_retention))
+        if entry.index % cadence == 0 and entry.index > self.wal_retention:
+            wal = getattr(self.raft, "wal", None)
+            if wal is not None:
+                wal.compact_to(entry.index - self.wal_retention)
+
+    def _maybe_reconfigure(self, batch: list[bytes]) -> bool:
+        """Single-envelope batches only (broadcast isolates CONFIG
+        messages into their own batch, the standardchannel.go stance):
+        a CONFIG envelope carrying a new ConsensusType consenter set
+        applies membership + transport changes (one-server-at-a-time,
+        as etcd applies them).  → True iff a consenter set was found."""
+        from fabric_tpu.protos import configtx_pb2, orderer_pb2
+
+        if len(batch) != 1:
+            return False
+        for env_bytes in batch:
+            try:
+                env = protoutil.unmarshal(common_pb2.Envelope, env_bytes)
+                payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+                ch = protoutil.unmarshal(
+                    common_pb2.ChannelHeader, payload.header.channel_header
+                )
+                if ch.type != common_pb2.HeaderType.CONFIG:
+                    continue
+                cfg_env = protoutil.unmarshal(
+                    configtx_pb2.ConfigEnvelope, payload.data
+                )
+                ordg = cfg_env.config.channel_group.groups.get("Orderer")
+                if ordg is None or "ConsensusType" not in ordg.values:
+                    continue
+                ct = protoutil.unmarshal(
+                    orderer_pb2.ConsensusType, ordg.values["ConsensusType"].value
+                )
+                meta = protoutil.unmarshal(
+                    orderer_pb2.RaftConfigMetadata, ct.metadata
+                )
+                ids = [c.id for c in meta.consenters if c.id]
+                if not ids:
+                    continue
+                addr_map = {
+                    c.id: (c.host, c.port)
+                    for c in meta.consenters if c.id
+                }
+                cur = sorted({self.raft.id, *self.raft.peers})
+                if sorted(ids) != cur:
+                    if self.on_consenters is not None:
+                        self.on_consenters(addr_map)
+                    self.raft.update_peers(ids)
+                return True
+            except Exception:
+                import logging
+
+                logging.getLogger("fabric_tpu.orderer").exception(
+                    "%s: consenter reconfiguration from config block "
+                    "failed", self.channel,
+                )
+        return False
+
+    # -- snapshot catch-up (follower_chain.go) -----------------------------
+
+    def _on_snapshot_hint(self, snap_index: int, snap_term: int) -> None:
+        """The leader compacted past us: pull the missing BLOCKS from
+        the cluster, then fast-forward the raft log state."""
+        if self.block_puller is None:
+            return
+        if self._catchup_task is not None and not self._catchup_task.done():
+            return
+        target_height = self._offset + snap_index
+
+        async def go():
+            try:
+                async for raw in self.block_puller(
+                    self.channel, self.blocks.height, target_height - 1
+                ):
+                    blk = common_pb2.Block()
+                    blk.ParseFromString(raw)
+                    if blk.header.number != self.blocks.height:
+                        continue
+                    if not self._catchup_block_ok(blk):
+                        import logging
+
+                        logging.getLogger("fabric_tpu.orderer").warning(
+                            "%s: catch-up block %d failed attestation — "
+                            "refusing", self.channel, blk.header.number,
+                        )
+                        break
+                    self.blocks.add_block(blk)
+                    self._height_changed.set()
+                    self._height_changed = asyncio.Event()
+                # block 0 may have arrived out-of-band: refresh the
+                # entry→block mapping and re-derive membership from the
+                # newest materialized config block
+                self._offset = self._derive_offset()
+                self._reapply_config_membership()
+                if self._materialized >= snap_index:
+                    self.raft.install_snapshot(snap_index, snap_term)
+            except Exception as e:
+                import logging
+
+                logging.getLogger("fabric_tpu.orderer").warning(
+                    "%s: snapshot catch-up to %d failed: %s",
+                    self.channel, target_height, e,
+                )
+
+        self._catchup_task = asyncio.ensure_future(go())
+
+    def _catchup_block_ok(self, blk) -> bool:
+        """Pulled blocks must carry the attestation this round's
+        deliver-side verification demands: under BFT (a byzantine
+        cluster peer is IN the fault model) the 2f+1 commit proof over
+        the batch digest, verified against the consenter identity
+        registry; prev-hash chaining is enforced by add_block either
+        way.  CFT raft trusts cluster peers for catch-up, as the
+        reference's follower chain does."""
+        verifiers = getattr(self.raft, "verifiers", None)
+        if not verifiers:
+            return True  # raft / dev mode
+        import hashlib
+
+        from fabric_tpu.ordering.bft import COMMIT, _signable
+
+        try:
+            idx = common_pb2.BlockMetadataIndex.ORDERER
+            meta = json.loads(bytes(blk.metadata.metadata[idx]))
+            proof = meta["bft_proof"]
+            payload = json.dumps(
+                [bytes(e).hex() for e in blk.data.data]
+            ).encode()
+            want = hashlib.sha256(payload).hexdigest()
+            quorum = getattr(self.raft, "quorum", 1)
+            good = set()
+            for m in proof:
+                if not isinstance(m, dict) or m.get("type") != COMMIT:
+                    continue
+                if m.get("digest") != want:
+                    continue
+                sender = m.get("from")
+                ver = verifiers.get(sender)
+                sig = m.get("sig")
+                if sender in good or ver is None or not sig:
+                    continue
+                if ver.verify(_signable(m), bytes.fromhex(sig)):
+                    good.add(sender)
+            return len(good) >= quorum
+        except Exception:
+            return False
 
     # -- deliver --------------------------------------------------------------
 

@@ -146,11 +146,45 @@ class OrdererNode:
             genesis_block=genesis_block,
             consensus=self.consensus, signer=self.signer,
             verifiers=self.verifiers, view_timeout=self.view_timeout,
+            block_puller=self._pull_blocks,
+            on_consenters=self._on_consenters,
         )
         self.chains[channel_id] = chain
         if start:
             chain.start()
         return chain
+
+    def _on_consenters(self, addr_map: dict) -> None:
+        """Committed consenter-set change: make new members reachable.
+        The cluster map is NODE-wide (shared by every channel this
+        registrar hosts), so entries are only added/updated here —
+        per-channel membership exclusion happens in each chain's
+        update_peers, never by dropping another channel's transport."""
+        for nid, addr in addr_map.items():
+            self.cluster[nid] = tuple(addr)
+
+    async def _pull_blocks(self, channel: str, start: int, stop: int):
+        """Pull serialized blocks [start, stop] from ANY cluster peer's
+        Deliver — the follower-chain catch-up source
+        (orderer/common/follower/follower_chain.go)."""
+        hdr = json.dumps(
+            {"channel": channel, "start": start, "stop": stop}
+        ).encode()
+        for peer_id in list(self.cluster):
+            if peer_id == self.id:
+                continue
+            try:
+                cli = await self._peer_client(peer_id)
+                st = await cli.open_stream("Deliver")
+                await st.send(hdr)
+                got = False
+                async for raw in st:
+                    got = True
+                    yield raw
+                if got:
+                    return
+            except Exception:
+                continue
 
     # -- services -----------------------------------------------------------------
 

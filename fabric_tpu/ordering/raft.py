@@ -28,6 +28,7 @@ MSG_VOTE = "vote"
 MSG_VOTE_RESP = "vote_resp"
 MSG_APPEND = "append"
 MSG_APPEND_RESP = "append_resp"
+MSG_SNAP_HINT = "snap_hint"  # leader compacted past the follower
 
 _LEN = struct.Struct(">I")
 
@@ -53,6 +54,11 @@ class WAL:
         self.wal_path = os.path.join(dirpath, "wal.bin")
         self.term = 0
         self.voted_for: str | None = None
+        # compaction watermark: entries <= snap_index are gone from the
+        # log (their effects live in the materialized block store —
+        # the reference's WAL+snapshot split, etcdraft/storage.go)
+        self.snap_index = 0
+        self.snap_term = 0
         self.entries: list[Entry] = []
         self._load()
         self._f = open(self.wal_path, "ab")
@@ -63,6 +69,8 @@ class WAL:
                 meta = json.load(f)
             self.term = meta.get("term", 0)
             self.voted_for = meta.get("voted_for")
+            self.snap_index = meta.get("snap_index", 0)
+            self.snap_term = meta.get("snap_term", 0)
         if not os.path.exists(self.wal_path):
             return
         good = 0
@@ -80,7 +88,8 @@ class WAL:
             # any previously-read suffix from i (leader change rewrote it)
             while self.entries and self.entries[-1].index >= index:
                 self.entries.pop()
-            self.entries.append(ent)
+            if index > self.snap_index:  # compacted entries are gone
+                self.entries.append(ent)
             off += 20 + ln
             good = off
         if good != len(blob):
@@ -91,10 +100,55 @@ class WAL:
         self.term, self.voted_for = term, voted_for
         tmp = self.meta_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"term": term, "voted_for": voted_for}, f)
+            json.dump({
+                "term": term, "voted_for": voted_for,
+                "snap_index": self.snap_index, "snap_term": self.snap_term,
+            }, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.meta_path)
+
+    def _rewrite(self):
+        self._f.close()
+        tmp = self.wal_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for e in self.entries:
+                f.write(_LEN.pack(len(e.data))
+                        + struct.pack(">QQ", e.term, e.index) + e.data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.wal_path)
+        self._f = open(self.wal_path, "ab")
+
+    def compact_to(self, index: int) -> int:
+        """Drop entries <= index from the log (they are materialized in
+        the block store); records the (snap_index, snap_term)
+        watermark.  → number of entries dropped."""
+        if index <= self.snap_index:
+            return 0
+        dropped = 0
+        term = self.snap_term
+        for e in self.entries:
+            if e.index <= index:
+                dropped += 1
+                term = e.term
+        if not dropped:
+            return 0
+        self.entries = [e for e in self.entries if e.index > index]
+        self.snap_index = index
+        self.snap_term = term
+        self.save_meta(self.term, self.voted_for)  # watermark FIRST
+        self._rewrite()
+        return dropped
+
+    def install_snapshot(self, index: int, term: int) -> None:
+        """Out-of-band catch-up installed state through ``index`` (the
+        chain pulled the blocks): the log restarts after it."""
+        self.entries = [e for e in self.entries if e.index > index]
+        self.snap_index = index
+        self.snap_term = term
+        self.save_meta(self.term, self.voted_for)
+        self._rewrite()
 
     def append(self, entries: list[Entry]):
         for e in entries:
@@ -105,15 +159,10 @@ class WAL:
 
     def truncate_from(self, index: int):
         """Drop log entries >= index (conflict rewrite).  Rewrites the
-        file — raft conflicts are rare and logs are compacted."""
+        file — raft conflicts are rare, and compaction keeps the log
+        short, so the rewrite is bounded by the retention window."""
         self.entries = [e for e in self.entries if e.index < index]
-        self._f.close()
-        with open(self.wal_path, "wb") as f:
-            for e in self.entries:
-                f.write(_LEN.pack(len(e.data)) + struct.pack(">QQ", e.term, e.index) + e.data)
-            f.flush()
-            os.fsync(f.fileno())
-        self._f = open(self.wal_path, "ab")
+        self._rewrite()
 
     def close(self):
         self._f.close()
@@ -129,19 +178,25 @@ class RaftNode:
     def __init__(self, node_id: str, peers: list[str], wal: WAL,
                  apply_cb, send_cb,
                  election_timeout: tuple[float, float] = (0.15, 0.30),
-                 heartbeat: float = 0.05):
+                 heartbeat: float = 0.05, catchup_cb=None):
         self.id = node_id
         self.peers = [p for p in peers if p != node_id]
         self.wal = wal
         self.apply_cb = apply_cb
         self.send_cb = send_cb
+        # catchup_cb(snap_index, snap_term): the leader compacted past
+        # this follower — pull state out-of-band (blocks from the
+        # cluster, the follower-chain pattern) then install_snapshot
+        self.catchup_cb = catchup_cb
         self.election_timeout = election_timeout
         self.heartbeat = heartbeat
 
         self.state = "follower"
         self.leader_id: str | None = None
-        self.commit_index = 0
-        self.last_applied = 0
+        # a compacted WAL restarts with everything <= snap_index
+        # already materialized by the chain
+        self.commit_index = wal.snap_index
+        self.last_applied = wal.snap_index
         self.next_index: dict[str, int] = {}
         self.match_index: dict[str, int] = {}
         self.votes: set[str] = set()
@@ -154,11 +209,11 @@ class RaftNode:
 
     @property
     def last_index(self) -> int:
-        return self.wal.entries[-1].index if self.wal.entries else 0
+        return self.wal.entries[-1].index if self.wal.entries else self.wal.snap_index
 
     @property
     def last_term(self) -> int:
-        return self.wal.entries[-1].term if self.wal.entries else 0
+        return self.wal.entries[-1].term if self.wal.entries else self.wal.snap_term
 
     def _entry(self, index: int) -> Entry | None:
         if not self.wal.entries:
@@ -283,6 +338,45 @@ class RaftNode:
             self._on_append(msg)
         elif kind == MSG_APPEND_RESP:
             self._on_append_resp(msg)
+        elif kind == MSG_SNAP_HINT:
+            self._on_snap_hint(msg)
+
+    def _on_snap_hint(self, msg):
+        if msg["term"] != self.wal.term or msg["snap_index"] <= self.last_applied:
+            return
+        self._reset_election_timer()
+        if self.catchup_cb is not None:
+            self.catchup_cb(msg["snap_index"], msg["snap_term"])
+
+    def install_snapshot(self, index: int, term: int) -> None:
+        """The chain pulled and materialized blocks through raft index
+        ``index`` out-of-band: fast-forward the log state so
+        replication resumes after it."""
+        if index <= self.last_applied:
+            return
+        self.wal.install_snapshot(index, term)
+        self.commit_index = max(self.commit_index, index)
+        self.last_applied = max(self.last_applied, index)
+        if self._apply_waiters:
+            rest = []
+            for idx, ev in self._apply_waiters:
+                if self.last_applied >= idx:
+                    ev.set()
+                else:
+                    rest.append((idx, ev))
+            self._apply_waiters = rest
+
+    def update_peers(self, peers: list[str]) -> None:
+        """Consenter-set change from a committed config block (the
+        etcdraft reconfiguration path, chain.go:1115; single-server
+        changes at a time, as etcd applies them)."""
+        self.peers = [p for p in peers if p != self.id]
+        for p in self.peers:
+            self.next_index.setdefault(p, self.last_index + 1)
+            self.match_index.setdefault(p, 0)
+        for gone in set(self.next_index) - set(self.peers):
+            self.next_index.pop(gone, None)
+            self.match_index.pop(gone, None)
 
     def _on_vote(self, msg):
         grant = False
@@ -304,8 +398,20 @@ class RaftNode:
 
     def _send_append(self, peer: str):
         ni = self.next_index.get(peer, self.last_index + 1)
+        if ni <= self.wal.snap_index:
+            # the entries this follower needs are compacted away: it
+            # must catch up from the block store (follower_chain.go),
+            # then resume replication after the snapshot watermark
+            self.send_cb(peer, {
+                "type": MSG_SNAP_HINT, "term": self.wal.term,
+                "from": self.id, "snap_index": self.wal.snap_index,
+                "snap_term": self.wal.snap_term,
+            })
+            return
         prev = self._entry(ni - 1)
-        prev_term = prev.term if prev else 0
+        prev_term = prev.term if prev else (
+            self.wal.snap_term if ni - 1 == self.wal.snap_index else 0
+        )
         ents = []
         idx = ni
         while True:
@@ -331,7 +437,10 @@ class RaftNode:
             self._reset_election_timer()
             prev_i, prev_t = msg["prev_index"], msg["prev_term"]
             prev = self._entry(prev_i)
-            if prev_i == 0 or (prev is not None and prev.term == prev_t):
+            if prev_i == 0 or (prev is not None and prev.term == prev_t) or (
+                prev_i == self.wal.snap_index
+                and prev_t == self.wal.snap_term
+            ):
                 ok = True
                 new = []
                 for em in msg["entries"]:

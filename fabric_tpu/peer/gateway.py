@@ -59,7 +59,7 @@ class Gateway:
             self.node.msp, self.node.signer, self.node.runtime
         )
         loop = asyncio.get_event_loop()
-        async with chan.commit_lock:
+        async with chan.commit_lock.reader():
             return await loop.run_in_executor(
                 None, endorser.process_proposal, signed
             )

@@ -152,7 +152,13 @@ class PeerChannel:
 
         self.confighistory = ConfigHistoryDB(f"{data_dir}/confighistory.db")
         self.transient_retention = 50  # blocks (core.yaml transientstore)
-        self.commit_lock = asyncio.Lock()  # endorsement vs commit (txmgr RW lock)
+        from fabric_tpu.utils.locks import AsyncRWLock
+
+        # endorsement vs commit: simulations take the SHARED side, the
+        # committer the exclusive one (lockbased_txmgr RW semantics,
+        # endorser.go:379-401) — endorsements run in parallel with each
+        # other and only serialize against block commits
+        self.commit_lock = AsyncRWLock()
         self._height_changed = asyncio.Event()
         self._deliver_task: asyncio.Task | None = None
 
@@ -205,7 +211,7 @@ class PeerChannel:
             self.verify_block_signature(b)
             return self.validator.validate(b)
 
-        async with self.commit_lock:
+        async with self.commit_lock.writer():
             t0 = _time.perf_counter()
             flt, batch, history = await loop.run_in_executor(
                 None, _verify_and_validate, block
@@ -513,7 +519,7 @@ class PeerChannel:
         if proc is not None and hasattr(proc, "bundle"):
             cfg = proc.bundle.config.SerializeToString()
         loop = asyncio.get_event_loop()
-        async with self.commit_lock:
+        async with self.commit_lock.writer():
             # worker thread: a large state export must not freeze the
             # node's RPC services for its duration
             return await loop.run_in_executor(
@@ -635,7 +641,7 @@ class PeerNode:
             return pr.SerializeToString()
         endorser = chan.make_endorser(self.msp, self.signer, self.runtime)
         loop = asyncio.get_event_loop()
-        async with chan.commit_lock:  # simulate against a stable height
+        async with chan.commit_lock.reader():  # stable height; parallel
             # off the event loop: ECDSA verify + chaincode execution
             # must not stall Deliver/Query/commit service latency
             result = await loop.run_in_executor(

@@ -93,7 +93,7 @@ class PolicyProvider:
         return getattr(info, "collections", {}).get(coll)
 
 
-@dataclass
+@dataclass(slots=True)
 class ParsedTx:
     idx: int
     code: int = C.NOT_VALIDATED
@@ -156,6 +156,7 @@ class PendingBlock:
     dpre: object           # _DevicePre or None
     overlay: object = None  # predecessor UpdateBatch (in-flight commit)
     fetch2: object = None   # stage-2 packed fetch, set by _launch_device
+    range_phantom: frozenset = frozenset()  # tx idxs failing range re-exec
 
     @property
     def txids(self) -> set:
@@ -613,7 +614,7 @@ class BlockValidator:
         # block); falls back to the host path for custom plugins,
         # non-v3 kernels, or consumption-unsafe blocks
         if getattr(fetch, "device_out", None) is not None and txs and dpre:
-            pending.fetch2 = self._launch_device(
+            pending.fetch2, pending.range_phantom = self._launch_device(
                 block, txs, fetch, dpre, overlay
             )
         return pending
@@ -828,7 +829,13 @@ class BlockValidator:
 
         t0 = time.perf_counter()
         # committed-range phantom re-execution (host state reads, plus
-        # the in-flight predecessor's writes when pipelined)
+        # the in-flight predecessor's writes when pipelined).  The CODE
+        # is assigned at finish, AFTER the policy verdicts — the host
+        # path's check order is creator → policy → mvcc/phantom, and a
+        # tx failing both must report ENDORSEMENT_POLICY_FAILURE on
+        # both paths; here the tx is only excluded from the kernel's
+        # writer set (its writes must not kill other reads).
+        range_phantom: set = set()
         if dpre.has_range:
             for ptx in txs:
                 if (
@@ -838,14 +845,14 @@ class BlockValidator:
                          or (overlay is not None
                              and _overlay_range_phantom(ptx, overlay)))
                 ):
-                    ptx.code = C.PHANTOM_READ_CONFLICT
+                    range_phantom.add(ptx.idx)
 
         t_bucket = int(dpre.static.read_keys.shape[0])
         structural = np.zeros(t_bucket, bool)
         creator_idx = np.full(t_bucket, -1, np.int32)
         for ptx in txs:
             if ptx.undetermined and not ptx.is_config:
-                structural[ptx.idx] = True
+                structural[ptx.idx] = ptx.idx not in range_phantom
                 creator_idx[ptx.idx] = (
                     -2 if ptx.host_creator_ok else ptx.creator_item_idx
                 )  # -2 = host-verified (idemix) → always-true lane
@@ -863,7 +870,7 @@ class BlockValidator:
             t_bucket,
         )
         self._t("stage2_dispatch", t0)
-        return fetch2
+        return fetch2, range_phantom
 
     def _finish_device(self, pending: "PendingBlock"):
         """Consume the stage-2 packed output: final codes, filter,
@@ -900,6 +907,8 @@ class BlockValidator:
             i = ptx.idx
             if not policy_ok[i]:
                 ptx.code = C.ENDORSEMENT_POLICY_FAILURE
+            elif i in pending.range_phantom:
+                ptx.code = C.PHANTOM_READ_CONFLICT
             elif valid[i]:
                 ptx.code = C.VALID
             else:

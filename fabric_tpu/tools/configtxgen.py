@@ -106,11 +106,14 @@ def genesis_config(profile: Profile) -> configtx_pb2.Config:
     ordg.mod_policy = "Admins"
     consenters = []
     for c in profile.raft_consenters:
-        # (host, port) or (host, port, serialized_identity) — BFT
-        # channels need the identity to pin the attestation voter set
+        # (host, port[, serialized_identity[, node_id]]) — BFT channels
+        # need the identity to pin the attestation voter set; the node
+        # id drives membership reconfiguration
         rc = orderer_pb2.RaftConsenter(host=c[0], port=c[1])
         if len(c) > 2 and c[2]:
             rc.identity = c[2]
+        if len(c) > 3 and c[3]:
+            rc.id = c[3]
         consenters.append(rc)
     ordg.values["ConsensusType"].value = orderer_pb2.ConsensusType(
         type=profile.consensus_type,

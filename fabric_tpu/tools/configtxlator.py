@@ -1,0 +1,62 @@
+"""configtxlator analog: config proto ↔ JSON translation + config
+update (delta) computation (reference: internal/configtxlator/update +
+the REST tool in cmd/configtxlator; here a library + CLI verbs — no
+REST server needed when the CLI is a library call away)."""
+
+from __future__ import annotations
+
+from google.protobuf import json_format
+
+from fabric_tpu.protos import common_pb2, configtx_pb2, orderer_pb2, policies_pb2
+
+_TYPES = {
+    "common.Config": configtx_pb2.Config,
+    "common.ConfigEnvelope": configtx_pb2.ConfigEnvelope,
+    "common.ConfigUpdate": configtx_pb2.ConfigUpdate,
+    "common.ConfigUpdateEnvelope": configtx_pb2.ConfigUpdateEnvelope,
+    "common.Block": common_pb2.Block,
+    "common.Envelope": common_pb2.Envelope,
+    "common.Payload": common_pb2.Payload,
+    "orderer.ConsensusType": orderer_pb2.ConsensusType,
+    "orderer.RaftConfigMetadata": orderer_pb2.RaftConfigMetadata,
+    "policies.SignaturePolicyEnvelope": policies_pb2.SignaturePolicyEnvelope,
+}
+
+
+def message_type(name: str):
+    try:
+        return _TYPES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown message type {name!r}; known: {sorted(_TYPES)}"
+        ) from None
+
+
+def proto_decode(type_name: str, data: bytes) -> str:
+    """Serialized proto → canonical JSON."""
+    msg = message_type(type_name)()
+    msg.ParseFromString(data)
+    return json_format.MessageToJson(
+        msg, preserving_proto_field_name=True, sort_keys=True
+    )
+
+
+def proto_encode(type_name: str, json_text: str) -> bytes:
+    """JSON → serialized proto (round-trips proto_decode)."""
+    msg = message_type(type_name)()
+    json_format.Parse(json_text, msg)
+    return msg.SerializeToString()
+
+
+def compute_update(channel_id: str, original: bytes, updated: bytes) -> bytes:
+    """Two serialized common.Config snapshots → the serialized
+    common.ConfigUpdate delta (read set with version pins + write set)
+    — internal/configtxlator/update/update.go Compute."""
+    from fabric_tpu.tools import configtxgen as ctg
+
+    cur = configtx_pb2.Config()
+    cur.ParseFromString(original)
+    new = configtx_pb2.Config()
+    new.ParseFromString(updated)
+    upd = ctg.compute_update(channel_id, cur, new)
+    return upd.SerializeToString()

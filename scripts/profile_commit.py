@@ -7,7 +7,7 @@ import bench
 
 
 def main(n_tx=1000):
-    blocks, fresh_state, fresh_validator, mgr, prov, CC = bench._build_commit_network(n_tx)
+    blocks, fresh_state, fresh_validator, mgr, prov, CC, _ninv = bench._build_commit_network(n_tx)
     blk = blocks[0]
     state = fresh_state()
     v = fresh_validator(state)

@@ -1,0 +1,164 @@
+"""Host-path vs device-path differential property test: randomized
+adversarial blocks (invalid signatures, duplicate endorsers/txids,
+consumption-unsafe policies, stale/phantom reads, range queries,
+config txs, garbage envelopes) must produce byte-identical
+TRANSACTIONS_FILTER and update batches on `_validate_host` and the
+fused device path — the fallback conditions are exactly where a silent
+divergence would hide (VERDICT r3 weak #3)."""
+
+import random
+
+import pytest
+
+from fabric_tpu import protoutil as pu
+from fabric_tpu.crypto import cryptogen
+from fabric_tpu.crypto import policy as pol
+from fabric_tpu.crypto.msp import MSPManager
+from fabric_tpu.ledger.rwset import TxRWSet
+from fabric_tpu.ledger.statedb import MemVersionedDB, UpdateBatch
+from fabric_tpu.peer import txassembly as txa
+from fabric_tpu.peer.validator import (
+    BlockValidator, NamespaceInfo, PolicyProvider,
+)
+
+CHANNEL = "diffchan"
+CC_SAFE = "diffcc"
+CC_UNSAFE = "diffun"
+N_BLOCKS = 200
+TXS_PER_BLOCK = 8  # fixed-ish sizes keep the jit shape set small
+
+
+@pytest.fixture(scope="module")
+def net():
+    orgs = [
+        cryptogen.generate_org(f"Org{i}MSP", f"org{i}.diff.example.com",
+                               peers=1, users=1)
+        for i in (1, 2, 3)
+    ]
+    mgr = MSPManager({o.msp_id: o.msp() for o in orgs})
+    peers = [
+        cryptogen.signing_identity(o, f"peer0.org{i}.diff.example.com")
+        for i, o in zip((1, 2, 3), orgs)
+    ]
+    rogue_org = cryptogen.generate_org("RogueMSP", "rogue.diff.example.com",
+                                       peers=1)
+    prov = PolicyProvider({
+        CC_SAFE: NamespaceInfo(policy=pol.from_dsl(
+            "OutOf(2, 'Org1MSP.peer', 'Org2MSP.peer', 'Org3MSP.peer')")),
+        # one identity can match BOTH principals → consumption-unsafe
+        # rows → the device path must fall back and still agree
+        CC_UNSAFE: NamespaceInfo(policy=pol.from_dsl(
+            "OutOf(1, 'Org1MSP.peer', 'Org1MSP.member')")),
+    })
+    return {
+        "mgr": mgr, "prov": prov, "peers": peers,
+        "client": cryptogen.signing_identity(orgs[0],
+                                             "User1@org1.diff.example.com"),
+        "rogue": cryptogen.signing_identity(rogue_org,
+                                            "peer0.rogue.diff.example.com"),
+    }
+
+
+def _seed_state():
+    db = MemVersionedDB()
+    seed = UpdateBatch()
+    for i in range(8):
+        seed.put(CC_SAFE, f"s{i}", b"v", (1, i))
+        seed.put(CC_UNSAFE, f"u{i}", b"v", (1, i))
+    db.apply_updates(seed, (1, 0))
+    return db
+
+
+def _rand_tx(net, rng):
+    ns = CC_UNSAFE if rng.random() < 0.15 else CC_SAFE
+    tx = TxRWSet()
+    n = tx.ns_rwset(ns)
+    for _ in range(rng.randrange(0, 3)):
+        i = rng.randrange(8)
+        key = f"{'u' if ns == CC_UNSAFE else 's'}{i}"
+        kind = rng.random()
+        if kind < 0.6:
+            n.reads[key] = (1, i)          # fresh
+        elif kind < 0.8:
+            n.reads[key] = (0, 99)         # stale → conflict
+        else:
+            n.reads[f"absent{i}"] = None   # absent, matches state
+    for _ in range(rng.randrange(0, 3)):
+        n.writes[f"w{rng.randrange(12)}"] = b"x"
+    if rng.random() < 0.15:
+        # range query over seeded keys; sometimes missing a result
+        lo, hi = "s0", "s4"
+        results = [(f"s{i}", (1, i)) for i in range(4)
+                   if not (rng.random() < 0.4 and i == 2)]
+        n.range_queries.append((lo, hi, results))
+    rw = tx.to_proto().SerializeToString()
+
+    choice = rng.random()
+    peers = net["peers"]
+    if choice < 0.55:
+        endorsers = rng.sample(peers, 2)          # satisfies 2-of-3
+    elif choice < 0.7:
+        endorsers = [rng.choice(peers)]           # under-endorsed
+    elif choice < 0.8:
+        p = rng.choice(peers)
+        endorsers = [p, p]                        # duplicate endorser
+    elif choice < 0.9:
+        endorsers = [rng.choice(peers), net["rogue"]]  # foreign org
+    else:
+        endorsers = rng.sample(peers, 3)
+    _, _, prop = txa.create_signed_proposal(net["client"], CHANNEL, ns, [b"i"])
+    resps = [txa.create_proposal_response(prop, rw, e, ns) for e in endorsers]
+    env = txa.assemble_transaction(prop, resps, net["client"])
+
+    tamper = rng.random()
+    if tamper < 0.08:
+        env.signature = env.signature[:-4] + bytes(4)   # bad creator sig
+    elif tamper < 0.16:
+        raw = bytearray(env.SerializeToString())
+        # flip one byte deep in the payload: often breaks an
+        # endorsement or the structure — both paths must agree on HOW
+        raw[len(raw) // 2] ^= 0x40
+        return bytes(raw)
+    return env.SerializeToString()
+
+
+def _rand_block(net, rng, num):
+    envs = []
+    dup_pool = []
+    for _ in range(TXS_PER_BLOCK):
+        r = rng.random()
+        if r < 0.04:
+            envs.append(b"")                      # nil envelope
+        elif r < 0.08:
+            envs.append(b"\x13garbage-bytes")     # malformed
+        elif r < 0.12 and dup_pool:
+            envs.append(rng.choice(dup_pool))     # duplicate txid
+        else:
+            raw = _rand_tx(net, rng)
+            envs.append(raw)
+            dup_pool.append(raw)
+    blk = pu.new_block(num, b"prev-%d" % num)
+    for e in envs:
+        blk.data.data.append(e)
+    return pu.finalize_block(blk)
+
+
+def test_host_device_differential(net):
+    rng = random.Random(20260730)
+    mismatches = []
+    for bi in range(N_BLOCKS):
+        blk = _rand_block(net, rng, num=2 + bi)
+
+        v_dev = BlockValidator(net["mgr"], net["prov"], _seed_state())
+        flt_d, batch_d, hist_d = v_dev.validate(blk)
+
+        v_host = BlockValidator(net["mgr"], net["prov"], _seed_state())
+        pre = v_host.preprocess(blk)
+        flt_h, batch_h, hist_h = v_host._validate_host(
+            blk, pre[0], pre[1], pre[2]
+        )
+        if (bytes(flt_d) != bytes(flt_h)
+                or sorted(batch_d.updates) != sorted(batch_h.updates)
+                or hist_d != hist_h):
+            mismatches.append((bi, list(flt_d), list(flt_h)))
+    assert not mismatches, mismatches[:5]

@@ -81,3 +81,40 @@ def test_logspec():
     assert logging.getLogger("fabric_tpu").level == logging.ERROR
     logging.getLogger("fabric_tpu.peer").setLevel(logging.NOTSET)
     logging.getLogger("fabric_tpu").setLevel(logging.NOTSET)
+
+
+def test_debug_profiling_surface():
+    """Live profiling endpoints (peer.profile pprof analog,
+    start.go:861-876): thread-stack dumps and a timed cProfile
+    window."""
+    async def scenario():
+        from fabric_tpu.opsserver import HealthRegistry, OperationsServer
+
+        srv = OperationsServer(health=HealthRegistry())
+        await srv.start()
+        try:
+            import urllib.request
+
+            loop = asyncio.get_event_loop()
+
+            def get(path):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{path}", timeout=10
+                ) as r:
+                    return r.status, r.read()
+
+            status, body = await loop.run_in_executor(
+                None, get, "/debug/stacks"
+            )
+            assert status == 200
+            assert b"--- thread" in body
+
+            status, body = await loop.run_in_executor(
+                None, get, "/debug/profile?seconds=0.2"
+            )
+            assert status == 200
+            assert b"cumulative" in body
+        finally:
+            await srv.stop()
+
+    run(scenario())
