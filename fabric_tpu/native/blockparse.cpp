@@ -19,6 +19,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <string_view>
+#include <unordered_map>
 #if defined(__x86_64__) || defined(__i386__)
 #include <immintrin.h>
 #define BP_HAVE_SHANI_COMPILE 1
@@ -464,13 +466,19 @@ void sha256_test(const uint8_t* p, int64_t n, uint8_t out[32]) {
 
 // Parse n envelopes (spans into blob).  Per-env outputs; endorsements
 // flatten into the e_* arrays (capacity cap_endo).  Returns total
-// endorsement count, or -1 if cap_endo was too small.
+// endorsement count, or -1 if a capacity was too small.
 //
 // ok[i]: 1 = standard endorser tx fully parsed; 0 = slow-path needed
 // (the Python validator re-parses those envelopes).
+//
+// Identity INTERNING: creators/endorsers are deduped block-wide into
+// ident_span (uid → span); creator_uid / e_uid reference it and
+// e_dup marks repeat endorsers WITHIN a tx — the Python loop then
+// resolves each distinct identity exactly once (a block re-presents
+// the same few certs thousands of times).
 int64_t parse_block(
     const uint8_t* blob, const int64_t* env_off, const int64_t* env_len,
-    int64_t n, int64_t cap_endo,
+    int64_t n, int64_t cap_endo, int64_t cap_ids,
     // per-envelope outputs
     uint8_t* ok, int64_t* ch_type,
     int64_t* txid_span, int64_t* channel_span, int64_t* creator_span,
@@ -481,8 +489,25 @@ int64_t parse_block(
     int64_t* endo_start, int64_t* endo_count,
     // flat endorsement outputs
     int64_t* e_endorser_span, uint8_t* e_digest, uint8_t* e_r, uint8_t* e_s,
-    uint8_t* e_ok) {
+    uint8_t* e_ok,
+    // identity interning outputs
+    int32_t* creator_uid,          // [n]; -1 = none
+    int32_t* e_uid, uint8_t* e_dup,  // [cap_endo]
+    int64_t* ident_span,           // [cap_ids, 2]
+    int64_t* n_ids_out) {
   int64_t ne = 0;
+  std::unordered_map<std::string_view, int32_t> ids;
+  int32_t next_id = 0;
+  auto intern = [&](const uint8_t* p, size_t len) -> int32_t {
+    std::string_view k(reinterpret_cast<const char*>(p), len);
+    auto it = ids.find(k);
+    if (it != ids.end()) return it->second;
+    if (next_id >= cap_ids) return -2;  // capacity: caller falls back
+    ident_span[2 * next_id] = p - blob;
+    ident_span[2 * next_id + 1] = int64_t(len);
+    ids.emplace(k, next_id);
+    return next_id++;
+  };
   for (int64_t i = 0; i < n; i++) {
     ok[i] = 0;
     ch_type[i] = -1;
@@ -519,6 +544,12 @@ int64_t parse_block(
     put_span(channel_span, i, blob, channel);
     put_span(creator_span, i, blob, creator);
     put_span(nonce_span, i, blob, nonce);
+    creator_uid[i] = -1;
+    if (creator.ok) {
+      int32_t uid = intern(creator.p, creator.n);
+      if (uid == -2) return -1;
+      creator_uid[i] = uid;
+    }
 
     // creator signature item: digest of the raw payload bytes
     sha2(payload.p, payload.n, nullptr, 0, payload_digest + 32 * i);
@@ -571,6 +602,15 @@ int64_t parse_block(
       Span endorser = field_bytes(fp, flen, 1);
       Span esig = field_bytes(fp, flen, 2);
       put_span(e_endorser_span, ne, blob, endorser);
+      e_uid[ne] = -1;
+      e_dup[ne] = 0;
+      if (endorser.ok) {
+        int32_t uid = intern(endorser.p, endorser.n);
+        if (uid == -2) return -1;
+        e_uid[ne] = uid;
+        for (int64_t k = endo_start[i]; k < ne; k++)
+          if (e_uid[k] == uid) { e_dup[ne] = 1; break; }
+      }
       e_ok[ne] = 0;
       if (endorser.ok && esig.ok &&
           der_sig(esig.p, esig.n, e_r + 32 * ne, e_s + 32 * ne)) {
@@ -586,6 +626,7 @@ int64_t parse_block(
     if (endo_fail) continue;  // slow path sorts out the odd endorsement
     ok[i] = 1;
   }
+  *n_ids_out = next_id;
   return ne;
 }
 

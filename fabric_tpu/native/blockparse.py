@@ -38,6 +38,12 @@ class ParsedBlock:
     e_r: np.ndarray
     e_s: np.ndarray
     e_ok: np.ndarray
+    # block-wide identity interning (creators + endorsers deduped)
+    creator_uid: np.ndarray      # [n] int32; -1 = none
+    e_uid: np.ndarray            # [m] int32
+    e_dup: np.ndarray            # [m] uint8: repeat endorser in its tx
+    ident_span: np.ndarray       # [n_ids, 2]
+    n_ids: int
 
     def span(self, arr: np.ndarray, i: int) -> bytes | None:
         off, ln = int(arr[i, 0]), int(arr[i, 1])
@@ -61,6 +67,7 @@ def parse_envelopes(envs: list[bytes]) -> ParsedBlock | None:
         pos += len(e)
 
     cap = max(8, 8 * n)
+    cap_ids = cap + n
     out = ParsedBlock(
         blob=blob,
         ok=np.zeros(n, np.uint8),
@@ -83,14 +90,20 @@ def parse_envelopes(envs: list[bytes]) -> ParsedBlock | None:
         e_r=np.zeros((cap, 32), np.uint8),
         e_s=np.zeros((cap, 32), np.uint8),
         e_ok=np.zeros(cap, np.uint8),
+        creator_uid=np.full(n, -1, np.int32),
+        e_uid=np.full(cap, -1, np.int32),
+        e_dup=np.zeros(cap, np.uint8),
+        ident_span=np.zeros((cap_ids, 2), np.int64),
+        n_ids=0,
     )
+    n_ids = np.zeros(1, np.int64)
 
     def ptr(a):
         return a.ctypes.data_as(ctypes.c_void_p)
 
     ne = lib.parse_block(
         ctypes.c_char_p(blob), ptr(offs), ptr(lens),
-        ctypes.c_int64(n), ctypes.c_int64(cap),
+        ctypes.c_int64(n), ctypes.c_int64(cap), ctypes.c_int64(cap_ids),
         ptr(out.ok), ptr(out.ch_type),
         ptr(out.txid_span), ptr(out.channel_span), ptr(out.creator_span),
         ptr(out.nonce_span), ptr(out.results_span), ptr(out.events_span),
@@ -99,7 +112,10 @@ def parse_envelopes(envs: list[bytes]) -> ParsedBlock | None:
         ptr(out.endo_start), ptr(out.endo_count),
         ptr(out.e_endorser_span), ptr(out.e_digest), ptr(out.e_r),
         ptr(out.e_s), ptr(out.e_ok),
+        ptr(out.creator_uid), ptr(out.e_uid), ptr(out.e_dup),
+        ptr(out.ident_span), ptr(n_ids),
     )
     if ne < 0:
-        return None  # endorsement capacity exceeded — python path
+        return None  # a capacity was exceeded — python path
+    out.n_ids = int(n_ids[0])
     return out

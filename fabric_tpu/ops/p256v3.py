@@ -488,6 +488,8 @@ def prepare_cols(digest_b, r_b, s_b, qx_res, qy_res, pub_ok,
         except Exception:
             lib = None
         if lib is not None:
+            # the native path needs NO Python bigints at all — the
+            # admission flags, inversion, and windows all come from C
             # one GIL-releasing C call: admission flags + batch
             # inversion + window recoding for the whole batch
             eb = np.ascontiguousarray(digest_b)

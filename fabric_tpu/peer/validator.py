@@ -433,17 +433,43 @@ class BlockValidator:
             "ok": native.ok.tolist(),
             "txid": native.txid_span.tolist(),
             "channel": native.channel_span.tolist(),
-            "creator": native.creator_span.tolist(),
             "txid_digest": [bytes(d).hex() for d in native.txid_digest],
             "creator_sig_ok": native.creator_sig_ok.tolist(),
             "endo_start": native.endo_start.tolist(),
             "endo_count": native.endo_count.tolist(),
-            "e_span": native.e_endorser_span.tolist(),
             "e_ok": native.e_ok.tolist(),
             "c_arrs": (native.payload_digest, native.creator_r,
                        native.creator_s),
             "e_arrs": (native.e_digest, native.e_r, native.e_s),
+            # interned identities: resolved (deserialized + EC-checked)
+            # at most ONCE per distinct cert in the block
+            "creator_uid": native.creator_uid.tolist(),
+            "e_uid": native.e_uid[:].tolist(),
+            "e_dup": native.e_dup.tolist(),
+            "ident_span": native.ident_span,
+            "idents": [None] * native.n_ids,
         }
+
+    def _resolve_uid(self, ctx, uid: int):
+        """uid → (Identity | None, serialized bytes, has_ec_key)."""
+        pool = ctx["idents"]
+        got = pool[uid]
+        if got is None:
+            span = ctx["ident_span"]
+            o, ln = int(span[uid, 0]), int(span[uid, 1])
+            ser = ctx["blob"][o:o + ln]
+            try:
+                ident = self.msp.deserialize_identity(ser)
+            except Exception:
+                got = (None, ser, False)
+            else:
+                try:
+                    ident.public_numbers
+                    got = (ident, ser, True)
+                except Exception:
+                    got = (ident, ser, False)
+            pool[uid] = got
+        return got
 
     def _parse_fast(self, i: int, ctx, txs, items, seen_txids) -> bool:
         """Native-pre-parsed endorser tx → ParsedTx + signature items;
@@ -455,15 +481,12 @@ class BlockValidator:
         txs.append(ptx)
         blob = ctx["blob"]
         to, tl = ctx["txid"][i]
-        co, cl = ctx["creator"][i]
         ho, hl = ctx["channel"][i]
         txid_b = blob[to:to + tl] if to >= 0 else None
-        creator = blob[co:co + cl] if co >= 0 else b""
         ptx.txid = txid_b.decode("utf-8", "replace") if txid_b else ""
         ptx.channel = (
             blob[ho:ho + hl].decode("utf-8", "replace") if ho >= 0 else ""
         )
-        ptx.creator = creator
 
         # txid binding: tx_id == sha256(nonce ‖ creator) hex
         if not ptx.txid or ptx.txid != ctx["txid_digest"][i]:
@@ -474,14 +497,16 @@ class BlockValidator:
             return True
         seen_txids[ptx.txid] = i
 
-        try:
-            ident = self.msp.deserialize_identity(creator)
-        except Exception:
+        cu = ctx["creator_uid"][i]
+        if cu < 0:
             ptx.code = C.BAD_CREATOR_SIGNATURE
             return True
-        try:
-            ident.public_numbers  # EC key required for the batch lane
-        except Exception:
+        ident, ser, has_ec = self._resolve_uid(ctx, cu)
+        ptx.creator = ser
+        if ident is None:
+            ptx.code = C.BAD_CREATOR_SIGNATURE
+            return True
+        if not has_ec:
             if ident.is_valid and not hasattr(ident, "cert"):
                 # idemix creator: unwind and let the Python path do the
                 # host-side proof verification
@@ -497,26 +522,23 @@ class BlockValidator:
 
         # rwset handling is deferred: the native mvcc_prep pass after
         # the envelope loop parses all rwsets in one C call (or the
-        # Python fallback parses per tx) — see _parse
-        seen_endorsers: set[bytes] = set()
-        e_span, e_ok, e_arrs = ctx["e_span"], ctx["e_ok"], ctx["e_arrs"]
-        deserialize = self.msp.deserialize_identity
+        # Python fallback parses per tx) — see _parse.  Endorser dedup
+        # (policy.go:360-363) came from the C interner (e_dup).
+        e_ok, e_arrs = ctx["e_ok"], ctx["e_arrs"]
+        e_uid, e_dup = ctx["e_uid"], ctx["e_dup"]
+        resolve = self._resolve_uid
         base = ctx["endo_start"][i]
         for j in range(base, base + ctx["endo_count"][i]):
-            eo, el = e_span[j]
-            if not e_ok[j] or eo < 0:
-                continue  # unparseable endorsement contributes nothing
-            endorser = blob[eo:eo + el]
-            if endorser in seen_endorsers:
-                continue  # dedup by identity (policy.go:360-363)
-            try:
-                eident = deserialize(endorser)
-                eident.public_numbers  # EC key required
-            except Exception:
+            if not e_ok[j] or e_dup[j]:
+                continue  # unparseable/duplicate contributes nothing
+            uid = e_uid[j]
+            if uid < 0:
                 continue
-            seen_endorsers.add(endorser)
+            eident, eser, ehas_ec = resolve(ctx, uid)
+            if eident is None or not ehas_ec:
+                continue
             ptx.endo_item_idx.append(items.add_fast(e_arrs, j, eident))
-            ptx.endorsements.append((endorser, eident))
+            ptx.endorsements.append((eser, eident))
         return True
 
     # -- the pipeline ------------------------------------------------------
@@ -939,13 +961,14 @@ class BlockValidator:
         updates = batch.updates
         history = []
         blob = rwp.blob
-        w_uid = rwp.w_uid.tolist()
-        w_is_del = rwp.w_is_del.tolist()
-        w_val_span = rwp.w_val_span[:, 0].tolist(), rwp.w_val_span[:, 1].tolist()
-        ns_of = rwp.ns_of_ukey.tolist()
+        nw = rwp.n_writes  # slice REAL rows; the arrays are capacity-sized
+        w_uid = rwp.w_uid[:nw].tolist()
+        w_is_del = rwp.w_is_del[:nw].tolist()
+        vo_l = rwp.w_val_span[:nw, 0].tolist()
+        vl_l = rwp.w_val_span[:nw, 1].tolist()
+        ns_of = rwp.ns_of_ukey[:rwp.n_keys].tolist()
         w_start = rwp.w_start.tolist()
         w_count = rwp.w_count.tolist()
-        vo_l, vl_l = w_val_span
         for ptx in txs:
             if ptx.code != C.VALID:
                 continue
