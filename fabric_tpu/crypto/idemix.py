@@ -38,9 +38,17 @@ import hashlib
 import json
 import secrets
 
-# parameter lengths (bits); l_n is set per issuer
+# parameter lengths (bits); l_n is set per issuer.  The CL soundness
+# analysis needs e to live in a NARROW interval around a large power of
+# two — e ∈ [2^(L_E-1), 2^(L_E-1) + 2^(L_E_PRIME)] — so the Σ-protocol
+# can prove the range: the response is computed over the offset
+# e' = e − 2^(L_E-1), and the verifier's bound on s_e guarantees
+# |e'| < 2^(L_E_PRIME+L_C+L_STAT+2) ≪ 2^(L_E-2), hence e is genuinely
+# huge (no e=1 forgeries).  That requires L_E_PRIME+L_C+L_STAT+2 < L_E-2,
+# which the classic idemix parameter set (l_e=597, l_e'=120) satisfies.
 L_M = 256        # attribute size
-L_E = 120        # prime exponent e
+L_E = 597        # total bit-length of the prime exponent e
+L_E_PRIME = 120  # width of the interval e ranges over
 L_STAT = 80      # statistical hiding slack
 L_C = 256        # Fiat–Shamir challenge
 
@@ -82,6 +90,16 @@ def _is_probable_prime(x: int, rounds: int = 40) -> bool:
 def _gen_prime(bits: int) -> int:
     while True:
         x = _rand_bits(bits) | (1 << (bits - 1)) | 1
+        if _is_probable_prime(x):
+            return x
+
+
+def _gen_cred_exponent() -> int:
+    """A prime in [2^(L_E-1), 2^(L_E-1) + 2^(L_E_PRIME)] — the narrow
+    window the presentation proof's range bound certifies."""
+    base = 1 << (L_E - 1)
+    while True:
+        x = base + (_rand_bits(L_E_PRIME) | 1)
         if _is_probable_prime(x):
             return x
 
@@ -162,7 +180,7 @@ class IdemixIssuer:
                * pow(commitment, -c, ipk.n)) % ipk.n
         if lhs != proof["t"] % ipk.n:
             raise ValueError("bad commitment proof")
-        e = _gen_prime(L_E)
+        e = _gen_cred_exponent()
         v_i = _rand_bits(self.bits + L_STAT)
         m_ou, m_role = _attr_int(ou), _attr_int(role)
         base = (commitment * pow(ipk.S, v_i, ipk.n)
@@ -213,7 +231,11 @@ def sign(ipk: IssuerPublicKey, cred: Credential, msg: bytes) -> bytes:
     A2 = (cred.A * pow(ipk.S, r, n)) % n
     v2 = cred.v - cred.e * r  # integer (may be negative)
 
-    r_e = _rand_bits(L_E + L_C + L_STAT)
+    # the Σ-protocol runs over the OFFSET e' = e − 2^(L_E-1); the
+    # verifier folds the fixed 2^(L_E-1) back in, so the range bound on
+    # s_e pins e to its prime window (no small-exponent forgeries)
+    e_off = cred.e - (1 << (L_E - 1))
+    r_e = _rand_bits(L_E_PRIME + L_C + L_STAT)
     r_v = _rand_bits(n.bit_length() + 2 * L_STAT + L_C + L_E)
     r_sk = _rand_bits(L_M + L_C + L_STAT)
     t = (pow(A2, r_e, n) * pow(ipk.S, r_v, n)
@@ -222,7 +244,7 @@ def sign(ipk: IssuerPublicKey, cred: Credential, msg: bytes) -> bytes:
     c = _fs_challenge(ipk.to_json(), A2, t, cred.ou, cred.role, nonce, msg)
     return json.dumps({
         "A2": hex(A2), "c": hex(c), "nonce": nonce,
-        "s_e": hex(r_e + c * cred.e),
+        "s_e": hex(r_e + c * e_off),
         "s_v": hex(r_v + c * v2) if r_v + c * v2 >= 0
                else "-" + hex(-(r_v + c * v2)),
         "s_sk": hex(r_sk + c * cred.sk),
@@ -248,12 +270,17 @@ def verify(ipk: IssuerPublicKey, ou: str, role: str, msg: bytes,
         nonce = d["nonce"]
         if not (0 < A2 < n):
             return False
-        # soundness range bound on s_e (e must be in its prime range)
-        if s_e >= 1 << (L_E + L_C + L_STAT + 2):
+        # soundness range bound: s_e certifies the OFFSET e' = e−2^(L_E-1),
+        # so extraction yields |e'| < 2^(L_E_PRIME+L_C+L_STAT+2) ≪ 2^(L_E-2)
+        # and e = 2^(L_E-1) + e' is provably in its huge prime window —
+        # an adversary cannot use e=1 (or any small e) because the fixed
+        # A2^(c·2^(L_E-1)) factor below would demand a genuine large-e
+        # root (strong-RSA hard)
+        if not (0 <= s_e < 1 << (L_E_PRIME + L_C + L_STAT + 1)):
             return False
         z_d = (ipk.Z * pow(ipk.R_ou, -_attr_int(ou), n)
                * pow(ipk.R_role, -_attr_int(role), n)) % n
-        t_hat = (pow(A2, s_e, n) * pow(ipk.S, s_v, n)
+        t_hat = (pow(A2, s_e + (c << (L_E - 1)), n) * pow(ipk.S, s_v, n)
                  * pow(ipk.R_sk, s_sk, n) * pow(z_d, -c, n)) % n
         return _fs_challenge(
             ipk.to_json(), A2, t_hat, ou, role, nonce, msg

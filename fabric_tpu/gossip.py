@@ -236,6 +236,19 @@ class GossipService:
             cfg = chan.collection_config(ns, coll) if chan else None
             max_peers = int((cfg or {}).get("max_peer_count", 0) or 0)
             required = int((cfg or {}).get("required_peer_count", 0) or 0)
+            if cfg is not None and max_peers == 0:
+                # maximumPeerCount 0 means NO endorsement-time
+                # dissemination (reconciliation-only delivery), not
+                # "unlimited" (pvtdata/distributor.go contract)
+                if required > 0:
+                    # misconfigured (reference rejects max < required
+                    # at definition time): surface the zero-push risk
+                    log.warning(
+                        "collection %s/%s requires %d peers but "
+                        "max_peer_count=0 disables eager push — "
+                        "skipping dissemination", ns, coll, required,
+                    )
+                continue
             # alive members first (probe liveness); max_peer_count caps
             # SUCCESSFUL deliveries, not attempts — a dead peer must
             # not consume the cap while a live member goes untried

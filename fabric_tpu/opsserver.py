@@ -148,8 +148,8 @@ class OperationsServer:
         """Live profiling surface (the reference's peer.profile pprof
         server, internal/peer/node/start.go:861-876, translated to the
         Python runtime): /debug/stacks dumps every thread's stack;
-        /debug/profile?seconds=N runs cProfile over the live process
-        and returns the cumulative-time report."""
+        /debug/profile?seconds=N runs a wall-clock statistical sampler
+        over every live thread and returns a samples/self table."""
         import sys
         import traceback
         from urllib.parse import parse_qs, urlparse
@@ -169,11 +169,13 @@ class OperationsServer:
             return 200, "text/plain", "\n".join(out).encode()
         if parsed.path == "/debug/profile":
             # NOTE: blocks THIS request for the sampling window; other
-            # connections keep being served (per-connection tasks)
-            import cProfile
-            import io
-            import pstats
-            import time as _time
+            # connections keep being served (per-connection tasks).
+            # A STATISTICAL sampler over sys._current_frames(), not
+            # cProfile: the commit/validate hot path runs in
+            # ThreadPoolExecutor workers, and a tracing profiler
+            # enabled on the event-loop thread would systematically
+            # miss it — the wall-clock sampler sees every thread.
+            import threading
 
             try:
                 seconds = float(
@@ -183,17 +185,53 @@ class OperationsServer:
                 return 400, "application/json", b'{"error": "bad seconds"}'
             seconds = max(0.1, min(seconds, 60.0))
 
-            prof = cProfile.Profile()
-
             async def run():
-                prof.enable()
-                await asyncio.sleep(seconds)
-                prof.disable()
-                buf = io.StringIO()
-                pstats.Stats(prof, stream=buf).sort_stats(
-                    "cumulative"
-                ).print_stats(50)
-                return buf.getvalue()
+                interval = 0.005
+                counts: dict[tuple, int] = {}
+                nsamples = 0
+                names = {}
+                deadline = asyncio.get_event_loop().time() + seconds
+                while asyncio.get_event_loop().time() < deadline:
+                    names = {
+                        t.ident: t.name for t in threading.enumerate()
+                    }
+                    for tid, frame in sys._current_frames().items():
+                        nsamples += 1
+                        # dedupe per stack: a recursive function counts
+                        # ONCE per sample, not once per stack level
+                        stack_keys = set()
+                        f = frame
+                        while f is not None:
+                            co = f.f_code
+                            stack_keys.add(
+                                (names.get(tid, str(tid)),
+                                 co.co_filename, co.co_name, f is frame)
+                            )
+                            f = f.f_back
+                        for key in stack_keys:
+                            counts[key] = counts.get(key, 0) + 1
+                    await asyncio.sleep(interval)
+                lines = [
+                    f"wall-clock samples over {seconds}s "
+                    f"({nsamples} thread-samples, {interval * 1000:.0f}ms "
+                    "interval); 'self' = frame was on top",
+                    f"{'samples':>8} {'self':>6}  location",
+                ]
+                agg: dict[tuple, list] = {}
+                for (tname, fn, func, is_top), cnt in counts.items():
+                    row = agg.setdefault((tname, fn, func), [0, 0])
+                    row[0] += cnt
+                    if is_top:
+                        row[1] += cnt
+                for (tname, fn, func), (tot, self_cnt) in sorted(
+                    agg.items(), key=lambda kv: -kv[1][0]
+                )[:80]:
+                    short = fn.rsplit("/", 1)[-1]
+                    lines.append(
+                        f"{tot:>8} {self_cnt:>6}  "
+                        f"[{tname}] {short}:{func}"
+                    )
+                return "\n".join(lines) + "\n"
 
             return run  # the connection handler awaits coroutine routes
         return 404, "application/json", b'{"error": "not found"}'

@@ -342,9 +342,19 @@ class RaftNode:
             self._on_snap_hint(msg)
 
     def _on_snap_hint(self, msg):
-        if msg["term"] != self.wal.term or msg["snap_index"] <= self.last_applied:
+        # term ordering must not gate the catch-up ACTION: a follower
+        # whose term churned above the leader's (election storms while
+        # partitioned) would otherwise discard the only message kind
+        # the leader sends it (next_index < snap_index ⇒ hints, never
+        # AppendEntries) and keep churning until vote traffic happens
+        # to converge the terms.  Acting on a stale-term hint is safe —
+        # catchup_cb pulls SIGNED blocks and verifies them before
+        # installing — so only the election-timer reset (a leadership
+        # claim) stays term-gated.
+        if msg["snap_index"] <= self.last_applied:
             return
-        self._reset_election_timer()
+        if msg["term"] >= self.wal.term:
+            self._reset_election_timer()
         if self.catchup_cb is not None:
             self.catchup_cb(msg["snap_index"], msg["snap_term"])
 

@@ -70,17 +70,25 @@ async def _mknet(tmp_path, n_peers=2):
         node = PeerNode(f"p{i}", str(tmp_path / f"p{i}"), mgr, signers[i], rt)
         await node.start()
         # collA spans both orgs; collPriv is Org1-only (the eligibility
-        # filter under test); undefined collections disseminate nowhere
+        # filter under test); undefined collections disseminate nowhere.
+        # max_peer_count must be ≥ required_peer_count (the reference
+        # validates this) and 0 means NO endorsement-time push —
+        # reconciliation-only (distributor maximumPeerCount contract)
         prov = PolicyProvider({CC: NamespaceInfo(policy=policy, collections={
             "collA": {"member_orgs": ["Org1MSP", "Org2MSP"],
-                      "required_peer_count": 1, "max_peer_count": 0,
+                      "required_peer_count": 1, "max_peer_count": 2,
                       "btl": 0},
             "collB": {"member_orgs": ["Org1MSP", "Org2MSP"],
-                      "required_peer_count": 0, "max_peer_count": 0,
+                      "required_peer_count": 0, "max_peer_count": 2,
                       "btl": 0},
             "collPriv": {"member_orgs": ["Org1MSP"],
-                         "required_peer_count": 0, "max_peer_count": 0,
+                         "required_peer_count": 0, "max_peer_count": 2,
                          "btl": 0},
+            # pull-only lane: eligible members but max_peer_count 0 —
+            # eager push must SKIP it entirely
+            "collPullOnly": {"member_orgs": ["Org1MSP", "Org2MSP"],
+                             "required_peer_count": 0, "max_peer_count": 0,
+                             "btl": 0},
         })})
         ch = node.join_channel(CHANNEL, prov)
         peers.append(node)
@@ -149,6 +157,40 @@ def test_pvt_distribution_and_pull(tmp_path):
                 )
                 assert hv is not None
                 assert hv.value == hashlib.sha256(b"secret-value").digest()
+
+            # pull-only collection (max_peer_count 0): endorsement-time
+            # push must SKIP it — p1's transient store stays empty for
+            # this txid; the data still arrives post-commit via the
+            # reconciler (reconciliation-only delivery)
+            signed2, tx_id2, prop2 = txa.create_signed_proposal(
+                client, CHANNEL, CC,
+                [b"put_private", b"collPullOnly", b"po-key"],
+                transient={"value": b"po-value"},
+            )
+            cli = RpcClient("127.0.0.1", p0.port)
+            await cli.connect()
+            raw = await cli.unary("Endorse", signed2.SerializeToString())
+            await cli.close()
+            pr2 = proposal_pb2.ProposalResponse()
+            pr2.ParseFromString(raw)
+            assert pr2.response.status == 200, pr2.response.message
+            await asyncio.sleep(1.0)  # window an eager push would use
+            assert not p1.channels[CHANNEL].transient.get(tx_id2)
+
+            env2 = txa.assemble_transaction(prop2, [pr2], client)
+            bc = BroadcastClient([("127.0.0.1", orderer.port)])
+            res = await bc.broadcast(CHANNEL, env2.SerializeToString())
+            assert res["status"] == 200
+            await bc.close()
+
+            def committed_po(p):
+                vv = p.channels[CHANNEL].ledger.state.get_state(
+                    f"{CC}$collPullOnly", "po-key"
+                )
+                return vv is not None and vv.value == b"po-value"
+
+            assert await _wait(lambda: committed_po(p0), 20)
+            assert await _wait(lambda: committed_po(p1), 25)
         finally:
             for p in peers:
                 await p.stop()

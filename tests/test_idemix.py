@@ -63,6 +63,46 @@ def test_forgery_without_credential_rejected(setup):
     assert not idemix.verify(ipk, "org1", "client", b"msg", bytes(good))
 
 
+def test_small_exponent_forgery_rejected(setup):
+    """Regression: with no lower-bound range proof on e, an attacker
+    knowing only the issuer public key could pick e=1, random (sk, v),
+    set A2 = z_d·S^-v·R_sk^-sk (no e-th root needed when e=1) and run
+    the honest Schnorr proof — a universal forgery.  The offset form
+    (responses over e' = e−2^(L_E-1), verifier folds A2^(c·2^(L_E-1))
+    into t_hat, tight bound on s_e) must kill it."""
+    ipk = setup["issuer"].ipk
+    n = ipk.n
+    sk = idemix._rand_bits(idemix.L_M)
+    v = idemix._rand_bits(n.bit_length())
+    ou, role = "org1", "admin"   # any attributes, no credential held
+    z_d = (ipk.Z * pow(ipk.R_ou, -idemix._attr_int(ou), n)
+           * pow(ipk.R_role, -idemix._attr_int(role), n)) % n
+    A2 = (z_d * pow(ipk.S, -v, n) * pow(ipk.R_sk, -sk, n)) % n
+    # A2^1 · S^v · R_sk^sk == z_d holds; run the honest Σ-protocol
+    # exactly as the pre-fix signer did (responses over e itself)
+    import secrets as _secrets
+    r_e = idemix._rand_bits(idemix.L_E_PRIME + idemix.L_C + idemix.L_STAT)
+    r_v = idemix._rand_bits(n.bit_length() + 2 * idemix.L_STAT
+                            + idemix.L_C + idemix.L_E)
+    r_sk = idemix._rand_bits(idemix.L_M + idemix.L_C + idemix.L_STAT)
+    t = (pow(A2, r_e, n) * pow(ipk.S, r_v, n) * pow(ipk.R_sk, r_sk, n)) % n
+    nonce = _secrets.token_hex(16)
+    c = idemix._fs_challenge(ipk.to_json(), A2, t, ou, role, nonce, b"msg")
+    sig = json.dumps({
+        "A2": hex(A2), "c": hex(c), "nonce": nonce,
+        "s_e": hex(r_e + c * 1),       # e = 1
+        "s_v": hex(r_v + c * v),
+        "s_sk": hex(r_sk + c * sk),
+    }).encode()
+    assert not idemix.verify(ipk, ou, role, b"msg", sig)
+    # and the signer path itself cannot launder a small-e credential:
+    # sign() computes responses over e−2^(L_E-1), which for e=1 drives
+    # s_e negative → rejected by the range check
+    fake = idemix.Credential(A=A2, e=1, v=v, sk=sk, ou=ou, role=role)
+    sig2 = idemix.sign(ipk, fake, b"msg")
+    assert not idemix.verify(ipk, ou, role, b"msg", sig2)
+
+
 def test_issuer_rejects_bad_commitment_proof(setup):
     issuer = setup["issuer"]
     holder = idemix.IdemixHolder(issuer.ipk)

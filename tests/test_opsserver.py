@@ -113,7 +113,11 @@ def test_debug_profiling_surface():
                 None, get, "/debug/profile?seconds=0.2"
             )
             assert status == 200
-            assert b"cumulative" in body
+            assert b"wall-clock samples" in body
+            # the sampler must see OTHER threads, not just the event
+            # loop — this request itself runs in an executor worker
+            # blocked in urlopen, so a worker thread must appear
+            assert b"ThreadPoolExecutor" in body or b"asyncio" in body
         finally:
             await srv.stop()
 
