@@ -10,6 +10,12 @@ class HistoryDB:
     def __init__(self, path: str):
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
+        # history is DERIVED state: the kvledger recovery path replays
+        # it from stored blocks (savepoint-gated), so a lost WAL tail
+        # on crash self-heals — no per-commit fsync.  NORMAL, not OFF:
+        # OFF can corrupt the DB file itself on power loss, and there
+        # is no drop-and-rebuild path on open
+        self._conn.execute("PRAGMA synchronous=NORMAL")
         self._conn.execute(
             "CREATE TABLE IF NOT EXISTS hist ("
             " ns TEXT, key TEXT, block INTEGER, txnum INTEGER,"
@@ -22,13 +28,13 @@ class HistoryDB:
 
     def commit_block(self, block_num: int, writes: list[tuple[str, str, int]]):
         """writes: [(ns, key, txnum)] for VALID txs of the block."""
-        cur = self._conn.cursor()
-        for ns, key, txnum in writes:
-            cur.execute(
-                "INSERT OR REPLACE INTO hist VALUES (?,?,?,?)",
-                (ns, key, block_num, txnum),
-            )
-        cur.execute("INSERT OR REPLACE INTO savepoint VALUES (0,?)", (block_num,))
+        self._conn.executemany(
+            "INSERT OR REPLACE INTO hist VALUES (?,?,?,?)",
+            [(ns, key, block_num, txnum) for ns, key, txnum in writes],
+        )
+        self._conn.execute(
+            "INSERT OR REPLACE INTO savepoint VALUES (0,?)", (block_num,)
+        )
         self._conn.commit()
 
     def get_history_for_key(self, ns: str, key: str):

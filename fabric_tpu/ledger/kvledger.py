@@ -100,6 +100,15 @@ class KVLedger:
         self.blocks.add_block(block, txids=txids)
         if pvt_data:
             self.pvtdata.commit_block(num, pvt_data)
+        if getattr(self.state, "durable", True):
+            # a DURABLE state savepoint must never get ahead of the
+            # block files (recover() replays forward from the
+            # savepoint; a savepoint past a crash-truncated store
+            # would skip replay and fork the peer) — close the group
+            # window before the state commit.  Non-durable backends
+            # (mem) recover by full replay, so they keep the
+            # amortized-fsync fast path.
+            self.blocks.sync()
         self.state.apply_updates(batch, (num, 0))
         if self.history is not None and history_writes:
             self.history.commit_block(num, history_writes)

@@ -55,6 +55,12 @@ class UpdateBatch:
 class VersionedDB:
     """SPI (statedb.go:36-76)."""
 
+    # True when the backend persists across process crashes — the
+    # kvledger uses this to keep the block store's durability AHEAD of
+    # the state savepoint (a durable savepoint past the block files
+    # would break crash recovery's replay-forward assumption)
+    durable: bool = True
+
     def open(self) -> None: ...
     def close(self) -> None: ...
 
@@ -104,6 +110,8 @@ class MemVersionedDB(VersionedDB):
     per-key read SEMANTICS under that overlap are handled by the
     validator's overlay, the lock only guards the dict/cache
     iteration itself."""
+
+    durable = False  # dies with the process: always replay-recovered
 
     def __init__(self):
         import threading

@@ -63,9 +63,8 @@ def mvcc_validate(
     / `phantom` distinguish MVCC_READ_CONFLICT from
     PHANTOM_READ_CONFLICT for the TRANSACTIONS_FILTER codes.
     """
-    T = read_keys.shape[0]
-
-    # --- per-read version check vs committed state (parallel over all)
+    # per-read version check vs committed state (parallel over all);
+    # the conflict matrices + fixpoint live in mvcc_validate_hostver
     pad = read_keys < 0
     ver_eq = jnp.all(read_vers == comm_vers, axis=-1)
     ok = jnp.where(
@@ -73,15 +72,39 @@ def mvcc_validate(
         ver_eq,
         read_present == comm_present,  # both absent ok; presence flip = stale
     )
-    ver_ok = jnp.all(ok | pad, axis=-1) & pre_ok  # [T]
+    ver_ok = jnp.all(ok | pad, axis=-1)  # [T]
+    return mvcc_validate_hostver(
+        read_keys, ver_ok, write_keys, rq_lo, rq_hi, pre_ok
+    )
 
-    # --- [T, T] conflict matrices (reader j vs writer i, strict i < j)
-    w_valid = (write_keys >= 0)[None, :, None, :]  # [1, Ti, 1, W]
-    r_valid = (read_keys >= 0)[:, None, :, None]   # [Tj, 1, R, 1]
+
+mvcc_validate_jit = jax.jit(mvcc_validate)
+
+
+def mvcc_validate_hostver(
+    read_keys,      # [T, R] int32 block-local key ids; -1 = padding
+    ver_ok_host,    # [T] bool: per-tx committed-version check, HOST-side
+    write_keys,     # [T, W] int32 block-local key ids; -1 = padding
+    rq_lo,          # [T, Q] int32 range-query id interval start; -1 = pad
+    rq_hi,          # [T, Q] int32 exclusive interval end
+    pre_ok,         # [T] bool: upstream validity (sigs, policy, structure)
+):
+    """``mvcc_validate`` with the per-read committed-version compare
+    done on HOST (StaticBlock.host_ver_ok): the compare is elementwise
+    and state-dependent, so shipping the committed presence/version
+    arrays to the device bought nothing but two launch-time H2D
+    transfers over a latency-bound tunnel.  The device keeps what it is
+    uniquely good at — the [T,T] conflict matrices and the validity
+    fixpoint (validator.go:81-118's serial loop, reformulated)."""
+    T = read_keys.shape[0]
+    ver_ok = ver_ok_host & pre_ok
+
+    w_valid = (write_keys >= 0)[None, :, None, :]
+    r_valid = (read_keys >= 0)[:, None, :, None]
     eq = (
         read_keys[:, None, :, None] == write_keys[None, :, None, :]
     ) & w_valid & r_valid
-    direct = jnp.any(eq, axis=(2, 3))  # [Tj, Ti]
+    direct = jnp.any(eq, axis=(2, 3))
 
     q_valid = (rq_lo >= 0)[:, None, :, None]
     in_range = (
@@ -89,17 +112,16 @@ def mvcc_validate(
         & (write_keys[None, :, None, :] < rq_hi[:, None, :, None])
         & w_valid & q_valid
     )
-    phantom_m = jnp.any(in_range, axis=(2, 3))  # [Tj, Ti]
+    phantom_m = jnp.any(in_range, axis=(2, 3))
 
-    order = jnp.tril(jnp.ones((T, T), jnp.bool_), k=-1)  # [j, i] with i < j
+    order = jnp.tril(jnp.ones((T, T), jnp.bool_), k=-1)
     direct = direct & order
     phantom_m = phantom_m & order
     conflict_m = (direct | phantom_m).astype(jnp.float32)
 
-    # --- fixpoint: valid[j] = ver_ok[j] ∧ ¬∃i<j valid[i] ∧ conflict[j,i]
     def body(state):
         v, _, it = state
-        hit = conflict_m @ v.astype(jnp.float32) > 0  # [T] matvec (MXU)
+        hit = conflict_m @ v.astype(jnp.float32) > 0
         return ver_ok & ~hit, v, it + 1
 
     def cond(state):
@@ -112,9 +134,6 @@ def mvcc_validate(
     conflict = (direct.astype(jnp.float32) @ vf > 0) & ver_ok
     phantom = (phantom_m.astype(jnp.float32) @ vf > 0) & ver_ok
     return valid, conflict, phantom
-
-
-mvcc_validate_jit = jax.jit(mvcc_validate)
 
 
 # ---------------------------------------------------------------------------
@@ -194,6 +213,33 @@ class StaticBlock:
             a[0], a[1], a[2], jnp.asarray(comm_present),
             jnp.asarray(comm_vers), a[3], a[4], a[5],
         )
+
+    def host_ver_ok(self, committed: dict) -> np.ndarray:
+        """[T] bool: the per-read committed-version compare of
+        ``mvcc_validate`` done on host numpy — bit-identical to the
+        kernel's reduction (validateKVRead semantics: version equality
+        when both present, presence flip = stale, padding inert)."""
+        comm_present, comm_vers = self.fill_committed(committed)
+        pad = self.read_keys < 0
+        ver_eq = (self.read_vers == comm_vers).all(axis=-1)
+        ok = np.where(
+            self.read_present & comm_present,
+            ver_eq,
+            self.read_present == comm_present,
+        )
+        return np.logical_or(ok, pad).all(axis=-1)
+
+    def device_args_hostver(self, committed: dict):
+        """`mvcc_validate_hostver` argument tuple (minus pre_ok):
+        static uploaded arrays + the ONE state-dependent [T] bool."""
+        return self.device_args_verok(self.host_ver_ok(committed))
+
+    def device_args_verok(self, ver_ok: np.ndarray):
+        """`mvcc_validate_hostver` args from an already-computed [T]
+        host version check."""
+        self.upload()
+        a = self._jnp
+        return (a[0], jnp.asarray(ver_ok), a[3], a[4], a[5])
 
 
 def prepare_block_static(txs: list[TxRWSet], bucketed: bool = False) -> StaticBlock:
@@ -280,6 +326,7 @@ class VecStaticBlock(StaticBlock):
     r_cols: np.ndarray = None   # [nr] slot per flat read
     r_uid: np.ndarray = None    # [nr] unique-key id per flat read
     u_composite: list = None    # [n_keys] composite mvcc keys
+    u_pairs: list = None        # [n_keys] (ns, key) pairs (validator)
 
     def fill_committed(self, committed: dict):
         U = len(self.u_composite)
@@ -297,6 +344,23 @@ class VecStaticBlock(StaticBlock):
             comm_present[self.r_rows, self.r_cols] = up[self.r_uid]
             comm_vers[self.r_rows, self.r_cols] = uv[self.r_uid]
         return comm_present, comm_vers
+
+    def ver_ok_from_u(self, up: np.ndarray, uv: np.ndarray) -> np.ndarray:
+        """[T] bool from per-UNIQUE-key committed (present, version)
+        arrays — the flat path's host-side validateKVRead reduction
+        (no [T,R] scatter, no composite-key dict)."""
+        Tb = self.read_keys.shape[0]
+        if not len(self.r_rows):
+            return np.ones(Tb, bool)
+        rp = self.read_present[self.r_rows, self.r_cols]
+        rv = self.read_vers[self.r_rows, self.r_cols]
+        cp = up[self.r_uid]
+        ver_eq = (rv == uv[self.r_uid]).all(axis=1)
+        okr = np.where(rp & cp, ver_eq, rp == cp)
+        bad_per_tx = np.bincount(
+            self.r_rows[~okr], minlength=Tb
+        )
+        return bad_per_tx == 0
 
 
 def prepare_block_from_flat(n_txs: int, rwp, composite_keys: list) -> VecStaticBlock:

@@ -404,6 +404,84 @@ class SigCollector:
         return out
 
 
+class ColumnarSigBatch:
+    """A signature batch ALREADY in column form — the validator's
+    fully vectorized fast path assembles digest/r/s byte columns and
+    per-identity cached pubkey residues straight from the native
+    pre-parser's arrays with numpy gathers, so no per-item Python runs
+    at all.  Slow rows (config-tx creators, host fallbacks) append as
+    legacy int tuples after the fast block."""
+
+    __slots__ = ("digest_b", "r_b", "s_b", "qx_res", "qy_res",
+                 "pub_ok", "slow", "n_fast", "ident_of", "idents")
+
+    def __init__(self, digest_b, r_b, s_b, qx_res, qy_res, pub_ok,
+                 ident_of=None, idents=None):
+        self.digest_b, self.r_b, self.s_b = digest_b, r_b, s_b
+        self.qx_res, self.qy_res, self.pub_ok = qx_res, qy_res, pub_ok
+        self.slow = []
+        self.n_fast = len(digest_b)
+        # per-fast-item identity (uid array + pool) — only for the
+        # v1/v2 tuples() compatibility path
+        self.ident_of = ident_of
+        self.idents = idents
+
+    @property
+    def n(self) -> int:
+        return self.n_fast + len(self.slow)
+
+    def __len__(self) -> int:
+        return self.n
+
+    def add_slow(self, item) -> int:
+        pos = self.n
+        self.slow.append(item)
+        return pos
+
+    def assemble(self):
+        """→ the six prepare_cols arrays with slow rows appended."""
+        if not self.slow:
+            return (self.digest_b, self.r_b, self.s_b,
+                    self.qx_res, self.qy_res, self.pub_ok)
+        k = len(self.slow)
+        pad = lambda a: np.concatenate(
+            [a, np.zeros((k,) + a.shape[1:], a.dtype)]
+        )
+        digest_b, r_b, s_b = (pad(self.digest_b), pad(self.r_b),
+                              pad(self.s_b))
+        qx_res, qy_res = pad(self.qx_res), pad(self.qy_res)
+        pub_ok = pad(self.pub_ok)
+        for j, (e, r, s, qx, qy) in enumerate(self.slow):
+            pos = self.n_fast + j
+            if not (0 <= r < (1 << 256) and 0 <= s < (1 << 256)):
+                continue  # row stays zero, pub_ok False (reject)
+            digest_b[pos] = np.frombuffer(int(e).to_bytes(32, "big"), np.uint8)
+            r_b[pos] = np.frombuffer(int(r).to_bytes(32, "big"), np.uint8)
+            s_b[pos] = np.frombuffer(int(s).to_bytes(32, "big"), np.uint8)
+            res = rns.ints_to_rns([qx, qy])
+            qx_res[pos], qy_res[pos] = res[0], res[1]
+            pub_ok[pos] = (
+                0 <= qx < P and 0 <= qy < P and not (qx == 0 and qy == 0)
+            )
+        return digest_b, r_b, s_b, qx_res, qy_res, pub_ok
+
+    def tuples(self) -> list:
+        """Legacy int-tuple form (v1/v2 comparison kernels only);
+        pubkey ints come from the identity pool, not the residues."""
+        out = []
+        for i in range(self.n_fast):
+            ident = self.idents[int(self.ident_of[i])]
+            qx, qy = ident.public_numbers
+            out.append((
+                int.from_bytes(bytes(self.digest_b[i]), "big"),
+                int.from_bytes(bytes(self.r_b[i]), "big"),
+                int.from_bytes(bytes(self.s_b[i]), "big"),
+                qx, qy,
+            ))
+        out.extend(self.slow)
+        return out
+
+
 def _assemble_cols(c: SigCollector):
     """SigCollector → (digest_b, r_b, s_b [B,32] u8; qx_res, qy_res
     [B,2n] i32; pub_ok [B] bool)."""
@@ -569,6 +647,15 @@ def verify_launch(items) -> VerifyHandle:
 
     Accepts either legacy (digest, r, s, qx, qy) int tuples or a
     SigCollector (the commit path's zero-bigint column form)."""
+    if isinstance(items, ColumnarSigBatch):
+        if not items.n:
+            return VerifyHandle(jnp.zeros((0,), bool), 0)
+        n_real = items.n
+        args = prepare_cols(*items.assemble(), pad_to=_bucket(n_real))
+        out = verify_batch_jit(*args)
+        if hasattr(out, "copy_to_host_async"):
+            out.copy_to_host_async()
+        return VerifyHandle(out, n_real)
     if isinstance(items, SigCollector):
         if not items.n:
             return VerifyHandle(jnp.zeros((0,), bool), 0)

@@ -121,7 +121,9 @@ def build_stage2(t_bucket: int, n_sig: int, group_sigs: tuple):
         policy_ok = policy_ok[:t_bucket].astype(bool)
 
         pre_ok = structural_ok & creator_ok & policy_ok
-        valid, conflict, phantom = mvcc_ops.mvcc_validate(*mvcc_arrays, pre_ok)
+        valid, conflict, phantom = mvcc_ops.mvcc_validate_hostver(
+            *mvcc_arrays, pre_ok
+        )
 
         parts = [valid, conflict, phantom, creator_ok, policy_ok, sig_valid]
         parts.extend(safes)

@@ -277,6 +277,12 @@ class PeerChannel:
         reg.counter(
             "ledger_transaction_count", "committed txs by validity"
         ).add(len(flt) - n_valid, channel=self.id, status="invalid")
+        # clients key retries off commit acknowledgment: force any open
+        # group-commit fsync window closed BEFORE signalling height /
+        # commit status, so an acknowledged block can never be lost to
+        # a crash on a quiet channel (the add-block-time lag check
+        # only runs while traffic flows)
+        self.ledger.blocks.sync()
         self._height_changed.set()
         self._height_changed = asyncio.Event()
         return flt

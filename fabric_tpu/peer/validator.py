@@ -157,10 +157,50 @@ class PendingBlock:
     overlay: object = None  # predecessor UpdateBatch (in-flight commit)
     fetch2: object = None   # stage-2 packed fetch, set by _launch_device
     range_phantom: frozenset = frozenset()  # tx idxs failing range re-exec
+    fb: object = None       # _FastBlock of a columnar parse, or None
 
     @property
     def txids(self) -> set:
         return {ptx.txid for ptx in self.txs if ptx.txid}
+
+
+@dataclass
+class _FastBlock:
+    """Array-form block state for the fully vectorized (columnar)
+    parse: everything the device-path stages need, with NO per-tx
+    Python objects on the hot path.  ParsedTx objects still exist for
+    the slow lanes and post-commit consumers, but their endorsement
+    lists / namespaces are only materialized on demand
+    (_materialize_for_host)."""
+
+    native: object            # blockparse.ParsedBlock
+    codes: object             # [n] int32 LIVE codes (synced with ptx)
+    is_config: object         # [n] bool
+    c_ok: object              # [n] bool: eligible columnar endorser txs
+    creator_item: object      # [n] int64 global sig-item idx; -1 none
+    uid_mat: object           # [n, S] int64 pool row (uid+1); 0 = pad
+    endo_idx_mat: object      # [n, S] int32 global item idx; -1 = pad
+    ecnt: object              # [n] included endorsement count
+    idents: list              # uid → Identity | None
+    sers: list                # uid → serialized identity bytes
+    has_ec: object            # [n_ids+1] bool
+    fallback_idx: list        # envelope indices parsed on the py path
+    materialized: bool = False
+
+
+class _SlowItems:
+    """add_slow shim for fallback envelopes inside the columnar parse:
+    collects legacy tuples; positions are LOCAL and get rebased past
+    the fast block once its size is known."""
+
+    __slots__ = ("slow",)
+
+    def __init__(self):
+        self.slow = []
+
+    def add_slow(self, item) -> int:
+        self.slow.append(item)
+        return len(self.slow) - 1
 
 
 @dataclass
@@ -249,6 +289,10 @@ class BlockValidator:
                 native = nbp.parse_envelopes(list(block.data.data))
             except Exception:
                 native = None
+        if native is not None:
+            out = self._parse_columnar(block, native)
+            if out is not None:
+                return out
         fast_ctx = self._fast_ctx(native) if native is not None else None
         for i, env_bytes in enumerate(block.data.data):
             if fast_ctx is not None and fast_ctx["ok"][i]:
@@ -256,123 +300,7 @@ class BlockValidator:
                     continue
                 # fast path bowed out (e.g. an idemix creator whose
                 # proof is not a DER signature): python path below
-            ptx = ParsedTx(idx=i)
-            txs.append(ptx)
-            if not env_bytes:
-                ptx.code = C.NIL_ENVELOPE
-                continue
-            try:
-                env = protoutil.unmarshal(common_pb2.Envelope, env_bytes)
-                payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
-                ch = protoutil.unmarshal(
-                    common_pb2.ChannelHeader, payload.header.channel_header
-                )
-                sh = protoutil.unmarshal(
-                    common_pb2.SignatureHeader, payload.header.signature_header
-                )
-            except Exception:
-                ptx.code = C.BAD_PAYLOAD
-                continue
-            ptx.txid, ptx.channel, ptx.creator = ch.tx_id, ch.channel_id, sh.creator
-
-            if ch.type == common_pb2.HeaderType.CONFIG:
-                # config txs go to the config machinery, not the
-                # endorsement pipeline (v20/validator.go:397-419): the
-                # creator signature still rides the block's signature
-                # batch; structure + policy checks happen in
-                # _validate_config after phase 1a.
-                ptx.is_config = True
-                if block.header.number == 0:
-                    continue  # genesis: trust anchor, no creator check
-                try:
-                    ident = self.msp.deserialize_identity(sh.creator)
-                    if not ident.is_valid:
-                        raise ValueError("invalid creator identity")
-                    item = _sig_item(ident, env.payload, env.signature)
-                except Exception:
-                    ptx.code = C.BAD_CREATOR_SIGNATURE
-                    continue
-                ptx.creator_item_idx = items.add_slow(item)
-                continue
-            if ch.type != common_pb2.HeaderType.ENDORSER_TRANSACTION:
-                ptx.code = C.UNKNOWN_TX_TYPE
-                continue
-            # txid binding: tx_id must equal sha256(nonce ‖ creator) —
-            # prevents txid squatting / DUPLICATE_TXID poisoning
-            # (protoutil/proputils.go:362 CheckTxID)
-            if not ch.tx_id or ch.tx_id != protoutil.compute_tx_id(
-                sh.nonce, sh.creator
-            ):
-                ptx.code = C.BAD_PROPOSAL_TXID
-                continue
-            # dup txid in-block (v20/validator.go:460-481); the
-            # vs-ledger check happens in validate() — preprocess() must
-            # be runnable BEFORE the previous block commits (pipeline)
-            if ch.tx_id in seen_txids:
-                ptx.code = C.DUPLICATE_TXID
-                continue
-            seen_txids[ch.tx_id] = i
-
-            # creator: deserializable, valid cert, sig over payload
-            try:
-                ident = self.msp.deserialize_identity(sh.creator)
-            except Exception:
-                ptx.code = C.BAD_CREATOR_SIGNATURE
-                continue
-            if not ident.is_valid:
-                ptx.code = C.BAD_CREATOR_SIGNATURE
-                continue
-            item = None
-            try:
-                item = _sig_item(ident, env.payload, env.signature)
-            except Exception:
-                # identities without an EC public key (idemix anonymous
-                # creators, msp/idemix.go) verify HOST-side: each
-                # signature is a zero-knowledge presentation proof the
-                # batch kernel has no lane for
-                host_ok = False
-                if ident.is_valid and not hasattr(ident, "cert"):
-                    try:
-                        host_ok = ident.verify(env.payload, env.signature)
-                    except Exception:
-                        host_ok = False
-                if not host_ok:
-                    ptx.code = C.BAD_CREATOR_SIGNATURE
-                    continue
-                ptx.host_creator_ok = True
-            if item is not None:
-                ptx.creator_item_idx = items.add_slow(item)
-
-            # endorsements + rwset
-            try:
-                _, _, cap, prp, cca = protoutil.extract_action(
-                    env, parsed=(payload, ch, sh)
-                )
-                ptx.rwset = TxRWSet.from_bytes(cca.results)
-                ptx.namespaces = tuple(sorted(ptx.rwset.ns))
-                prp_bytes = cap.action.proposal_response_payload
-                seen_endorsers: set[bytes] = set()
-                for e in cap.action.endorsements:
-                    # dedup by identity: a repeated endorser counts as
-                    # ONE signature toward the policy (reference
-                    # SignatureSetToValidIdentities,
-                    # common/policies/policy.go:360-363)
-                    if e.endorser in seen_endorsers:
-                        continue
-                    try:
-                        eident = self.msp.deserialize_identity(e.endorser)
-                        eitem = _sig_item(eident, prp_bytes + e.endorser, e.signature)
-                    except Exception:
-                        continue  # unparseable endorsement: contributes nothing
-                    seen_endorsers.add(e.endorser)
-                    ptx.endo_item_idx.append(items.add_slow(eitem))
-                    ptx.endorsements.append((e.endorser, eident))
-            except protoutil.TxParseError as e:
-                ptx.code = e.code
-                continue
-            except Exception:
-                ptx.code = C.BAD_RWSET
-                continue
+            self._parse_one_py(i, env_bytes, block, txs, items, seen_txids)
 
         # rwsets of native-fast endorser txs: ONE C call parses, interns
         # keys, and emits flat arrays; txs it cannot cover (ranges,
@@ -410,7 +338,394 @@ class BlockValidator:
                         )
                     else:
                         self._py_rwset(ptx, native)
-        return txs, items, rwp
+        return txs, items, rwp, None
+
+    def _parse_columnar(self, block, native):
+        """Fully vectorized parse of a native-pre-parsed block: the
+        per-tx Python loop of ``_parse`` becomes numpy over the C++
+        parser's arrays — txid binding is a [k,64] hex compare, in-block
+        dup detection a row-unique, the signature batch a set of column
+        gathers, and the policy-group inputs scatter into [n,S]
+        matrices.  Identities resolve ONCE per distinct cert.
+
+        Envelopes the columnar lane cannot carry (config txs, idemix
+        creators, malformed bytes) run through ``_parse_one_py`` in
+        block order, sharing the dup registry.  Returns None when no
+        envelope qualifies (the legacy loop takes over)."""
+        from fabric_tpu.ops.p256v3 import ColumnarSigBatch
+        from fabric_tpu.utils.batching import next_pow2
+
+        n = len(block.data.data)
+        blob = native.blob
+        n_ids = native.n_ids
+        NOTV = int(C.NOT_VALIDATED)
+
+        # -- interned identity resolution (once per distinct cert) ----
+        idents: list = [None] * n_ids
+        sers: list = [None] * n_ids
+        known = np.zeros(n_ids + 1, bool)
+        ivalid = np.zeros(n_ids + 1, bool)
+        has_ec = np.zeros(n_ids + 1, bool)
+        idemix_like = np.zeros(n_ids + 1, bool)
+        span = native.ident_span
+        for u in range(n_ids):
+            o, ln = int(span[u, 0]), int(span[u, 1])
+            ser = blob[o:o + ln]
+            sers[u] = ser
+            try:
+                ident = self.msp.deserialize_identity(ser)
+            except Exception:
+                continue
+            idents[u] = ident
+            known[u] = True
+            ivalid[u] = bool(ident.is_valid)
+            try:
+                ident.public_numbers
+                ident.rns_pub
+                has_ec[u] = True
+            except Exception:
+                idemix_like[u] = ivalid[u] and not hasattr(ident, "cert")
+
+        ok = native.ok.astype(bool)
+        cu = native.creator_uid.astype(np.int64)
+        cu_valid = cu >= 0
+        cuc = np.where(cu_valid, cu, n_ids)
+        fallback = ~ok | (cu_valid & idemix_like[cuc])
+        columnar = ~fallback
+        if not columnar.any():
+            return None
+
+        # -- txid binding: tx_id must equal hex(sha256(nonce‖creator))
+        t_off = native.txid_span[:, 0]
+        t_len = native.txid_span[:, 1]
+        blob_u8 = np.frombuffer(blob, np.uint8)
+        cand = columnar & (t_off >= 0) & (t_len == 64)
+        bind_ok = np.zeros(n, bool)
+        crows = np.flatnonzero(cand)
+        if len(crows):
+            txh = blob_u8[t_off[crows][:, None] + np.arange(64)[None, :]]
+            dg = native.txid_digest[crows]
+            hi, lo = dg >> 4, dg & 15
+            hx = np.empty((len(crows), 64), np.uint8)
+            hx[:, 0::2] = np.where(hi < 10, hi + 48, hi + 87)
+            hx[:, 1::2] = np.where(lo < 10, lo + 48, lo + 87)
+            bind_ok[crows] = (txh == hx).all(axis=1)
+
+        # decoded txid strings (ledger index + dup-vs-ledger checks)
+        txid_strs = [""] * n
+        off_l, len_l = t_off.tolist(), t_len.tolist()
+        for i in np.flatnonzero(columnar & (t_off >= 0)).tolist():
+            txid_strs[i] = blob[off_l[i]:off_l[i] + len_l[i]].decode(
+                "utf-8", "replace"
+            )
+
+        # -- duplicate txids + fallback envelopes (block order) -------
+        codes = np.full(n, NOTV, np.int32)
+        dup = np.zeros(n, bool)
+        fb_txs: dict[int, ParsedTx] = {}
+        shim = _SlowItems()
+        fallback_idx = np.flatnonzero(fallback).tolist()
+        if not fallback_idx:
+            brows = np.flatnonzero(bind_ok)
+            if len(brows) > 1:
+                keys = blob_u8[t_off[brows][:, None] + np.arange(64)[None, :]]
+                _, first = np.unique(keys, axis=0, return_index=True)
+                d = np.ones(len(brows), bool)
+                d[first] = False
+                dup[brows] = d
+        else:
+            # mixed block: interleave fallback parsing with columnar
+            # txid claims in envelope order so dup semantics match the
+            # serial path exactly
+            seen: dict[str, int] = {}
+            fall_l = fallback.tolist()
+            bind_l = bind_ok.tolist()
+            data = block.data.data
+            for i in range(n):
+                if fall_l[i]:
+                    sub: list = []
+                    self._parse_one_py(i, data[i], block, sub, shim, seen)
+                    fb_txs[i] = sub[0]
+                elif bind_l[i]:
+                    t = txid_strs[i]
+                    if t in seen:
+                        dup[i] = True
+                    else:
+                        seen[t] = i
+
+        codes[columnar & ~bind_ok] = int(C.BAD_PROPOSAL_TXID)
+        codes[dup] = int(C.DUPLICATE_TXID)
+        live = columnar & bind_ok & ~dup
+        csig = native.creator_sig_ok.astype(bool)
+        c_ok = live & cu_valid & known[cuc] & ivalid[cuc] & has_ec[cuc] & csig
+        codes[live & ~c_ok] = int(C.BAD_CREATOR_SIGNATURE)
+
+        # -- signature batch: column gathers, zero per-item Python ----
+        m = int(native.endo_count[:n].sum())
+        tx_of_e = np.repeat(np.arange(n), native.endo_count[:n])
+        e_ok_m = native.e_ok[:m].astype(bool) & (native.e_dup[:m] == 0)
+        eu = native.e_uid[:m].astype(np.int64)
+        eu_valid = eu >= 0
+        euc = np.where(eu_valid, eu, n_ids)
+        mask_e = c_ok[tx_of_e] & e_ok_m & eu_valid & known[euc] & has_ec[euc]
+
+        c_rows = np.flatnonzero(c_ok)
+        nc = len(c_rows)
+        creator_item = np.full(n, -1, np.int64)
+        creator_item[c_rows] = np.arange(nc)
+        e_rows = np.flatnonzero(mask_e)
+        ne = len(e_rows)
+        e_item = np.full(m, -1, np.int64)
+        e_item[e_rows] = nc + np.arange(ne)
+
+        from fabric_tpu.ops import rns
+
+        qx_pool = np.zeros((n_ids + 1, 2 * rns.N_CH), np.int32)
+        qy_pool = np.zeros((n_ids + 1, 2 * rns.N_CH), np.int32)
+        for u in range(n_ids):
+            if has_ec[u]:
+                a, b = idents[u].rns_pub
+                qx_pool[u], qy_pool[u] = a, b
+
+        digest_b = np.concatenate(
+            [native.payload_digest[c_rows], native.e_digest[:m][e_rows]]
+        )
+        r_b = np.concatenate(
+            [native.creator_r[c_rows], native.e_r[:m][e_rows]]
+        )
+        s_b = np.concatenate(
+            [native.creator_s[c_rows], native.e_s[:m][e_rows]]
+        )
+        uid_items = np.concatenate([cu[c_rows], eu[e_rows]])
+        items = ColumnarSigBatch(
+            digest_b, r_b, s_b, qx_pool[uid_items], qy_pool[uid_items],
+            np.ones(nc + ne, bool), ident_of=uid_items, idents=idents,
+        )
+
+        # -- per-tx endorsement matrices (policy-group inputs) --------
+        inc = mask_e.astype(np.int64)
+        csum = np.cumsum(inc) if m else np.zeros(0, np.int64)
+        csum0 = np.concatenate([[0], csum])
+        start = native.endo_start[:n].astype(np.int64)
+        ecnt = (np.bincount(tx_of_e[e_rows], minlength=n)
+                if ne else np.zeros(n, np.int64))
+        S = max(4, next_pow2(int(ecnt.max()) if ne else 1))
+        uid_mat = np.zeros((n, S), np.int64)
+        endo_idx_mat = np.full((n, S), -1, np.int32)
+        if ne:
+            ordinal = (csum - 1) - csum0[start][tx_of_e]
+            rr = tx_of_e[e_rows]
+            cc = ordinal[e_rows]
+            uid_mat[rr, cc] = eu[e_rows] + 1
+            endo_idx_mat[rr, cc] = e_item[e_rows]
+
+        # -- rwsets: one C call over the eligible txs -----------------
+        rwp = None
+        if c_ok.any():
+            try:
+                from fabric_tpu.native import mvccprep_py
+
+                rwp = mvccprep_py.prep(native, c_ok)
+            except Exception:
+                rwp = None
+
+        # -- ParsedTx shells (slow-lane fields left lazy) -------------
+        code_l = codes.tolist()
+        txs = [
+            fb_txs[i] if i in fb_txs else
+            ParsedTx(idx=i, code=code_l[i], txid=txid_strs[i])
+            for i in range(n)
+        ]
+        ci_l = creator_item.tolist()
+        cu_l = cu.tolist()
+        if rwp is not None:
+            st = rwp.status
+            res_off = native.results_span[:, 0].tolist()
+            res_len = native.results_span[:, 1].tolist()
+            for i in c_rows.tolist():
+                ptx = txs[i]
+                ptx.creator = sers[cu_l[i]]
+                ptx.creator_item_idx = ci_l[i]
+                if st[i] == 0:
+                    o = res_off[i]
+                    ptx.rwset_bytes = blob[o:o + res_len[i]] if o >= 0 else b""
+                else:
+                    self._py_rwset(ptx, native)
+        else:
+            for i in c_rows.tolist():
+                ptx = txs[i]
+                ptx.creator = sers[cu_l[i]]
+                ptx.creator_item_idx = ci_l[i]
+                self._py_rwset(ptx, native)
+
+        # fallback ptxs: rebase their slow item indices past the fast
+        # block, then sync their codes into the live array
+        if fallback_idx:
+            base = items.n_fast
+            items.slow = shim.slow
+            is_cfg = np.zeros(n, bool)
+            for i, ptx in fb_txs.items():
+                if ptx.creator_item_idx >= 0:
+                    ptx.creator_item_idx += base
+                if ptx.endo_item_idx:
+                    ptx.endo_item_idx = [k + base for k in ptx.endo_item_idx]
+                codes[i] = int(ptx.code)
+                is_cfg[i] = ptx.is_config
+        else:
+            is_cfg = np.zeros(n, bool)
+
+        fb = _FastBlock(
+            native=native, codes=codes, is_config=is_cfg, c_ok=c_ok,
+            creator_item=creator_item, uid_mat=uid_mat,
+            endo_idx_mat=endo_idx_mat, ecnt=ecnt, idents=idents,
+            sers=sers, has_ec=has_ec, fallback_idx=fallback_idx,
+        )
+        return txs, items, rwp, fb
+
+    def _materialize_for_host(self, txs, fb) -> None:
+        """Fill the per-tx endorsement lists / namespaces the columnar
+        parse left lazy — required before any host-dispatch validation
+        path touches ParsedTx objects of a columnar block."""
+        if fb is None or fb.materialized:
+            return
+        uid_mat, em = fb.uid_mat, fb.endo_idx_mat
+        for i in np.flatnonzero(fb.c_ok).tolist():
+            ptx = txs[i]
+            k = int(fb.ecnt[i])
+            if k and not ptx.endorsements:
+                ptx.endo_item_idx = em[i, :k].tolist()
+                ptx.endorsements = [
+                    (fb.sers[int(uid_mat[i, s]) - 1],
+                     fb.idents[int(uid_mat[i, s]) - 1])
+                    for s in range(k)
+                ]
+            if not ptx.namespaces and ptx.rwset is not None:
+                ptx.namespaces = tuple(sorted(ptx.rwset.ns))
+        fb.materialized = True
+
+    def _parse_one_py(self, i, env_bytes, block, txs, items, seen_txids):
+        """Parse ONE envelope on the Python path (config txs, idemix
+        creators, malformed bytes, non-native blocks) — appends a
+        ParsedTx and its signature items.  Shared by the legacy loop
+        and the columnar fast path's fallback lane; ``seen_txids`` is
+        the block-order dup registry both lanes feed."""
+        ptx = ParsedTx(idx=i)
+        txs.append(ptx)
+        if not env_bytes:
+            ptx.code = C.NIL_ENVELOPE
+            return
+        try:
+            env = protoutil.unmarshal(common_pb2.Envelope, env_bytes)
+            payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+            ch = protoutil.unmarshal(
+                common_pb2.ChannelHeader, payload.header.channel_header
+            )
+            sh = protoutil.unmarshal(
+                common_pb2.SignatureHeader, payload.header.signature_header
+            )
+        except Exception:
+            ptx.code = C.BAD_PAYLOAD
+            return
+        ptx.txid, ptx.channel, ptx.creator = ch.tx_id, ch.channel_id, sh.creator
+
+        if ch.type == common_pb2.HeaderType.CONFIG:
+            # config txs go to the config machinery, not the
+            # endorsement pipeline (v20/validator.go:397-419): the
+            # creator signature still rides the block's signature
+            # batch; structure + policy checks happen in
+            # _validate_config after phase 1a.
+            ptx.is_config = True
+            if block.header.number == 0:
+                return  # genesis: trust anchor, no creator check
+            try:
+                ident = self.msp.deserialize_identity(sh.creator)
+                if not ident.is_valid:
+                    raise ValueError("invalid creator identity")
+                item = _sig_item(ident, env.payload, env.signature)
+            except Exception:
+                ptx.code = C.BAD_CREATOR_SIGNATURE
+                return
+            ptx.creator_item_idx = items.add_slow(item)
+            return
+        if ch.type != common_pb2.HeaderType.ENDORSER_TRANSACTION:
+            ptx.code = C.UNKNOWN_TX_TYPE
+            return
+        # txid binding: tx_id must equal sha256(nonce ‖ creator) —
+        # prevents txid squatting / DUPLICATE_TXID poisoning
+        # (protoutil/proputils.go:362 CheckTxID)
+        if not ch.tx_id or ch.tx_id != protoutil.compute_tx_id(
+            sh.nonce, sh.creator
+        ):
+            ptx.code = C.BAD_PROPOSAL_TXID
+            return
+        # dup txid in-block (v20/validator.go:460-481); the
+        # vs-ledger check happens in validate() — preprocess() must
+        # be runnable BEFORE the previous block commits (pipeline)
+        if ch.tx_id in seen_txids:
+            ptx.code = C.DUPLICATE_TXID
+            return
+        seen_txids[ch.tx_id] = i
+
+        # creator: deserializable, valid cert, sig over payload
+        try:
+            ident = self.msp.deserialize_identity(sh.creator)
+        except Exception:
+            ptx.code = C.BAD_CREATOR_SIGNATURE
+            return
+        if not ident.is_valid:
+            ptx.code = C.BAD_CREATOR_SIGNATURE
+            return
+        item = None
+        try:
+            item = _sig_item(ident, env.payload, env.signature)
+        except Exception:
+            # identities without an EC public key (idemix anonymous
+            # creators, msp/idemix.go) verify HOST-side: each
+            # signature is a zero-knowledge presentation proof the
+            # batch kernel has no lane for
+            host_ok = False
+            if ident.is_valid and not hasattr(ident, "cert"):
+                try:
+                    host_ok = ident.verify(env.payload, env.signature)
+                except Exception:
+                    host_ok = False
+            if not host_ok:
+                ptx.code = C.BAD_CREATOR_SIGNATURE
+                return
+            ptx.host_creator_ok = True
+        if item is not None:
+            ptx.creator_item_idx = items.add_slow(item)
+
+        # endorsements + rwset
+        try:
+            _, _, cap, prp, cca = protoutil.extract_action(
+                env, parsed=(payload, ch, sh)
+            )
+            ptx.rwset = TxRWSet.from_bytes(cca.results)
+            ptx.namespaces = tuple(sorted(ptx.rwset.ns))
+            prp_bytes = cap.action.proposal_response_payload
+            seen_endorsers: set[bytes] = set()
+            for e in cap.action.endorsements:
+                # dedup by identity: a repeated endorser counts as
+                # ONE signature toward the policy (reference
+                # SignatureSetToValidIdentities,
+                # common/policies/policy.go:360-363)
+                if e.endorser in seen_endorsers:
+                    continue
+                try:
+                    eident = self.msp.deserialize_identity(e.endorser)
+                    eitem = _sig_item(eident, prp_bytes + e.endorser, e.signature)
+                except Exception:
+                    continue  # unparseable endorsement: contributes nothing
+                seen_endorsers.add(e.endorser)
+                ptx.endo_item_idx.append(items.add_slow(eitem))
+                ptx.endorsements.append((e.endorser, eident))
+        except protoutil.TxParseError as e:
+            ptx.code = e.code
+            return
+        except Exception:
+            ptx.code = C.BAD_RWSET
+            return
 
     def _py_rwset(self, ptx, native) -> None:
         """Python rwset parse for one native-fast tx the flat path
@@ -557,16 +872,16 @@ class BlockValidator:
         import time
 
         t0 = time.perf_counter()
-        txs, items, rwp = self._parse(block)
+        txs, items, rwp, fb = self._parse(block)
         t0 = self._t("host_parse", t0)
         fetch = p256.verify_launch(items)
         t0 = self._t("sig_prepare_launch", t0)
-        dpre = self._device_preprocess(txs, rwp)
+        dpre = self._device_preprocess(txs, rwp, fb)
         self._t("device_pre", t0)
         # the MSP manager the identities were validated against: a
         # config tx in the PREVIOUS block may rotate membership between
         # preprocess and validate — validate() detects and re-parses
-        return txs, items, fetch, self.msp, dpre
+        return txs, items, fetch, self.msp, dpre, fb
 
     def validate(self, block: common_pb2.Block, pre=None):
         return self.validate_finish(self.validate_launch(block, pre=pre))
@@ -611,7 +926,7 @@ class BlockValidator:
             # preprocessed (committed config tx): stale identity
             # validations / plans must not leak — redo the parse
             pre = self.preprocess(block)
-        txs, items, fetch, _, dpre = pre
+        txs, items, fetch, _, dpre, fb = pre
         # parsed records for post-commit consumers (config rotation) —
         # the commit path is serialized per channel, so this is safe
         self.last_parsed = txs
@@ -629,7 +944,7 @@ class BlockValidator:
 
         pending = PendingBlock(
             block=block, txs=txs, items=items, fetch=fetch, dpre=dpre,
-            overlay=overlay,
+            overlay=overlay, fb=fb,
         )
         # fused single-sync device path: policy + MVCC consume the
         # verify output ON DEVICE (one dispatch + one readback per
@@ -650,10 +965,14 @@ class BlockValidator:
                 return result
         return self._validate_host(
             pending.block, pending.txs, pending.items, pending.fetch,
-            overlay=pending.overlay,
+            overlay=pending.overlay, fb=pending.fb,
         )
 
-    def _validate_host(self, block, txs, items, fetch, overlay=None):
+    def _validate_host(self, block, txs, items, fetch, overlay=None,
+                       fb=None):
+        # a columnar parse leaves endorsement lists / namespaces lazy:
+        # the host dispatch path walks them, so fill them first
+        self._materialize_for_host(txs, fb)
         # phase 1a: one batched ECDSA verify for the whole block
         sig_valid = np.asarray(fetch(), bool) if items else np.zeros(0, bool)
 
@@ -725,7 +1044,7 @@ class BlockValidator:
 
     # -- fused single-sync device path ------------------------------------
 
-    def _device_preprocess(self, txs, rwp=None):
+    def _device_preprocess(self, txs, rwp=None, fb=None):
         """State-INDEPENDENT device-path inputs: policy match matrices
         (vectorized gather over per-identity cached principal rows) and
         static MVCC arrays.  Runs in the prefetch thread, overlapping
@@ -742,6 +1061,14 @@ class BlockValidator:
         default = self.plugins.get("default")
         if type(default).__name__ != "DefaultValidation":
             return None
+        if fb is not None:
+            dp = self._device_pre_columnar(txs, rwp, fb)
+            if dp is not NotImplemented:
+                return dp
+            # block mixes lanes the columnar builder doesn't carry
+            # (idemix creators, range queries, partial native parses):
+            # materialize the per-tx lists and run the generic builder
+            self._materialize_for_host(txs, fb)
 
         entries = []  # (ptx, ns, info)
         for ptx in txs:
@@ -841,6 +1168,118 @@ class BlockValidator:
             has_range=has_range, policies=self.policies,
         )
 
+    def _device_pre_columnar(self, txs, rwp, fb):
+        """Policy-group + static-MVCC construction straight from the
+        columnar arrays: match matrices come from a per-identity row
+        pool gathered through the [n,S] uid matrix, entries from the
+        flat (tx, ns) arrays — no per-entry Python loop.  Handles only
+        blocks whose every live tx is a flat-rwset columnar tx;
+        returns NotImplemented otherwise (caller falls back to the
+        generic builder), or None for custom plugins (host path)."""
+        from fabric_tpu.ops import mvcc as mvcc_ops
+        from fabric_tpu.utils.batching import next_pow2
+
+        if rwp is None:
+            return NotImplemented
+        default = self.plugins["default"]
+        n = len(txs)
+        codes = fb.codes
+        NOTV = int(C.NOT_VALIDATED)
+        live = (codes == NOTV) & ~fb.is_config
+        st_ok = rwp.status[:n] == 0
+        if bool((live & ~(fb.c_ok & st_ok)).any()) or not live.any():
+            return NotImplemented
+
+        tns_c = rwp.tx_ns_count[:n]
+        # a tx writing no namespace → INVALID_CHAINCODE (same verdict
+        # as the host dispatch path's entry collection)
+        zero = live & (tns_c == 0)
+        if zero.any():
+            for i in np.flatnonzero(zero).tolist():
+                txs[i].code = C.INVALID_CHAINCODE
+                codes[i] = int(C.INVALID_CHAINCODE)
+            live = live & ~zero
+            if not live.any():
+                return NotImplemented
+
+        total_ns = int(tns_c.sum())
+        etx = np.repeat(np.arange(n), tns_c)
+        ens = rwp.ns_ids_flat[:total_ns]
+        sel = live[etx]
+        etx, ens = etx[sel], ens[sel]
+        ns_names = rwp.ns_names()
+        infos = [self.policies.info(nm) for nm in ns_names]
+        bad_ids = [j for j, inf in enumerate(infos) if inf is None]
+        if bad_ids:
+            badsel = np.isin(ens, bad_ids)
+            bad_txs = np.unique(etx[badsel])
+            for i in bad_txs.tolist():
+                txs[i].code = C.INVALID_CHAINCODE
+                codes[i] = int(C.INVALID_CHAINCODE)
+            keep = ~np.isin(etx, bad_txs)
+            etx, ens = etx[keep], ens[keep]
+        if any(
+            inf is not None and (inf.plugin or "default") != "default"
+            for inf in infos
+        ):
+            return None  # custom plugin in play → host dispatch path
+
+        import jax.numpy as jnp
+
+        key_ns: dict[int, list] = {}
+        key_info: dict[int, object] = {}
+        for j, inf in enumerate(infos):
+            if inf is None:
+                continue
+            key = id(inf.policy)
+            key_ns.setdefault(key, []).append(j)
+            key_info[key] = inf
+        groups = []
+        group_entries = []
+        S = fb.uid_mat.shape[1]
+        n_pool = len(fb.idents)
+        for key, ns_ids in key_ns.items():
+            inf = key_info[key]
+            plan = default._plan(inf.policy)
+            P = len(plan.principals)
+            if len(key_ns) > 1:
+                gtx = etx[np.isin(ens, ns_ids)]
+            else:
+                gtx = etx
+            E = len(gtx)
+            Eb = max(16, next_pow2(max(E, 1)))
+            row_pool = np.zeros((n_pool + 1, P), bool)
+            for u in range(n_pool):
+                if fb.has_ec[u]:
+                    row_pool[u + 1] = default._match_row(
+                        plan, fb.sers[u], fb.idents[u]
+                    )
+            match = np.zeros((Eb, S, P), bool)
+            endo_idx = np.full((Eb, S), -1, np.int32)
+            tx_of = np.full(Eb, -1, np.int32)
+            if E:
+                match[:E] = row_pool[fb.uid_mat[gtx]]
+                endo_idx[:E] = fb.endo_idx_mat[gtx]
+                tx_of[:E] = gtx
+            groups.append((
+                plan, jnp.asarray(match), jnp.asarray(endo_idx),
+                jnp.asarray(tx_of),
+            ))
+            group_entries.append(range(E))
+
+        ukeys = rwp.ukey_strs()
+        ns_of = rwp.ns_of_ukey[:rwp.n_keys].tolist()
+        pairs = [(ns_names[ns_of[u]], ukeys[u]) for u in range(rwp.n_keys)]
+        composite = [("pub", ns, k) for ns, k in pairs]
+        static = mvcc_ops.prepare_block_from_flat(n, rwp, composite)
+        static.u_pairs = pairs
+        static.upload()
+        return _DevicePre(
+            groups=groups, group_entries=group_entries, static=static,
+            has_range=False, policies=self.policies,
+            rwp=rwp, ns_names=ns_names, ukeys=ukeys,
+        )
+
     def _launch_device(self, block, txs, handle, dpre, overlay=None):
         """Host-side device-path launch: range re-execution, structural
         arrays, committed-version fill (+ overlay), stage-2 dispatch.
@@ -879,10 +1318,18 @@ class BlockValidator:
                     -2 if ptx.host_creator_ok else ptx.creator_item_idx
                 )  # -2 = host-verified (idemix) → always-true lane
 
-        committed = self._committed_versions(
-            dpre.static.read_key_set, overlay=overlay
-        )
-        mvcc_arrays = dpre.static.device_args(committed)
+        static = dpre.static
+        if getattr(static, "u_pairs", None) is not None:
+            # flat path: committed versions per UNIQUE key, compared on
+            # host — one [T] bool rides to the device
+            mvcc_arrays = static.device_args_verok(
+                self._flat_ver_ok(static, overlay)
+            )
+        else:
+            committed = self._committed_versions(
+                static.read_key_set, overlay=overlay
+            )
+            mvcc_arrays = static.device_args_hostver(committed)
         t0 = self._t("state_fill", t0)
 
         if self._device_pipeline is None:
@@ -893,6 +1340,33 @@ class BlockValidator:
         )
         self._t("stage2_dispatch", t0)
         return fetch2, range_phantom
+
+    def _flat_ver_ok(self, static, overlay):
+        """[T] bool committed-version check for a flat block: one bulk
+        state lookup over the UNIQUE read keys (the
+        preLoadCommittedVersionOfRSet analog), overlay overrides for
+        the in-flight predecessor, then a vectorized per-read compare
+        reduced per tx (VecStaticBlock.ver_ok_from_u)."""
+        pairs = static.u_pairs
+        U = len(pairs)
+        up = np.zeros(U, bool)
+        uv = np.zeros((U, 2), np.uint32)
+        vers = self.state.get_versions_bulk(pairs) if U else {}
+        ol = overlay.updates if overlay is not None else None
+        vget = vers.get
+        for ui, pr in enumerate(pairs):
+            if ol is not None:
+                vv = ol.get(pr)
+                if vv is not None:
+                    if vv.value is not None:
+                        up[ui] = True
+                        uv[ui] = vv.version
+                    continue
+            v = vget(pr)
+            if v is not None:
+                up[ui] = True
+                uv[ui] = v
+        return static.ver_ok_from_u(up, uv)
 
     def _finish_device(self, pending: "PendingBlock"):
         """Consume the stage-2 packed output: final codes, filter,
@@ -911,35 +1385,39 @@ class BlockValidator:
             if not np.all(safe_bits[: len(ents)]):
                 return None
 
-        # one pass over txs for the final code assignment (same check
-        # order as the reference: creator sig → config → policy → mvcc)
+        # final code assignment, vectorized — same check order as the
+        # reference: creator sig → config → policy → mvcc
         sig_valid = out["sig_valid"]
         n_sig = len(sig_valid)
         policy_ok, valid, phantom = out["policy_ok"], out["valid"], out["phantom"]
-        for ptx in txs:
-            if not ptx.undetermined:
-                continue
-            ci = ptx.creator_item_idx
-            if ci >= 0 and not (ci < n_sig and sig_valid[ci]):
-                ptx.code = C.BAD_CREATOR_SIGNATURE
-                continue
-            if ptx.is_config:
-                ptx.code = self._validate_config(block, ptx)
-                continue
-            i = ptx.idx
-            if not policy_ok[i]:
-                ptx.code = C.ENDORSEMENT_POLICY_FAILURE
-            elif i in pending.range_phantom:
-                ptx.code = C.PHANTOM_READ_CONFLICT
-            elif valid[i]:
-                ptx.code = C.VALID
-            else:
-                ptx.code = (
-                    C.PHANTOM_READ_CONFLICT if phantom[i]
-                    else C.MVCC_READ_CONFLICT
-                )
-
-        tx_filter = bytes(ptx.code for ptx in txs)
+        nT = len(txs)
+        final = np.fromiter((ptx.code for ptx in txs), np.int32, nT)
+        und = final == int(C.NOT_VALIDATED)
+        cfg = np.fromiter((ptx.is_config for ptx in txs), bool, nT)
+        ci_arr = np.fromiter(
+            (ptx.creator_item_idx for ptx in txs), np.int64, nT
+        )
+        svF = np.concatenate([sig_valid, [False]])
+        ci_idx = np.where((ci_arr >= 0) & (ci_arr < n_sig), ci_arr, n_sig)
+        creator_fail = und & (ci_arr >= 0) & ~svF[ci_idx]
+        rp = np.zeros(nT, bool)
+        for i in pending.range_phantom:
+            rp[i] = True
+        sel = np.select(
+            [~policy_ok[:nT], rp, valid[:nT], phantom[:nT]],
+            [int(C.ENDORSEMENT_POLICY_FAILURE), int(C.PHANTOM_READ_CONFLICT),
+             int(C.VALID), int(C.PHANTOM_READ_CONFLICT)],
+            default=int(C.MVCC_READ_CONFLICT),
+        )
+        upd = und & ~cfg & ~creator_fail
+        final[upd] = sel[upd]
+        final[und & creator_fail] = int(C.BAD_CREATOR_SIGNATURE)
+        for i in np.flatnonzero(cfg & und & ~creator_fail).tolist():
+            final[i] = self._validate_config(block, txs[i])  # rare
+        fl = final.tolist()
+        for ptx, c in zip(txs, fl):
+            ptx.code = c
+        tx_filter = bytes(fl)
         if dpre.rwp is not None:
             batch, history = self._build_updates_flat(
                 block.header.number, txs, dpre.rwp, dpre.ns_names,

@@ -155,7 +155,7 @@ def test_host_device_differential(net):
         v_host = BlockValidator(net["mgr"], net["prov"], _seed_state())
         pre = v_host.preprocess(blk)
         flt_h, batch_h, hist_h = v_host._validate_host(
-            blk, pre[0], pre[1], pre[2]
+            blk, pre[0], pre[1], pre[2], fb=pre[5]
         )
         if (bytes(flt_d) != bytes(flt_h)
                 or sorted(batch_d.updates) != sorted(batch_h.updates)

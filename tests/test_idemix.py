@@ -194,7 +194,7 @@ def test_anonymous_creator_through_validator(setup, tmp_path):
     # force the pure-host path: verdicts identical
     v2 = BlockValidator(mgr, prov, MemVersionedDB())
     pre = v2.preprocess(blk)
-    flt2, _, _ = v2._validate_host(blk, pre[0], pre[1], pre[2])
+    flt2, _, _ = v2._validate_host(blk, pre[0], pre[1], pre[2], fb=pre[5])
     assert list(flt2) == list(flt)
 
 

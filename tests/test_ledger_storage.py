@@ -116,6 +116,42 @@ def test_blockstore_reopen_and_torn_write_recovery(tmp_path):
     bs2.close()
 
 
+def test_blockstore_group_commit_index_clamp(tmp_path):
+    """Group commit lets the sqlite index run durably ahead of an
+    unsynced segment tail; after a crash truncates the tail, _recover
+    must clamp the index BACK to the files (the files are the source
+    of truth in both directions)."""
+    path = str(tmp_path / "chains")
+    bs = BlockStore(path, group_commit=8)
+    prev = b""
+    offs = []
+    for n in range(5):
+        blk = _block(n, prev, [b"p%d" % n])
+        offs.append(os.path.getsize(os.path.join(path, "blocks_000000.bin"))
+                    if n else 0)
+        bs.add_block(blk)
+        prev = pu.block_header_hash(blk.header)
+    # crash inside the group window: blocks 3-4's bytes never hit disk
+    bs._fh.close()
+    bs._idx.close()
+    seg = os.path.join(path, "blocks_000000.bin")
+    with open(seg, "r+b") as f:
+        f.truncate(offs[3])
+    bs2 = BlockStore(path)
+    assert bs2.height == 3  # index clamped to the surviving files
+    assert bs2.get_block(2) is not None
+    assert bs2.get_block(3) is None
+    assert bs2.get_tx_loc("tx3-0") is None  # txid rows clamped too
+    # the chain continues from the clamped tip
+    prev3 = pu.block_header_hash(bs2.get_block(2).header)
+    bs2.add_block(_block(3, prev3, [b"re-delivered"]))
+    assert bs2.height == 4
+    reblk = bs2.get_block(3)
+    assert reblk.header.number == 3
+    assert b"re-delivered" in reblk.data.data[0]
+    bs2.close()
+
+
 def test_blockstore_index_rebuild(tmp_path):
     path = str(tmp_path / "chains")
     bs = BlockStore(path)
