@@ -252,7 +252,12 @@ class BlockStore:
         boot = self.bootstrap_info()
         return boot[1] if boot else None
 
-    def add_block(self, block: common_pb2.Block, txids=None) -> None:
+    def add_block(self, block: common_pb2.Block, txids=None,
+                  hd_bytes: bytes | None = None) -> None:
+        """``hd_bytes``: optional pre-serialized header+data fields
+        (protoutil.block_header_data_bytes, built off the commit
+        thread) — metadata is spliced on here so the committer never
+        re-serializes the envelopes."""
         if block.header.number != self.height:
             raise ValueError(
                 f"block number {block.header.number} != height {self.height}"
@@ -263,7 +268,10 @@ class BlockStore:
                 f"block {block.header.number} previous_hash does not "
                 "extend this chain"
             )
-        data = block.SerializeToString()
+        if hd_bytes is not None:
+            data = protoutil.append_block_metadata(hd_bytes, block)
+        else:
+            data = block.SerializeToString()
         if self._fh.tell() + len(data) > _SEGMENT_MAX and self._fh.tell() > 0:
             self.sync()  # a finished segment must be durable
             self._fh.close()

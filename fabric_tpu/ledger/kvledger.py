@@ -86,6 +86,7 @@ class KVLedger:
         history_writes: list | None = None,
         pvt_data: dict | None = None,
         txids: list | None = None,
+        hd_bytes: bytes | None = None,
     ) -> None:
         num = block.header.number
         if num != self.blocks.height:
@@ -97,7 +98,7 @@ class KVLedger:
             block.metadata.metadata.append(b"")
         block.metadata.metadata[idx] = commit_hash
 
-        self.blocks.add_block(block, txids=txids)
+        self.blocks.add_block(block, txids=txids, hd_bytes=hd_bytes)
         if pvt_data:
             self.pvtdata.commit_block(num, pvt_data)
         if getattr(self.state, "durable", True):

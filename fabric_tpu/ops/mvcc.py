@@ -241,6 +241,24 @@ class StaticBlock:
         a = self._jnp
         return (a[0], jnp.asarray(ver_ok), a[3], a[4], a[5])
 
+    @property
+    def dims(self) -> tuple:
+        """(R, W, Q) — the packed-static column split."""
+        return (self.read_keys.shape[1], self.write_keys.shape[1],
+                self.rq_lo.shape[1])
+
+    def packed_static(self):
+        """[T, R+W+2Q] int32 on device — read_keys | write_keys |
+        rq_lo | rq_hi in ONE H2D transfer (the stage-2 hostver path
+        slices by static offsets inside the jit)."""
+        p = getattr(self, "_packed", None)
+        if p is None:
+            p = self._packed = jnp.asarray(np.concatenate(
+                [self.read_keys, self.write_keys, self.rq_lo, self.rq_hi],
+                axis=1,
+            ))
+        return p
+
 
 def prepare_block_static(txs: list[TxRWSet], bucketed: bool = False) -> StaticBlock:
     """Build the state-independent device arrays for `mvcc_validate`.

@@ -209,11 +209,12 @@ class PeerChannel:
             # signature + attestation checks are ECDSA-heavy: keep them
             # off the event loop with the rest of validation
             self.verify_block_signature(b)
-            return self.validator.validate(b)
+            pend = self.validator.validate_launch(b)
+            return self.validator.validate_finish(pend), pend.hd_bytes
 
         async with self.commit_lock.writer():
             t0 = _time.perf_counter()
-            flt, batch, history = await loop.run_in_executor(
+            (flt, batch, history), hd_bytes = await loop.run_in_executor(
                 None, _verify_and_validate, block
             )
             t1 = _time.perf_counter()
@@ -244,7 +245,7 @@ class PeerChannel:
                 for (ns, coll), kv in colls.items()
             }
             self.ledger.commit_block(block, flt, batch, history,
-                                     pvt_data=pvt_store)
+                                     pvt_data=pvt_store, hd_bytes=hd_bytes)
             if pvt.missing:
                 self.ledger.pvtdata.commit_block(
                     block.header.number, {},

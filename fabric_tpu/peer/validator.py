@@ -158,6 +158,7 @@ class PendingBlock:
     fetch2: object = None   # stage-2 packed fetch, set by _launch_device
     range_phantom: frozenset = frozenset()  # tx idxs failing range re-exec
     fb: object = None       # _FastBlock of a columnar parse, or None
+    hd_bytes: bytes = None  # pre-serialized header+data (ledger commit)
 
     @property
     def txids(self) -> set:
@@ -877,11 +878,15 @@ class BlockValidator:
         fetch = p256.verify_launch(items)
         t0 = self._t("sig_prepare_launch", t0)
         dpre = self._device_preprocess(txs, rwp, fb)
-        self._t("device_pre", t0)
+        t0 = self._t("device_pre", t0)
+        # header+data wire form for the ledger commit (the committer
+        # only splices fresh metadata on — see blockstore.add_block)
+        hd_bytes = protoutil.block_header_data_bytes(block)
+        self._t("hd_frame", t0)
         # the MSP manager the identities were validated against: a
         # config tx in the PREVIOUS block may rotate membership between
         # preprocess and validate — validate() detects and re-parses
-        return txs, items, fetch, self.msp, dpre, fb
+        return txs, items, fetch, self.msp, dpre, fb, hd_bytes
 
     def validate(self, block: common_pb2.Block, pre=None):
         return self.validate_finish(self.validate_launch(block, pre=pre))
@@ -926,7 +931,8 @@ class BlockValidator:
             # preprocessed (committed config tx): stale identity
             # validations / plans must not leak — redo the parse
             pre = self.preprocess(block)
-        txs, items, fetch, _, dpre, fb = pre
+        txs, items, fetch, _, dpre, fb = pre[:6]
+        hd_bytes = pre[6] if len(pre) > 6 else None
         # parsed records for post-commit consumers (config rotation) —
         # the commit path is serialized per channel, so this is safe
         self.last_parsed = txs
@@ -944,7 +950,7 @@ class BlockValidator:
 
         pending = PendingBlock(
             block=block, txs=txs, items=items, fetch=fetch, dpre=dpre,
-            overlay=overlay, fb=fb,
+            overlay=overlay, fb=fb, hd_bytes=hd_bytes,
         )
         # fused single-sync device path: policy + MVCC consume the
         # verify output ON DEVICE (one dispatch + one readback per
@@ -1116,14 +1122,15 @@ class BlockValidator:
                         pool_rows.append(default._match_row(plan, ser, ident))
                     idx_mat[e, s] = pi
             match = np.stack(pool_rows)[idx_mat]  # [E, S, P] gather
-            # upload NOW (prefetch thread): launch-time H2D over the
-            # tunnel is latency-bound and sits on the critical path
+            # pack + upload NOW (prefetch thread): launch-time H2D over
+            # the tunnel is latency-bound and sits on the critical path
             import jax.numpy as jnp
 
-            groups.append((
-                plan, jnp.asarray(match), jnp.asarray(endo_idx),
-                jnp.asarray(tx_of),
-            ))
+            gp = np.empty((E, S * P + S + 1), np.int32)
+            gp[:, :S * P] = match.reshape(E, -1)
+            gp[:, S * P:S * P + S] = endo_idx
+            gp[:, -1] = tx_of
+            groups.append((plan, jnp.asarray(gp), E, S))
             group_entries.append(ents)
 
         # static MVCC arrays (committed-version fill deferred to
@@ -1141,7 +1148,8 @@ class BlockValidator:
                 for u in range(rwp.n_keys)
             ]
             static = mvcc_ops.prepare_block_from_flat(len(txs), rwp, composite)
-            static.upload()
+            static.u_pairs = [(c[1], c[2]) for c in composite]
+            static.packed_static()
             return _DevicePre(
                 groups=groups, group_entries=group_entries, static=static,
                 has_range=False, policies=self.policies,
@@ -1162,7 +1170,7 @@ class BlockValidator:
                 mvcc_ops.TxRWSet(reads=reads, writes=writes, range_reads=rqs)
             )
         static = mvcc_ops.prepare_block_static(mvcc_txs, bucketed=True)
-        static.upload()
+        static.packed_static()
         return _DevicePre(
             groups=groups, group_entries=group_entries, static=static,
             has_range=has_range, policies=self.policies,
@@ -1254,17 +1262,15 @@ class BlockValidator:
                     row_pool[u + 1] = default._match_row(
                         plan, fb.sers[u], fb.idents[u]
                     )
-            match = np.zeros((Eb, S, P), bool)
-            endo_idx = np.full((Eb, S), -1, np.int32)
-            tx_of = np.full(Eb, -1, np.int32)
+            gp = np.zeros((Eb, S * P + S + 1), np.int32)
+            gp[:, S * P:S * P + S] = -1
+            gp[:, -1] = -1
             if E:
-                match[:E] = row_pool[fb.uid_mat[gtx]]
-                endo_idx[:E] = fb.endo_idx_mat[gtx]
-                tx_of[:E] = gtx
-            groups.append((
-                plan, jnp.asarray(match), jnp.asarray(endo_idx),
-                jnp.asarray(tx_of),
-            ))
+                gp[:E, :S * P] = row_pool[fb.uid_mat[gtx]].reshape(E, -1)
+                gp[:E, S * P:S * P + S] = fb.endo_idx_mat[gtx]
+                gp[:E, -1] = gtx
+            # ONE packed upload per group (prefetch thread)
+            groups.append((plan, jnp.asarray(gp), Eb, S))
             group_entries.append(range(E))
 
         ukeys = rwp.ukey_strs()
@@ -1273,7 +1279,7 @@ class BlockValidator:
         composite = [("pub", ns, k) for ns, k in pairs]
         static = mvcc_ops.prepare_block_from_flat(n, rwp, composite)
         static.u_pairs = pairs
-        static.upload()
+        static.packed_static()  # ONE H2D, prefetch thread
         return _DevicePre(
             groups=groups, group_entries=group_entries, static=static,
             has_range=False, policies=self.policies,
@@ -1322,21 +1328,24 @@ class BlockValidator:
         if getattr(static, "u_pairs", None) is not None:
             # flat path: committed versions per UNIQUE key, compared on
             # host — one [T] bool rides to the device
-            mvcc_arrays = static.device_args_verok(
-                self._flat_ver_ok(static, overlay)
-            )
+            ver_ok = self._flat_ver_ok(static, overlay)
         else:
             committed = self._committed_versions(
                 static.read_key_set, overlay=overlay
             )
-            mvcc_arrays = static.device_args_hostver(committed)
+            ver_ok = static.host_ver_ok(committed)
+        # ONE launch-time H2D: creator_idx | structural | ver_ok
+        launch_vec = np.empty((t_bucket, 3), np.int32)
+        launch_vec[:, 0] = creator_idx
+        launch_vec[:, 1] = structural
+        launch_vec[:, 2] = ver_ok
         t0 = self._t("state_fill", t0)
 
         if self._device_pipeline is None:
             self._device_pipeline = DeviceBlockPipeline()
         fetch2 = self._device_pipeline.run(
-            handle, creator_idx, structural, dpre.groups, mvcc_arrays,
-            t_bucket,
+            handle, launch_vec, dpre.groups, static.packed_static(),
+            static.dims, t_bucket,
         )
         self._t("stage2_dispatch", t0)
         return fetch2, range_phantom
