@@ -23,6 +23,32 @@ from fabric_tpu.protos import rwset_pb2
 
 Version = tuple[int, int]  # (block_num, tx_num)
 
+# the metadata entry carrying a key-level endorsement policy (a
+# serialized SignaturePolicyEnvelope) — reference:
+# core/ledger/util/couchdb… pb.MetaDataKeys / shim
+# SetStateValidationParameter (statebased/validator_keylevel.go)
+VALIDATION_PARAMETER = "VALIDATION_PARAMETER"
+
+
+def encode_metadata(entries: dict) -> bytes | None:
+    """{name: value} → stable wire bytes for statedb storage (an empty
+    map means the metadata was CLEARED → None).  Reuses the
+    KVMetadataWrite message with an empty key as the container."""
+    if not entries:
+        return None
+    mw = rwset_pb2.KVMetadataWrite(key="")
+    for name in sorted(entries):
+        mw.entries.add(name=name, value=entries[name])
+    return mw.SerializeToString()
+
+
+def decode_metadata(raw: bytes | None) -> dict:
+    if not raw:
+        return {}
+    mw = rwset_pb2.KVMetadataWrite()
+    mw.ParseFromString(raw)
+    return {e.name: e.value for e in mw.entries}
+
 
 @dataclass
 class NsRWSet:
@@ -150,6 +176,10 @@ class TxRWSet:
                 reads.append((("pub", name, k), ver))
             for k in sorted(n.writes):
                 writes.append(("pub", name, k))
+            # metadata-only writes are STATE-DEPENDENT writers (they
+            # bump the version only when the key exists) — the
+            # validator's _mvcc_inputs adds them with the existence
+            # check; this pure form stays state-independent
             for start, end, results in n.range_queries:
                 for k, ver in results:
                     reads.append((("pub", name, k), ver))

@@ -41,8 +41,11 @@ class UpdateBatch:
 
     def __init__(self):
         self.updates: dict = {}  # (ns, key) -> VersionedValue (value None = delete)
+        self.has_meta = False    # any entry carries key metadata (SBE)
 
     def put(self, ns: str, key: str, value: bytes | None, version: Version, metadata: bytes | None = None):
+        if metadata:
+            self.has_meta = True
         self.updates[(ns, key)] = VersionedValue(value, metadata, version)
 
     def delete(self, ns: str, key: str, version: Version):
@@ -120,6 +123,11 @@ class MemVersionedDB(VersionedDB):
         self._sorted_cache: dict = {}  # ns -> sorted key list (invalidated on write)
         self._savepoint: Version | None = None
         self._lock = threading.Lock()
+        # number of keys carrying non-null metadata (key-level
+        # endorsement policies): the validator's SBE gate — blocks on a
+        # channel with NO key-level policies anywhere skip the
+        # metadata bulk-lookup entirely
+        self.meta_count = 0
 
     def get_state(self, ns, key):
         return self._data.get((ns, key))  # dict.get is atomic under the GIL
@@ -173,9 +181,14 @@ class MemVersionedDB(VersionedDB):
     def apply_updates(self, batch, savepoint):
         with self._lock:
             for (ns, key), vv in batch.items():
+                old = self._data.get((ns, key))
+                if old is not None and old.metadata:
+                    self.meta_count -= 1
                 if vv.value is None:
                     self._data.pop((ns, key), None)
                 else:
+                    if vv.metadata:
+                        self.meta_count += 1
                     self._data[(ns, key)] = vv
                 self._sorted_cache.pop(ns, None)
         if savepoint is not None:
@@ -209,6 +222,11 @@ class SqliteVersionedDB(VersionedDB):
             " block INTEGER, txnum INTEGER)"
         )
         self._conn.commit()
+        # SBE gate (see MemVersionedDB.meta_count)
+        self.meta_count = self._conn.execute(
+            "SELECT COUNT(*) FROM state WHERE metadata IS NOT NULL"
+            " AND metadata != x''"
+        ).fetchone()[0]
 
     def close(self):
         if self._conn:
@@ -272,10 +290,23 @@ class SqliteVersionedDB(VersionedDB):
 
     def apply_updates(self, batch, savepoint):
         cur = self._conn.cursor()
+        # meta_count == 0 ⇒ no existing row carries metadata, so the
+        # per-key decrement probe is skippable (keeps the common
+        # no-SBE channel free of per-write SELECTs)
+        track = self.meta_count > 0
         for (ns, key), vv in batch.items():
+            if track:
+                row = cur.execute(
+                    "SELECT metadata FROM state WHERE ns=? AND key=?",
+                    (ns, key),
+                ).fetchone()
+                if row is not None and row[0]:
+                    self.meta_count -= 1
             if vv.value is None:
                 cur.execute("DELETE FROM state WHERE ns=? AND key=?", (ns, key))
             else:
+                if vv.metadata:
+                    self.meta_count += 1
                 cur.execute(
                     "INSERT OR REPLACE INTO state VALUES (?,?,?,?,?,?)",
                     (ns, key, vv.value, vv.metadata, vv.version[0], vv.version[1]),

@@ -64,6 +64,22 @@ class ContractStub:
     def get_state_range(self, start: str, end: str, limit: int = 0):
         return self._sim.get_state_range(self.namespace, start, end, limit)
 
+    def set_state_validation_parameter(self, key: str,
+                                       policy_bytes: bytes) -> None:
+        """Key-level endorsement policy (shim
+        SetStateValidationParameter): a serialized
+        SignaturePolicyEnvelope that the commit-path SBE pass enforces
+        for every later write to ``key``."""
+        self._sim.set_state_validation_parameter(
+            self.namespace, key, policy_bytes
+        )
+
+    def get_state_validation_parameter(self, key: str) -> bytes | None:
+        return self._sim.get_state_validation_parameter(self.namespace, key)
+
+    def set_state_metadata(self, key: str, metadata: dict) -> None:
+        self._sim.set_state_metadata(self.namespace, key, metadata)
+
     def get_private(self, coll: str, key: str) -> bytes | None:
         return self._sim.get_private_data(self.namespace, coll, key)
 

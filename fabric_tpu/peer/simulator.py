@@ -60,6 +60,29 @@ class TxSimulator:
     def set_state_metadata(self, ns: str, key: str, metadata: dict) -> None:
         self.rwset.ns_rwset(ns).metadata_writes[key] = dict(metadata)
 
+    def set_state_validation_parameter(self, ns: str, key: str,
+                                       policy_bytes: bytes) -> None:
+        """Shim SetStateValidationParameter: a metadata write whose
+        VALIDATION_PARAMETER entry is a serialized
+        SignaturePolicyEnvelope — the key-level endorsement policy the
+        commit-path SBE pass enforces (statebased/validator_keylevel)."""
+        from fabric_tpu.ledger.rwset import VALIDATION_PARAMETER
+
+        self.set_state_metadata(ns, key, {VALIDATION_PARAMETER: policy_bytes})
+
+    def get_state_validation_parameter(self, ns: str, key: str) -> bytes | None:
+        """Committed key-level policy (metadata reads are not recorded
+        in the read set — the reference's GetStateMetadata likewise
+        rides outside MVCC)."""
+        from fabric_tpu.ledger.rwset import (
+            VALIDATION_PARAMETER, decode_metadata,
+        )
+
+        vv = self.state.get_state(ns, key)
+        if vv is None or not vv.metadata:
+            return None
+        return decode_metadata(vv.metadata).get(VALIDATION_PARAMETER)
+
     # -- private data (collections) ----------------------------------------
 
     def get_private_data(self, ns: str, coll: str, key: str) -> bytes | None:

@@ -955,12 +955,45 @@ class BlockValidator:
         # fused single-sync device path: policy + MVCC consume the
         # verify output ON DEVICE (one dispatch + one readback per
         # block); falls back to the host path for custom plugins,
-        # non-v3 kernels, or consumption-unsafe blocks
-        if getattr(fetch, "device_out", None) is not None and txs and dpre:
+        # non-v3 kernels, consumption-unsafe blocks, or key-level
+        # endorsement (the SBE launch veto — committed key policies may
+        # have landed AFTER this block was preprocessed)
+        if (
+            getattr(fetch, "device_out", None) is not None and txs and dpre
+            and not self._sbe_launch_veto(txs, dpre, overlay)
+        ):
             pending.fetch2, pending.range_phantom = self._launch_device(
                 block, txs, fetch, dpre, overlay
             )
         return pending
+
+    def _sbe_launch_veto(self, txs, dpre, overlay) -> bool:
+        """True when a written key of this block carries a key-level
+        endorsement policy in committed state (or the in-flight
+        predecessor's batch) — the device program has no SBE lanes, so
+        such blocks re-route to the host dispatch path.  Free on
+        channels that never set validation parameters (meta_count 0).
+        In-block metadata WRITES never reach here: the native parser
+        routes them off the flat path and the group builders return
+        None for them at preprocess."""
+        if not self._metaful(overlay):
+            return False
+        static = dpre.static
+        if dpre.rwp is not None and getattr(static, "u_pairs", None):
+            rwp = dpre.rwp
+            for u in np.unique(rwp.w_uid[:rwp.n_writes]).tolist():
+                ns, key = static.u_pairs[u]
+                if self._committed_key_has_meta(ns, key, overlay):
+                    return True
+            return False
+        for ptx in txs:
+            if not ptx.undetermined or ptx.is_config or ptx.rwset is None:
+                continue
+            for ns_name, n in ptx.rwset.ns.items():
+                for k in n.writes:
+                    if self._committed_key_has_meta(ns_name, k, overlay):
+                        return True
+        return False
 
     def validate_finish(self, pending: "PendingBlock"):
         """Sync the device stage-2 of a launched block and produce the
@@ -1011,6 +1044,12 @@ class BlockValidator:
             for ns, info in zip(ptx.namespaces, infos):
                 name = info.plugin or "default"
                 by_plugin.setdefault(name, []).append((ptx, ns))
+        # key-level (state-based) endorsement: when any written key
+        # carries a committed VALIDATION_PARAMETER — or any tx writes
+        # one — the namespace verdicts become per-key fallbacks inside
+        # the SBE pass instead of immediate failures
+        sbe_active = self._sbe_active(txs, overlay)
+        ns_verdicts: dict | None = {} if sbe_active else None
         for name, group in by_plugin.items():
             plug = self.plugins.get(name)
             if plug is None:
@@ -1024,9 +1063,13 @@ class BlockValidator:
                 # per-(tx, namespace) group entries by block position
                 per_tx = plug.validate_batch(ctx)
                 ok = [per_tx[ptx.idx] for ptx, _ in group]
-            for (ptx, _), good in zip(group, ok):
-                if not good and ptx.undetermined:
+            for (ptx, ns), good in zip(group, ok):
+                if ns_verdicts is not None:
+                    ns_verdicts[(ptx.idx, ns)] = bool(good)
+                elif not good and ptx.undetermined:
                     ptx.code = C.ENDORSEMENT_POLICY_FAILURE
+        if sbe_active:
+            self._sbe_pass(txs, sig_valid, ns_verdicts, overlay)
 
         # phase 2: MVCC over the whole block
         mvcc_txs, committed = self._mvcc_inputs(txs, overlay=overlay)
@@ -1045,8 +1088,169 @@ class BlockValidator:
 
         # phase 3: filter + update batch + history
         tx_filter = bytes(ptx.code for ptx in txs)
-        batch, history = self._build_updates(block.header.number, txs)
+        batch, history = self._build_updates(
+            block.header.number, txs, overlay=overlay, sbe=sbe_active
+        )
         return tx_filter, batch, history
+
+    # -- state-based (key-level) endorsement -------------------------------
+
+    def _sbe_active(self, txs, overlay=None) -> bool:
+        """True when key-level endorsement applies to this block:
+        some tx writes key metadata, or a written key carries a
+        committed (or in-flight predecessor) VALIDATION_PARAMETER.
+        The committed probe only runs when the state reports any
+        metadata at all (statedb.meta_count) — channels that never use
+        SetStateValidationParameter pay nothing."""
+        metaful = self._metaful(overlay)
+        for ptx in txs:
+            rw = ptx._rwset  # lazy rwsets (columnar) can't carry them
+            if rw is None:
+                if not metaful:
+                    continue
+                rw = ptx.rwset  # forces the parse only on SBE channels
+                if rw is None:
+                    continue
+            for ns_name, n in rw.ns.items():
+                if n.metadata_writes:
+                    return True
+                if metaful:
+                    for k in n.writes:
+                        # ANY committed metadata (not just a policy)
+                        # activates the pass: plain value writes must
+                        # PRESERVE existing metadata, which the fast
+                        # update builder doesn't look up
+                        if self._committed_key_has_meta(
+                            ns_name, k, overlay
+                        ):
+                            return True
+        return False
+
+    def _metaful(self, overlay) -> bool:
+        """Any key metadata anywhere the block could see it: committed
+        state (meta_count) or the in-flight predecessor's batch."""
+        return getattr(self.state, "meta_count", 0) > 0 or (
+            overlay is not None and getattr(overlay, "has_meta", False)
+        )
+
+    def _committed_key_has_meta(self, ns: str, key: str, overlay) -> bool:
+        if overlay is not None:
+            vv = overlay.updates.get((ns, key))
+            if vv is not None:
+                return bool(vv.value is not None and vv.metadata)
+        vv = self.state.get_state(ns, key)
+        return vv is not None and bool(vv.metadata)
+
+    def _committed_key_policy(self, ns: str, key: str, overlay):
+        """Committed VALIDATION_PARAMETER bytes for (ns, key) — the
+        in-flight predecessor's update batch overrides the state read
+        (same serialization argument as _committed_versions)."""
+        from fabric_tpu.ledger.rwset import (
+            VALIDATION_PARAMETER, decode_metadata,
+        )
+
+        if overlay is not None:
+            vv = overlay.updates.get((ns, key))
+            if vv is not None:
+                if vv.value is None or not vv.metadata:
+                    return None
+                return decode_metadata(vv.metadata).get(VALIDATION_PARAMETER)
+        vv = self.state.get_state(ns, key)
+        if vv is None or not vv.metadata:
+            return None
+        return decode_metadata(vv.metadata).get(VALIDATION_PARAMETER)
+
+    def _sbe_pass(self, txs, sig_valid, ns_verdicts, overlay) -> None:
+        """Key-level endorsement enforcement, in block order — the
+        reference's dependency-managed walk
+        (statebased/validator_keylevel.go:244-260 + the
+        vpmanagerimpl.go:47-199 waits) collapsed to a serial pass: a
+        tx's written keys are checked under the policies in effect AT
+        ITS POSITION, where 'in effect' folds in metadata updates from
+        earlier PLUGIN-valid txs of the same block (matching the
+        reference: an earlier tx later killed by MVCC still had its
+        update visible to the key-level validator).  Keys without a
+        key-level policy fall back to the namespace verdict; a tx whose
+        namespace has no written keys at all is judged by the
+        namespace policy alone."""
+        from fabric_tpu.ledger.rwset import VALIDATION_PARAMETER
+
+        pending: dict = {}    # (ns, key) → policy bytes | None (cleared)
+        pol_cache: dict = {}  # policy bytes → (ast, plan) | None
+        comm_cache: dict = {}  # (ns, key) → committed policy probe
+        for ptx in txs:
+            if not ptx.undetermined or ptx.is_config or ptx.rwset is None:
+                continue
+            tx_ok = True
+            for ns_name in ptx.namespaces:
+                n = ptx.rwset.ns.get(ns_name)
+                if n is None:
+                    continue
+                keys = sorted(set(n.writes) | set(n.metadata_writes))
+                if not keys:
+                    if not ns_verdicts.get((ptx.idx, ns_name), False):
+                        tx_ok = False
+                        break
+                    continue
+                for k in keys:
+                    if (ns_name, k) in pending:
+                        pb = pending[(ns_name, k)]
+                    elif (ns_name, k) in comm_cache:
+                        pb = comm_cache[(ns_name, k)]
+                    else:
+                        pb = comm_cache[(ns_name, k)] = (
+                            self._committed_key_policy(ns_name, k, overlay)
+                        )
+                    if pb is None:
+                        ok_k = ns_verdicts.get((ptx.idx, ns_name), False)
+                    else:
+                        ok_k = self._eval_key_policy(
+                            pb, ptx, sig_valid, pol_cache
+                        )
+                    if not ok_k:
+                        tx_ok = False
+                        break
+                if not tx_ok:
+                    break
+            if not tx_ok:
+                ptx.code = C.ENDORSEMENT_POLICY_FAILURE
+                continue
+            # plugin-valid: this tx's metadata updates take effect for
+            # every later tx in the block
+            for ns_name, n in ptx.rwset.ns.items():
+                for k, entries in n.metadata_writes.items():
+                    pending[(ns_name, k)] = entries.get(VALIDATION_PARAMETER)
+
+    def _eval_key_policy(self, policy_bytes, ptx, sig_valid, cache) -> bool:
+        """Evaluate one key-level policy over the tx's sig-valid
+        endorsements (the exact interpreter — key policies are rare
+        and arbitrary, so no batch plan reuse is assumed)."""
+        got = cache.get(policy_bytes, False)
+        if got is False:
+            try:
+                from fabric_tpu.crypto.msp import policy_from_proto
+                from fabric_tpu.protos import policies_pb2
+
+                env = protoutil.unmarshal(
+                    policies_pb2.SignaturePolicyEnvelope, policy_bytes
+                )
+                ast = policy_from_proto(env)
+                plan = pol.compile_plan(ast)
+                got = (ast, plan)
+            except Exception:
+                got = None  # unparseable policy: fail closed
+            cache[policy_bytes] = got
+        if got is None:
+            return False
+        ast, plan = got
+        if not ptx.endorsements:
+            return False
+        idents = [ident for (_, ident) in ptx.endorsements]
+        valid = np.array(
+            [bool(sig_valid[i]) for i in ptx.endo_item_idx], bool
+        )
+        m = pol.match_matrix(idents, plan.principals) & valid[:, None]
+        return bool(pol.evaluate(ast, m))
 
     # -- fused single-sync device path ------------------------------------
 
@@ -1080,6 +1284,12 @@ class BlockValidator:
         for ptx in txs:
             if not ptx.undetermined or ptx.is_config:
                 continue
+            if ptx.rwset is not None and any(
+                n.metadata_writes for n in ptx.rwset.ns.values()
+            ):
+                # key-level endorsement rides this block: the device
+                # program has no SBE lanes → host dispatch path
+                return None
             infos = [self.policies.info(ns) for ns in ptx.namespaces]
             if not ptx.namespaces or any(i is None for i in infos):
                 ptx.code = C.INVALID_CHAINCODE  # same verdict on both paths
@@ -1500,11 +1710,29 @@ class BlockValidator:
                 mvcc_txs.append(mvcc_ops.TxRWSet(reads=[], writes=[], range_reads=[]))
                 continue
             reads, writes, rqs = ptx.rwset.mvcc_form()
+            # metadata-only writes are writers iff they APPLY — the key
+            # must exist in committed state (or the in-flight
+            # predecessor's batch); a no-op metadata write on an absent
+            # key must not conflict later readers (the reference's
+            # applyWriteSet leaves the batch untouched there)
+            for ns_name, n in ptx.rwset.ns.items():
+                for k in n.metadata_writes:
+                    if k not in n.writes and self._key_exists(
+                        ns_name, k, overlay
+                    ):
+                        writes.append(("pub", ns_name, k))
             mvcc_txs.append(
                 mvcc_ops.TxRWSet(reads=reads, writes=writes, range_reads=rqs)
             )
             all_read_keys.update(k for k, _ in reads)
         return mvcc_txs, self._committed_versions(all_read_keys, overlay=overlay)
+
+    def _key_exists(self, ns: str, key: str, overlay) -> bool:
+        if overlay is not None:
+            vv = overlay.updates.get((ns, key))
+            if vv is not None:
+                return vv.value is not None
+        return self.state.get_state(ns, key) is not None
 
     def _committed_versions(self, all_read_keys, overlay=None) -> dict:
         """Bulk-load committed versions for a set of mvcc-form keys
@@ -1587,22 +1815,66 @@ class BlockValidator:
                 return C.INVALID_OTHER_REASON
         return C.VALID
 
-    def _build_updates(self, block_num: int, txs):
+    def _build_updates(self, block_num: int, txs, overlay=None, sbe=False):
+        """Update batch + history for the block's VALID txs.  With
+        ``sbe`` (key-level endorsement in play): metadata writes of
+        valid txs commit — combined with a value write they ride the
+        same put; alone they re-put the existing value with new
+        metadata and a version bump (a no-op when the key does not
+        exist, the reference's semantics); plain value writes PRESERVE
+        the key's existing metadata; deletes clear it."""
+        from fabric_tpu.ledger.rwset import encode_metadata
+
         batch = UpdateBatch()
         history = []
+
+        def _prev(ns, key):
+            vv = batch.updates.get((ns, key))
+            if vv is not None:
+                return vv
+            if overlay is not None:
+                vv = overlay.updates.get((ns, key))
+                if vv is not None:
+                    return vv
+            return self.state.get_state(ns, key)
+
         for ptx in txs:
             if ptx.code != C.VALID or ptx.rwset is None:
                 continue
             ver = (block_num, ptx.idx)
             for ns_name in sorted(ptx.rwset.ns):
                 n = ptx.rwset.ns[ns_name]
+                mws = n.metadata_writes if sbe else {}
                 for key in sorted(n.writes):
                     val = n.writes[key]
                     if val is None:
                         batch.delete(ns_name, key, ver)
-                    else:
+                    elif not sbe:
                         batch.put(ns_name, key, val, ver)
+                    else:
+                        if key in mws:
+                            md = encode_metadata(mws[key])
+                        else:
+                            prev = _prev(ns_name, key)
+                            md = (
+                                prev.metadata
+                                if prev is not None and prev.value is not None
+                                else None
+                            )
+                        batch.put(ns_name, key, val, ver, metadata=md)
                     history.append((ns_name, key, ptx.idx))
+                for key in sorted(mws):
+                    if key in n.writes:
+                        continue  # combined above
+                    prev = _prev(ns_name, key)
+                    if prev is None or prev.value is None:
+                        continue  # metadata write on absent key: no-op
+                    # NO history entry: the reference's history DB
+                    # records value writes only (KvRwSet.Writes)
+                    batch.put(
+                        ns_name, key, prev.value, ver,
+                        metadata=encode_metadata(mws[key]),
+                    )
                 for coll in sorted(n.hashed):
                     hns = f"{ns_name}${coll}#hashed"
                     for kh, (vh, is_del) in sorted(n.hashed[coll].get("writes", {}).items()):

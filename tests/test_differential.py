@@ -1,10 +1,14 @@
 """Host-path vs device-path differential property test: randomized
 adversarial blocks (invalid signatures, duplicate endorsers/txids,
 consumption-unsafe policies, stale/phantom reads, range queries,
-config txs, garbage envelopes) must produce byte-identical
-TRANSACTIONS_FILTER and update batches on `_validate_host` and the
-fused device path — the fallback conditions are exactly where a silent
-divergence would hide (VERDICT r3 weak #3)."""
+hashed-collection reads/writes, key-level endorsement (SBE) lanes —
+committed policies, in-block policy updates/clears — config txs,
+garbage envelopes) must produce identical TRANSACTIONS_FILTER, update
+batches (values + metadata + versions), and history on
+`_validate_host` and the fused device path — the fallback conditions
+are exactly where a silent divergence would hide.  (Missing-pvtdata /
+BTL-expiry / eligibility live at the peer coordinator layer and are
+pinned by test_gossip_pvtdata.py instead.)"""
 
 import random
 
@@ -59,12 +63,38 @@ def net():
     }
 
 
+def _sbe_policy_bytes(msp_id: str) -> bytes:
+    from fabric_tpu.crypto.msp import policy_to_proto
+
+    return policy_to_proto(
+        pol.from_dsl(f"OutOf(1, '{msp_id}.peer')")
+    ).SerializeToString()
+
+
 def _seed_state():
+    from fabric_tpu.ledger.rwset import VALIDATION_PARAMETER, encode_metadata
+
     db = MemVersionedDB()
     seed = UpdateBatch()
     for i in range(8):
         seed.put(CC_SAFE, f"s{i}", b"v", (1, i))
         seed.put(CC_UNSAFE, f"u{i}", b"v", (1, i))
+    # SBE lane: committed key-level policies (Org2-only / Org3-only)
+    for i in range(4):
+        seed.put(
+            CC_SAFE, f"sbe{i}", b"locked", (1, 20 + i),
+            metadata=encode_metadata({
+                VALIDATION_PARAMETER:
+                    _sbe_policy_bytes("Org2MSP" if i % 2 else "Org3MSP"),
+            }),
+        )
+    # hashed private-collection lane
+    import hashlib as _hl
+
+    for i in range(4):
+        kh = _hl.sha256(b"pk%d" % i).digest()
+        seed.put(f"{CC_SAFE}$collA#hashed", kh.hex(),
+                 _hl.sha256(b"pv%d" % i).digest(), (1, 30 + i))
     db.apply_updates(seed, (1, 0))
     return db
 
@@ -85,6 +115,42 @@ def _rand_tx(net, rng):
             n.reads[f"absent{i}"] = None   # absent, matches state
     for _ in range(rng.randrange(0, 3)):
         n.writes[f"w{rng.randrange(12)}"] = b"x"
+    if ns == CC_SAFE:
+        sb = rng.random()
+        if sb < 0.12:
+            # write an SBE-locked key (committed Org2/Org3-only
+            # policy): validity depends on which endorsers land below
+            n.writes[f"sbe{rng.randrange(4)}"] = b"y"
+        elif sb < 0.2:
+            # in-block policy update / clear on a random key
+            from fabric_tpu.ledger.rwset import VALIDATION_PARAMETER
+
+            key = rng.choice(
+                [f"sbe{rng.randrange(4)}", f"s{rng.randrange(8)}"]
+            )
+            if rng.random() < 0.3:
+                n.metadata_writes[key] = {}  # clear → ns policy
+            else:
+                n.metadata_writes[key] = {
+                    VALIDATION_PARAMETER: _sbe_policy_bytes(
+                        rng.choice(["Org1MSP", "Org2MSP", "Org3MSP"])
+                    ),
+                }
+        if rng.random() < 0.12:
+            # hashed private-collection reads/writes
+            import hashlib as _hl
+
+            coll = n.hashed.setdefault(
+                "collA", {"reads": {}, "writes": {}}
+            )
+            i = rng.randrange(4)
+            kh = _hl.sha256(b"pk%d" % i).digest()
+            if rng.random() < 0.5:
+                coll["reads"][kh] = (
+                    (1, 30 + i) if rng.random() < 0.7 else (0, 9)
+                )
+            else:
+                coll["writes"][kh] = (_hl.sha256(b"nv").digest(), False)
     if rng.random() < 0.15:
         # range query over seeded keys; sometimes missing a result
         lo, hi = "s0", "s4"
@@ -157,8 +223,14 @@ def test_host_device_differential(net):
         flt_h, batch_h, hist_h = v_host._validate_host(
             blk, pre[0], pre[1], pre[2], fb=pre[5]
         )
+        def rows(b):
+            return sorted(
+                (k, vv.value, vv.metadata, vv.version)
+                for k, vv in b.updates.items()
+            )
+
         if (bytes(flt_d) != bytes(flt_h)
-                or sorted(batch_d.updates) != sorted(batch_h.updates)
+                or rows(batch_d) != rows(batch_h)
                 or hist_d != hist_h):
             mismatches.append((bi, list(flt_d), list(flt_h)))
     assert not mismatches, mismatches[:5]

@@ -1,0 +1,268 @@
+"""State-based (key-level) endorsement, end to end.
+
+Scenarios mirror the reference's integration/sbe suite over
+statebased/validator_keylevel.go + vpmanagerimpl.go: a key's
+VALIDATION_PARAMETER (a serialized SignaturePolicyEnvelope written via
+SetStateValidationParameter) overrides the namespace endorsement policy
+for every write to that key — committed cross-block, in effect
+IN-BLOCK from earlier plugin-valid txs, changeable only under the
+current policy, deletable (falling back to the namespace policy), a
+no-op on absent keys, preserved across plain value writes, and a
+version bump for MVCC purposes.
+"""
+
+import pytest
+
+from fabric_tpu import protoutil as pu
+from fabric_tpu.crypto import cryptogen
+from fabric_tpu.crypto import policy as pol
+from fabric_tpu.crypto.msp import policy_to_proto
+from fabric_tpu.ledger.rwset import (
+    VALIDATION_PARAMETER, TxRWSet, decode_metadata,
+)
+from fabric_tpu.ledger.statedb import MemVersionedDB, UpdateBatch
+from fabric_tpu.peer import txassembly as txa
+from fabric_tpu.peer.validator import (
+    BlockValidator, NamespaceInfo, PolicyProvider,
+)
+from fabric_tpu.protos import transaction_pb2
+
+C = transaction_pb2.TxValidationCode
+CHANNEL = "sbechan"
+CC = "sbecc"
+
+
+@pytest.fixture(scope="module")
+def net():
+    org1 = cryptogen.generate_org("Org1MSP", "org1.example.com", peers=1, users=1)
+    org2 = cryptogen.generate_org("Org2MSP", "org2.example.com", peers=1)
+    mgr = MSPManager({"Org1MSP": org1.msp(), "Org2MSP": org2.msp()})
+    return {
+        "mgr": mgr,
+        "client": cryptogen.signing_identity(org1, "User1@org1.example.com"),
+        "p1": cryptogen.signing_identity(org1, "peer0.org1.example.com"),
+        "p2": cryptogen.signing_identity(org2, "peer0.org2.example.com"),
+    }
+
+
+from fabric_tpu.crypto.msp import MSPManager  # noqa: E402
+
+
+def org_policy_bytes(msp_id: str) -> bytes:
+    """Serialized SignaturePolicyEnvelope requiring one ``msp_id`` peer."""
+    ast = pol.from_dsl(f"OutOf(1, '{msp_id}.peer')")
+    return policy_to_proto(ast).SerializeToString()
+
+
+def _tx(net, endorsers, reads=(), writes=(), meta=None):
+    signer = net["client"]
+    signed, tx_id, prop = txa.create_signed_proposal(
+        signer, CHANNEL, CC, [b"invoke"]
+    )
+    tx = TxRWSet()
+    n = tx.ns_rwset(CC)
+    for k, ver in reads:
+        n.reads[k] = ver
+    for k, v in writes:
+        n.writes[k] = v
+    for k, entries in (meta or {}).items():
+        n.metadata_writes[k] = dict(entries)
+    rw = tx.to_proto().SerializeToString()
+    responses = [
+        txa.create_proposal_response(prop, rw, e, CC) for e in endorsers
+    ]
+    return txa.assemble_transaction(prop, responses, signer)
+
+
+def _block(envs, num=2, prev=b"prev"):
+    blk = pu.new_block(num, prev)
+    for env in envs:
+        blk.data.data.append(env.SerializeToString())
+    return pu.finalize_block(blk)
+
+
+def _fresh(net, seed=None):
+    """(state, validator) with a 1-of-(Org1|Org2) namespace policy and
+    optional seeded keys [(key, value, metadata_bytes)]."""
+    state = MemVersionedDB()
+    b = UpdateBatch()
+    for key, value, md in seed or []:
+        b.put(CC, key, value, (1, 0), metadata=md)
+    state.apply_updates(b, (1, 0))
+    ns_policy = pol.from_dsl("OutOf(1, 'Org1MSP.peer', 'Org2MSP.peer')")
+    prov = PolicyProvider({CC: NamespaceInfo(policy=ns_policy)})
+    return state, BlockValidator(net["mgr"], prov, state)
+
+
+def _sbe_meta(msp_id: str) -> dict:
+    return {VALIDATION_PARAMETER: org_policy_bytes(msp_id)}
+
+
+def test_key_policy_enforced_cross_block(net):
+    state, v = _fresh(net)
+    # block 2: set value + Org2-only key policy on "k" (no policy yet,
+    # so the 1-of-any namespace policy admits the Org1 endorsement)
+    env = _tx(net, [net["p1"]], writes=[("k", b"v0")], meta={"k": _sbe_meta("Org2MSP")})
+    flt, batch, _ = v.validate(_block([env], num=2))
+    assert list(flt) == [C.VALID]
+    vv = batch.updates[(CC, "k")]
+    assert decode_metadata(vv.metadata)[VALIDATION_PARAMETER]
+    state.apply_updates(batch, (2, 0))
+    assert state.meta_count == 1
+
+    # block 3: an Org1-only write to "k" violates the key policy even
+    # though it satisfies the namespace policy
+    bad = _tx(net, [net["p1"]], writes=[("k", b"v1")])
+    flt, batch, _ = v.validate(_block([bad], num=3))
+    assert list(flt) == [C.ENDORSEMENT_POLICY_FAILURE]
+    assert (CC, "k") not in batch.updates
+
+    # an Org2 write passes, and the key policy survives the value write
+    good = _tx(net, [net["p2"]], writes=[("k", b"v2")])
+    flt, batch, _ = v.validate(_block([good], num=3))
+    assert list(flt) == [C.VALID]
+    assert decode_metadata(
+        batch.updates[(CC, "k")].metadata
+    )[VALIDATION_PARAMETER] == org_policy_bytes("Org2MSP")
+
+    # writes to OTHER keys stay under the namespace policy
+    other = _tx(net, [net["p1"]], writes=[("unrelated", b"x")])
+    flt, _, _ = v.validate(_block([other], num=3))
+    assert list(flt) == [C.VALID]
+
+
+def test_in_block_policy_takes_effect_for_later_txs(net):
+    """vpmanagerimpl.go:47-199 semantics: tx1 sets the key policy, and
+    tx2 IN THE SAME BLOCK is already judged under it; tx3 satisfying
+    the new policy commits."""
+    state, v = _fresh(net)
+    tx1 = _tx(net, [net["p1"]], writes=[("k", b"v")], meta={"k": _sbe_meta("Org2MSP")})
+    tx2 = _tx(net, [net["p1"]], writes=[("k", b"later")])   # violates new policy
+    tx3 = _tx(net, [net["p2"]], writes=[("k", b"fine")])    # satisfies it
+    flt, batch, _ = v.validate(_block([tx1, tx2, tx3], num=2))
+    assert list(flt) == [C.VALID, C.ENDORSEMENT_POLICY_FAILURE, C.VALID]
+    assert batch.updates[(CC, "k")].value == b"fine"
+
+
+def test_policy_change_requires_current_policy(net):
+    state, v = _fresh(net, seed=[
+        ("k", b"v", None),
+    ])
+    # install Org2 policy first
+    env = _tx(net, [net["p1"]], meta={"k": _sbe_meta("Org2MSP")})
+    flt, batch, _ = v.validate(_block([env], num=2))
+    assert list(flt) == [C.VALID]
+    state.apply_updates(batch, (2, 0))
+
+    # Org1 tries to flip the policy to Org1-only: the metadata write
+    # itself is a write to "k" and must satisfy the CURRENT Org2 policy
+    coup = _tx(net, [net["p1"]], meta={"k": _sbe_meta("Org1MSP")})
+    flt, batch, _ = v.validate(_block([coup], num=3))
+    assert list(flt) == [C.ENDORSEMENT_POLICY_FAILURE]
+    assert not batch.updates
+
+    # Org2 legitimately rotates it
+    rotate = _tx(net, [net["p2"]], meta={"k": _sbe_meta("Org1MSP")})
+    flt, batch, _ = v.validate(_block([rotate], num=3))
+    assert list(flt) == [C.VALID]
+    state.apply_updates(batch, (3, 0))
+    # now Org1 writes pass and Org2-only writes fail
+    flt, _, _ = v.validate(_block(
+        [_tx(net, [net["p1"]], writes=[("k", b"w")])], num=4))
+    assert list(flt) == [C.VALID]
+    flt, _, _ = v.validate(_block(
+        [_tx(net, [net["p2"]], writes=[("k", b"w")])], num=4))
+    assert list(flt) == [C.ENDORSEMENT_POLICY_FAILURE]
+
+
+def test_policy_delete_falls_back_to_namespace(net):
+    state, v = _fresh(net, seed=[
+        ("k", b"v", None),
+    ])
+    env = _tx(net, [net["p1"]], meta={"k": _sbe_meta("Org2MSP")})
+    flt, batch, _ = v.validate(_block([env], num=2))
+    state.apply_updates(batch, (2, 0))
+    assert state.meta_count == 1
+
+    # Org2 clears the metadata (empty map) — requires the Org2 policy
+    clear = _tx(net, [net["p2"]], meta={"k": {}})
+    flt, batch, _ = v.validate(_block([clear], num=3))
+    assert list(flt) == [C.VALID]
+    state.apply_updates(batch, (3, 0))
+    assert state.meta_count == 0
+    assert state.get_state(CC, "k").metadata is None
+
+    # namespace policy (1-of-any) governs again
+    flt, _, _ = v.validate(_block(
+        [_tx(net, [net["p1"]], writes=[("k", b"w")])], num=4))
+    assert list(flt) == [C.VALID]
+
+
+def test_metadata_write_on_absent_key_is_noop(net):
+    state, v = _fresh(net)
+    # tx1 metadata-writes a non-existent key; tx2 reads it as absent —
+    # the no-op must NOT make tx1 a writer, so tx2 stays valid (the
+    # reference's applyWriteSet leaves the batch untouched)
+    env = _tx(net, [net["p1"]], meta={"ghost": _sbe_meta("Org2MSP")})
+    rdr = _tx(net, [net["p1"]], reads=[("ghost", None)],
+              writes=[("out", b"x")])
+    flt, batch, _ = v.validate(_block([env, rdr], num=2))
+    assert list(flt) == [C.VALID, C.VALID]
+    assert (CC, "ghost") not in batch.updates
+    state.apply_updates(batch, (2, 0))
+    assert state.get_state(CC, "ghost") is None
+    assert state.meta_count == 0
+
+
+def test_metadata_write_bumps_version_for_mvcc(net):
+    state, v = _fresh(net, seed=[("k", b"v", None)])
+    # tx1 metadata-writes k (valid); tx2 then reads k at the seeded
+    # version → in-block writer conflict, exactly as a value write
+    tx1 = _tx(net, [net["p1"]], meta={"k": _sbe_meta("Org1MSP")})
+    tx2 = _tx(net, [net["p1"]], reads=[("k", (1, 0))], writes=[("out", b"x")])
+    flt, batch, _ = v.validate(_block([tx1, tx2], num=2))
+    assert list(flt) == [C.VALID, C.MVCC_READ_CONFLICT]
+    # the metadata-only update carries the key's existing value with a
+    # bumped version
+    vv = batch.updates[(CC, "k")]
+    assert vv.value == b"v"
+    assert vv.version == (2, 0)
+    state.apply_updates(batch, (2, 0))
+    # cross-block: a reader still citing (1, 0) now conflicts
+    stale = _tx(net, [net["p1"]], reads=[("k", (1, 0))], writes=[("o2", b"y")])
+    flt, _, _ = v.validate(_block([stale], num=3))
+    assert list(flt) == [C.MVCC_READ_CONFLICT]
+
+
+def test_sbe_via_chaincode_stub(net):
+    """The shim surface: SetStateValidationParameter from a contract
+    through the simulator produces the exact rwset the validator
+    enforces."""
+    from fabric_tpu.peer.chaincode import ChaincodeRuntime, Contract, Response
+    from fabric_tpu.peer.simulator import TxSimulator
+
+    class EPContract(Contract):
+        def lock(self, stub, key, msp):
+            stub.put_state(key.decode(), b"locked")
+            stub.set_state_validation_parameter(
+                key.decode(), org_policy_bytes(msp.decode())
+            )
+            return Response(200)
+
+    state, v = _fresh(net)
+    rt = ChaincodeRuntime()
+    rt.register(CC, EPContract())
+    sim = TxSimulator(state)
+    resp = rt.execute(sim, CC, [b"lock", b"asset1", b"Org2MSP"])
+    assert resp.status == 200
+    rw_bytes, _ = sim.done()
+    parsed = TxRWSet.from_bytes(rw_bytes)
+    assert parsed.ns[CC].metadata_writes["asset1"][VALIDATION_PARAMETER]
+    # and GetStateValidationParameter reads the committed policy back
+    signed = _tx(net, [net["p1"]], writes=[("asset1", b"locked")],
+                 meta={"asset1": _sbe_meta("Org2MSP")})
+    flt, batch, _ = v.validate(_block([signed], num=2))
+    state.apply_updates(batch, (2, 0))
+    sim2 = TxSimulator(state)
+    assert sim2.get_state_validation_parameter(CC, "asset1") == \
+        org_policy_bytes("Org2MSP")
