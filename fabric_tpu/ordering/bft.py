@@ -77,11 +77,18 @@ class BFTNode:
 
     def __init__(self, node_id: str, peers: list[str], wal: WAL,
                  apply_cb, send_cb, signer=None, verifiers=None,
-                 view_timeout: float = 2.0):
+                 view_timeout: float = 2.0, catchup_cb=None,
+                 catchup_gap: int = 8):
         """peers: ALL cluster node ids (including self).
         signer: SigningIdentity for outbound messages (None = unsigned
         dev mode, only acceptable in tests).
-        verifiers: {node_id: Identity-like with .verify(msg, sig)}."""
+        verifiers: {node_id: Identity-like with .verify(msg, sig)}.
+        catchup_cb(target_seq, view): the replica detected a sequence
+        gap it cannot close from live traffic (messages ``catchup_gap``
+        past its application point, or a new-view base beyond it) —
+        the chain pulls the missing BLOCKS from cluster peers,
+        verifies their 2f+1 attestations, and calls install_snapshot
+        (the SmartBFT synchronizer.go:40 Sync analog)."""
         self.id = node_id
         self.peers = sorted(set(peers) | {node_id})
         self.n = len(self.peers)
@@ -93,6 +100,8 @@ class BFTNode:
         self.signer = signer
         self.verifiers = verifiers or {}
         self.view_timeout = view_timeout
+        self.catchup_cb = catchup_cb
+        self.catchup_gap = max(1, catchup_gap)
 
         self.view = 0
         # a compacted WAL restarts with everything <= snap_index
@@ -221,6 +230,14 @@ class BFTNode:
                       self.id, msg.get("type"), msg.get("from"))
             return
         t = msg.get("type")
+        # schema guard: malformed fields from a byzantine sender must
+        # be dropped, not allowed to raise mid-dispatch (the Step
+        # stream handler would tear down on an escaped exception)
+        if t in (PRE_PREPARE, PREPARE, COMMIT):
+            if not isinstance(msg.get("seq"), int) or not isinstance(
+                msg.get("view"), int
+            ):
+                return
         if t == PRE_PREPARE:
             self._on_pre_prepare(msg)
         elif t == PREPARE:
@@ -303,6 +320,75 @@ class BFTNode:
         slot.commits[msg["from"]] = (msg.get("view"), msg["digest"])
         slot.commit_msgs[msg["from"]] = msg
         self._try_apply()
+        self._maybe_catchup(msg["from"], msg["seq"])
+
+    def _maybe_catchup(self, sender: str, seq_seen: int) -> None:
+        """Cluster traffic references sequences well past our
+        application point while the next-in-line slot has no payload:
+        the pre-prepares we're missing may be gone forever (view
+        changes drop uncommitted slots; the WAL compacts), so pull
+        the committed BLOCKS instead (synchronizer.go:40 Sync).
+
+        The trigger needs f+1 DISTINCT consenters claiming such
+        sequences — a single byzantine node must not be able to keep
+        every replica running bogus pull tasks (the synchronizer's
+        corroboration requirement).  The target is the (f+1)-th
+        largest claim: at least one honest node vouches for it."""
+        if self.catchup_cb is None:
+            return
+        claims = getattr(self, "_seq_claims", None)
+        if claims is None:
+            claims = self._seq_claims = {}
+        claims[sender] = max(claims.get(sender, 0), seq_seen)
+        vouched = self._vouched_seq()
+        if vouched <= self.last_applied + self.catchup_gap:
+            return
+        nxt = self.slots.get(self.last_applied + 1)
+        if nxt is not None and nxt.payload is not None:
+            return  # live traffic can still close the gap
+        self.catchup_cb(vouched - 1, self.view)
+
+    def _vouched_seq(self) -> int:
+        """The highest sequence at least one HONEST consenter has
+        referenced: the (f+1)-th largest per-sender claim."""
+        claims = getattr(self, "_seq_claims", {})
+        tops = sorted(claims.values(), reverse=True)
+        return tops[self.f] if len(tops) > self.f else 0
+
+    def install_snapshot(self, index: int, term: int) -> None:
+        """The chain materialized verified blocks through sequence
+        ``index`` out-of-band (catch-up pull): fast-forward the
+        consensus state so agreement resumes after it — the BFT mirror
+        of RaftNode.install_snapshot."""
+        if index <= self.last_applied:
+            return
+        self.wal.install_snapshot(index, term)
+        self.view = max(self.view, term)
+        self.last_applied = index
+        self.next_seq = max(self.next_seq, index + 1)
+        self._pending_since = None
+        for seq in list(self.slots):
+            if seq <= index:
+                del self.slots[seq]
+        for seq in [s for s in self._applied_ev if s <= index]:
+            # waiters learn the seq applied; digest confirmation will
+            # report False (the payload identity is unknown after a
+            # block-level catch-up), which the broadcast path treats
+            # as an unconfirmed ack — fail-safe for the client
+            self._applied_ev.pop(seq).set()
+        self._try_apply()  # buffered votes past the snapshot may apply
+        # residual gap: a vouched-for sequence just above the snapshot
+        # whose pre-prepare is gone stalls until traffic exceeds the
+        # catchup gap again — re-pull NOW rather than sit blocks
+        # behind while the channel is quiet
+        vouched = self._vouched_seq()
+        nxt = self.slots.get(self.last_applied + 1)
+        if (
+            self.catchup_cb is not None
+            and vouched > self.last_applied
+            and (nxt is None or nxt.payload is None)
+        ):
+            self.catchup_cb(vouched - 1, self.view)
 
     def _try_apply(self):
         while True:
@@ -380,6 +466,12 @@ class BFTNode:
         self.n = len(self.peers)
         self.f = (self.n - 1) // 3
         self.quorum = 2 * self.f + 1
+        # removed consenters' catch-up claims must not keep vouching
+        claims = getattr(self, "_seq_claims", None)
+        if claims:
+            self._seq_claims = {
+                k: v for k, v in claims.items() if k in self.peers
+            }
 
     def commit_proof(self, seq: int) -> list | None:
         """The 2f+1 signed COMMIT messages that committed ``seq`` —
@@ -574,6 +666,12 @@ class BFTNode:
             base + off: _digest(payload)
             for off, (_seq, payload) in enumerate(repro)
         }
+        if base > self.last_applied + 1 and self.catchup_cb is not None:
+            # the quorum's claims prove sequences up to base-1 are
+            # committed somewhere, and we missed them — the re-proposal
+            # set will never include them, so block catch-up is the
+            # ONLY way back (the gap the round-4 docstring documented)
+            self.catchup_cb(base - 1, v)
 
     def _install_view(self, view: int):
         self.view = view

@@ -108,6 +108,7 @@ class OrderingChain:
                 apply_cb=self._apply, send_cb=send_cb,
                 signer=signer, verifiers=verifiers,
                 view_timeout=view_timeout,
+                catchup_cb=self._on_snapshot_hint,
             )
         else:
             self.raft = RaftNode(
@@ -336,6 +337,34 @@ class OrderingChain:
                     if self.on_consenters is not None:
                         self.on_consenters(addr_map)
                     self.raft.update_peers(ids)
+                    # rotate the BFT message-verifier registry with the
+                    # membership: an added consenter authenticates by
+                    # the identity the config block carries; a removed
+                    # one loses its vote (smartbft configverifier.go)
+                    vers = getattr(self.raft, "verifiers", None)
+                    if vers:
+                        from fabric_tpu.crypto.identity import Identity
+
+                        for c in meta.consenters:
+                            if c.id and c.identity and c.id not in vers:
+                                try:
+                                    ident = Identity.from_serialized(
+                                        bytes(c.identity)
+                                    )
+                                    ident.is_valid = True
+                                    vers[c.id] = ident
+                                except Exception:
+                                    import logging
+
+                                    logging.getLogger(
+                                        "fabric_tpu.orderer"
+                                    ).warning(
+                                        "%s: bad identity for added "
+                                        "consenter %s", self.channel, c.id,
+                                    )
+                        for nid in list(vers):
+                            if nid not in ids:
+                                vers.pop(nid)
                 return True
             except Exception:
                 import logging
@@ -349,48 +378,74 @@ class OrderingChain:
     # -- snapshot catch-up (follower_chain.go) -----------------------------
 
     def _on_snapshot_hint(self, snap_index: int, snap_term: int) -> None:
-        """The leader compacted past us: pull the missing BLOCKS from
-        the cluster, then fast-forward the raft log state."""
+        """The leader compacted past us (raft) or the cluster vouched
+        for sequences we missed (BFT): pull the missing BLOCKS, then
+        fast-forward the consensus log state.  Hints arriving while a
+        pull is in flight raise the pending target instead of being
+        dropped — install_snapshot itself may re-hint for a residual
+        gap, and that must not be swallowed by the running-task
+        guard."""
         if self.block_puller is None:
             return
+        self._catchup_pending = max(
+            getattr(self, "_catchup_pending", 0), snap_index
+        )
+        self._catchup_term = snap_term
         if self._catchup_task is not None and not self._catchup_task.done():
             return
-        target_height = self._offset + snap_index
 
         async def go():
-            try:
-                async for raw in self.block_puller(
-                    self.channel, self.blocks.height, target_height - 1
+            import logging
+
+            log = logging.getLogger("fabric_tpu.orderer")
+            while True:
+                target = self._catchup_pending
+                term = getattr(self, "_catchup_term", snap_term)
+                target_height = self._offset + target
+                h_before = self.blocks.height
+                try:
+                    async for raw in self.block_puller(
+                        self.channel, self.blocks.height, target_height - 1
+                    ):
+                        blk = common_pb2.Block()
+                        blk.ParseFromString(raw)
+                        if blk.header.number != self.blocks.height:
+                            continue
+                        if not self._catchup_block_ok(blk):
+                            log.warning(
+                                "%s: catch-up block %d failed attestation "
+                                "— refusing", self.channel,
+                                blk.header.number,
+                            )
+                            break
+                        self.blocks.add_block(blk)
+                        self._height_changed.set()
+                        self._height_changed = asyncio.Event()
+                        # a pulled CONFIG block rotates membership (and
+                        # the BFT verifier registry) AT ITS HEIGHT, so
+                        # later blocks verify against the consenter set
+                        # actually in effect when they were attested
+                        self._maybe_reconfigure(list(blk.data.data))
+                    # block 0 may have arrived out-of-band: refresh the
+                    # entry→block mapping and re-derive membership from
+                    # the newest materialized config block
+                    self._offset = self._derive_offset()
+                    self._reapply_config_membership()
+                    if self._materialized >= target:
+                        self.raft.install_snapshot(target, term)
+                except Exception as e:
+                    log.warning(
+                        "%s: snapshot catch-up to %d failed: %s",
+                        self.channel, target_height, e,
+                    )
+                if (
+                    self._catchup_pending <= target
+                    or self.blocks.height == h_before
                 ):
-                    blk = common_pb2.Block()
-                    blk.ParseFromString(raw)
-                    if blk.header.number != self.blocks.height:
-                        continue
-                    if not self._catchup_block_ok(blk):
-                        import logging
-
-                        logging.getLogger("fabric_tpu.orderer").warning(
-                            "%s: catch-up block %d failed attestation — "
-                            "refusing", self.channel, blk.header.number,
-                        )
-                        break
-                    self.blocks.add_block(blk)
-                    self._height_changed.set()
-                    self._height_changed = asyncio.Event()
-                # block 0 may have arrived out-of-band: refresh the
-                # entry→block mapping and re-derive membership from the
-                # newest materialized config block
-                self._offset = self._derive_offset()
-                self._reapply_config_membership()
-                if self._materialized >= snap_index:
-                    self.raft.install_snapshot(snap_index, snap_term)
-            except Exception as e:
-                import logging
-
-                logging.getLogger("fabric_tpu.orderer").warning(
-                    "%s: snapshot catch-up to %d failed: %s",
-                    self.channel, target_height, e,
-                )
+                    # no higher hint, or no progress (blocks not yet
+                    # available anywhere) — stop; the next vouched
+                    # claim re-triggers
+                    return
 
         self._catchup_task = asyncio.ensure_future(go())
 

@@ -488,17 +488,98 @@ class PeerChannel:
                            ssl_ctx=getattr(self, "client_ssl", None))
         async with contextlib.aclosing(dc.blocks(self.id, start=self.height)) as gen:
             async for blk in gen:
+                # stream liveness for the censorship monitor: a block
+                # ARRIVED (even if its validation is slow) — only a
+                # silent stream counts as possible withholding
+                self._deliver_progress = (
+                    getattr(self, "_deliver_progress", 0) + 1
+                )
                 if blk.header.number < self.height:
                     continue  # replayed
                 await self.commit_block(blk)
 
-    def start_deliver(self, orderer_addrs: list[tuple[str, int]]):
-        """Background commit driver with orderer failover."""
+    def start_deliver(self, orderer_addrs: list[tuple[str, int]],
+                      censorship_check_s: float = 2.0):
+        """Background commit driver with orderer failover AND
+        censorship monitoring: an orderer that keeps the Deliver
+        stream open while withholding blocks is detected by
+        cross-checking the OTHER orderers' reported heights — when the
+        stream is silent but the rest of the cluster is ahead of us,
+        the connection rotates (the deliver-client BFT stance,
+        blocksprovider/bft_censorship_monitor.go + bft_deliverer.go;
+        a disconnect-only failover cannot see withholding)."""
         import logging
 
         self.orderer_addrs = list(orderer_addrs)  # gateway Submit uses these
 
         log = logging.getLogger("fabric_tpu.peer.deliver")
+
+        async def probe_height(addr) -> int:
+            from fabric_tpu.comm.rpc import RpcClient
+
+            cli = RpcClient(*addr, ssl_ctx=getattr(self, "client_ssl", None))
+            try:
+                await cli.connect()
+                res = json.loads(await asyncio.wait_for(
+                    cli.unary("Info", json.dumps(
+                        {"channel": self.id}).encode()),
+                    censorship_check_s,
+                ))
+                return int(res.get("height", -1)) if res.get(
+                    "status") == 200 else -1
+            except Exception:
+                return -1
+            finally:
+                try:
+                    await cli.close()
+                except Exception:
+                    pass
+
+        async def censored(current) -> bool:
+            # f+1 corroboration: ONE lying orderer (inflated Info
+            # height) must not be able to tear down a healthy stream —
+            # the BFT fault budget for the orderer list is
+            # f = (N-1)//3, so f+1 distinct claims guarantee an honest
+            # voucher
+            others = [a for a in orderer_addrs if a != current]
+            needed = (len(orderer_addrs) - 1) // 3 + 1
+            ahead = 0
+            for a in others:
+                if await probe_height(a) > self.height:
+                    ahead += 1
+                    if ahead >= needed:
+                        return True
+            return False
+
+        async def deliver_monitored(addr):
+            t = asyncio.ensure_future(self.run_deliver(addr))
+            idle_probes = 0
+            try:
+                while True:
+                    p0 = getattr(self, "_deliver_progress", 0)
+                    # quiet channels back the probing off (up to 8x):
+                    # the monitor is for WITHHOLDING, not for idling
+                    await asyncio.wait(
+                        {t},
+                        timeout=censorship_check_s * min(8, 1 + idle_probes),
+                    )
+                    if t.done():
+                        return await t  # propagate stream errors
+                    if getattr(self, "_deliver_progress", 0) != p0:
+                        idle_probes = 0  # blocks are flowing (even if
+                        continue         # validation is slow)
+                    if len(orderer_addrs) > 1 and await censored(addr):
+                        log.warning(
+                            "%s: orderer %s serves a silent stream while "
+                            "the cluster is ahead of height %d — "
+                            "suspecting censorship, rotating",
+                            self.id, addr, self.height,
+                        )
+                        raise RuntimeError("deliver censorship suspected")
+                    idle_probes += 1
+            finally:
+                if not t.done():
+                    t.cancel()
 
         async def loop():
             i = 0
@@ -506,7 +587,7 @@ class PeerChannel:
                 addr = orderer_addrs[i % len(orderer_addrs)]
                 i += 1
                 try:
-                    await self.run_deliver(addr)
+                    await deliver_monitored(addr)
                 except Exception as e:
                     # a deterministic commit failure re-fails forever;
                     # it must at least be VISIBLE

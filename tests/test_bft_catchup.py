@@ -1,0 +1,318 @@
+"""BFT replica state transfer + live membership reconfiguration.
+
+Round-4 left two admitted gaps: a BFT replica whose last_applied lags
+the cluster had no way back (ordering/bft.py's own docstring said so),
+and the consenter set was fixed at construction.  These tests pin the
+new paths: catch-up via block pull + install_snapshot when live
+traffic references sequences past the replica's application point
+(SmartBFT synchronizer.go:40 Sync analog), and consenter ADDITION via
+a committed config block carrying the new node's identity, with f and
+the quorum recomputed and the message-verifier registry rotated
+(smartbft configverifier.go)."""
+
+import asyncio
+
+import pytest
+
+from fabric_tpu.crypto import cryptogen
+from fabric_tpu.crypto.msp import MSPManager
+from fabric_tpu.ordering.blockcutter import BatchConfig
+from fabric_tpu.ordering.node import BroadcastClient, OrdererNode
+from fabric_tpu.protos import common_pb2, configtx_pb2, orderer_pb2
+
+CHANNEL = "bftcat"
+
+
+def run(coro, timeout=120):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(asyncio.wait_for(coro, timeout))
+    finally:
+        loop.close()
+
+
+async def _wait(cond, timeout=25.0):
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(0.03)
+    return False
+
+
+def _bft_material(n=5):
+    org = cryptogen.generate_org("OrdererMSP", "orderer.example.com",
+                                 peers=0, orderers=n, users=0)
+    mgr = MSPManager({"OrdererMSP": org.msp()})
+    ids = [f"o{i}" for i in range(n)]
+    signers = {
+        oid: cryptogen.signing_identity(
+            org, f"orderer{i}.orderer.example.com")
+        for i, oid in enumerate(ids)
+    }
+    verifiers = {
+        oid: mgr.deserialize_identity(signers[oid].serialized)
+        for oid in ids
+    }
+    return ids, signers, verifiers
+
+
+def _mk_node(tmp_path, oid, cluster, signers, verifiers, retention=4):
+    return OrdererNode(
+        oid, str(tmp_path / oid), cluster,
+        batch_config=BatchConfig(max_message_count=1, batch_timeout_s=0.1),
+        consensus="bft", signer=signers[oid], verifiers=dict(verifiers),
+        view_timeout=1.0,
+    )
+
+
+async def _mk_bft_cluster(tmp_path, ids, signers, verifiers, retention=4):
+    cluster = {}
+    nodes = {}
+    for oid in ids:
+        n = _mk_node(tmp_path, oid, cluster, signers, verifiers)
+        await n.start()
+        cluster[oid] = ("127.0.0.1", n.port)
+        nodes[oid] = n
+    for n in nodes.values():
+        n.cluster.update(cluster)
+        chain = n.join_channel(CHANNEL)
+        chain.wal_retention = retention
+    return nodes, cluster
+
+
+def test_bft_replica_catchup_after_compaction(tmp_path):
+    """A BFT replica that slept through the cluster's compaction window
+    recovers via block catch-up: live COMMIT traffic references
+    sequences past its application point, the chain pulls the missing
+    blocks (verifying their 2f+1 commit proofs), install_snapshot
+    fast-forwards the consensus state, and the replica rejoins
+    agreement."""
+    async def scenario():
+        ids, signers, verifiers = _bft_material(4)
+        ids = ids[:4]
+        nodes, cluster = await _mk_bft_cluster(
+            tmp_path, ids, signers, verifiers, retention=4
+        )
+        bc = BroadcastClient(list(cluster.values()))
+        try:
+            assert (await bc.broadcast(
+                CHANNEL, b"warm", retries=90))["status"] == 200
+            victim = nodes["o3"]
+            await victim.stop()
+
+            for i in range(14):  # past retention AND the catchup gap
+                res = await bc.broadcast(CHANNEL, b"m%d" % i, retries=90)
+                assert res["status"] == 200
+            live = [nodes[i] for i in ("o0", "o1", "o2")]
+            assert await _wait(lambda: all(
+                n.chains[CHANNEL].height >= 15 for n in live
+            ), 30)
+            wal0 = nodes["o0"].chains[CHANNEL].raft.wal
+            assert await _wait(lambda: wal0.snap_index > 0, 10)
+
+            # restart o3 from disk: far behind, pre-prepares long gone
+            o3 = _mk_node(tmp_path, "o3", dict(cluster), signers, verifiers)
+            await o3.start()
+            cluster["o3"] = ("127.0.0.1", o3.port)
+            for n in live:
+                n.cluster["o3"] = cluster["o3"]
+            o3.cluster.update(cluster)
+            ch3 = o3.join_channel(CHANNEL)
+            ch3.wal_retention = 4
+            nodes["o3"] = o3
+
+            # new traffic makes the gap visible to o3's catch-up probe
+            for i in range(10):
+                res = await bc.broadcast(
+                    CHANNEL, b"post%d" % i, retries=90)
+                assert res["status"] == 200
+            target = nodes["o0"].chains[CHANNEL].height
+            assert await _wait(lambda: ch3.height >= target, 40)
+            assert ch3.raft.last_applied >= wal0.snap_index
+            # identical headers across the cluster
+            for k in range(target):
+                a = ch3.blocks.get_block(k).header.SerializeToString()
+                b = nodes["o0"].chains[CHANNEL].blocks.get_block(
+                    k).header.SerializeToString()
+                assert a == b
+            await bc.close()
+        finally:
+            for n in nodes.values():
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+
+    run(scenario())
+
+
+def _bft_config_env(consenters, identities):
+    """CONFIG envelope carrying a BFT consenter set WITH identities."""
+    meta = orderer_pb2.RaftConfigMetadata(consenters=[
+        orderer_pb2.RaftConsenter(
+            host=h, port=p, id=i, identity=identities.get(i, b"")
+        )
+        for h, p, i in consenters
+    ])
+    ct = orderer_pb2.ConsensusType(
+        type="bft", metadata=meta.SerializeToString()
+    )
+    root = configtx_pb2.ConfigGroup()
+    root.groups["Orderer"].values["ConsensusType"].value = \
+        ct.SerializeToString()
+    cfg_env = configtx_pb2.ConfigEnvelope(
+        config=configtx_pb2.Config(sequence=1, channel_group=root)
+    )
+    ch = common_pb2.ChannelHeader(
+        type=common_pb2.HeaderType.CONFIG, channel_id=CHANNEL
+    )
+    payload = common_pb2.Payload(data=cfg_env.SerializeToString())
+    payload.header.channel_header = ch.SerializeToString()
+    return common_pb2.Envelope(payload=payload.SerializeToString())
+
+
+def test_bft_add_fifth_consenter_live(tmp_path):
+    """Consenter ADDITION on a live BFT channel: the committed config
+    block (carrying the new node's identity) grows the membership to
+    n=5 — f recomputes to 1, the quorum to 3 — existing replicas admit
+    the newcomer's signed messages, and the newcomer replicates the
+    chain and participates in new agreement."""
+    async def scenario():
+        ids5, signers, verifiers = _bft_material(5)
+        ids4 = ids5[:4]
+        # the initial cluster only knows o0..o3 (o4's identity arrives
+        # via the config block, NOT provisioning)
+        v4 = {k: v for k, v in verifiers.items() if k != "o4"}
+        nodes, cluster = await _mk_bft_cluster(
+            tmp_path, ids4, signers, v4, retention=1000
+        )
+        bc = BroadcastClient(list(cluster.values()))
+        try:
+            for i in range(3):
+                assert (await bc.broadcast(
+                    CHANNEL, b"pre%d" % i, retries=90))["status"] == 200
+
+            o4 = OrdererNode(
+                "o4", str(tmp_path / "o4"), {},
+                batch_config=BatchConfig(max_message_count=1,
+                                         batch_timeout_s=0.1),
+                consensus="bft", signer=signers["o4"],
+                verifiers=dict(verifiers),  # operator provisions its own
+                view_timeout=1.0,
+            )
+            await o4.start()
+            new_addr = ("127.0.0.1", o4.port)
+            consenters = [(h, p, oid) for oid, (h, p) in cluster.items()]
+            consenters.append((new_addr[0], new_addr[1], "o4"))
+            env = _bft_config_env(
+                consenters, {"o4": signers["o4"].serialized}
+            )
+            res = await bc.broadcast(
+                CHANNEL, env.SerializeToString(), retries=90
+            )
+            assert res["status"] == 200
+
+            # membership + thresholds + verifier registry all rotated
+            assert await _wait(lambda: all(
+                "o4" in n.chains[CHANNEL].raft.peers
+                and n.chains[CHANNEL].raft.n == 5
+                and n.chains[CHANNEL].raft.quorum == 3
+                and "o4" in n.chains[CHANNEL].raft.verifiers
+                for n in nodes.values()
+            ), 20)
+
+            # o4 joins; it detects its gap from live COMMIT traffic
+            # (sequences past its application point) and closes it by
+            # block catch-up, then participates in new agreement
+            o4.cluster.update({**cluster, "o4": new_addr})
+            ch4 = o4.join_channel(CHANNEL)
+            nodes["o4"] = o4
+            for i in range(10):
+                assert (await bc.broadcast(
+                    CHANNEL, b"post%d" % i, retries=90))["status"] == 200
+            assert await _wait(
+                lambda: ch4.height == nodes["o0"].chains[CHANNEL].height,
+                40,
+            )
+            assert ch4.height >= 14  # pre + config + post all present
+            await bc.close()
+        finally:
+            for n in nodes.values():
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+
+    run(scenario())
+
+
+def test_peer_censorship_monitor_rotates_off_withholding_orderer(tmp_path):
+    """BFT deliver-client stance: an orderer that keeps the Deliver
+    stream open while WITHHOLDING blocks cannot stall the peer — the
+    monitor cross-checks other orderers' heights and rotates
+    (blocksprovider/bft_censorship_monitor.go).  A disconnect-only
+    failover never fires here because the censor never disconnects."""
+    import json as _json
+
+    from fabric_tpu.comm.rpc import RpcServer
+    from fabric_tpu.crypto import policy as pol
+    from fabric_tpu.peer.chaincode import ChaincodeRuntime
+    from fabric_tpu.peer.node import PeerNode
+    from fabric_tpu.peer.validator import NamespaceInfo, PolicyProvider
+
+    async def scenario():
+        # one REAL (solo-bft dev) orderer with a few blocks
+        orderer = OrdererNode(
+            "o0", str(tmp_path / "o0"), {},
+            batch_config=BatchConfig(max_message_count=1,
+                                     batch_timeout_s=0.1),
+        )
+        await orderer.start()
+        orderer.cluster["o0"] = ("127.0.0.1", orderer.port)
+        orderer.join_channel("cns")
+        bc = BroadcastClient([("127.0.0.1", orderer.port)])
+        for i in range(3):
+            assert (await bc.broadcast(
+                "cns", b"m%d" % i, retries=60))["status"] == 200
+        await bc.close()
+
+        # the CENSOR: accepts Deliver and sends NOTHING, forever
+        censor = RpcServer("127.0.0.1", 0)
+
+        async def _black_hole(stream):
+            await stream.__anext__()  # consume the seek request
+            await asyncio.sleep(3600)
+            yield b""  # pragma: no cover — keeps this an async gen
+
+        censor.register("Deliver", _black_hole)
+        await censor.start()
+
+        org = cryptogen.generate_org("Org1MSP", "org1.example.com",
+                                     peers=1, users=1)
+        mgr = MSPManager({"Org1MSP": org.msp()})
+        peer = PeerNode(
+            "p0", str(tmp_path / "p0"), mgr,
+            cryptogen.signing_identity(org, "peer0.org1.example.com"),
+            ChaincodeRuntime(),
+        )
+        await peer.start()
+        prov = PolicyProvider({}, default=NamespaceInfo(
+            policy=pol.from_dsl("OutOf(1, 'Org1MSP.peer')")))
+        ch = peer.join_channel("cns", prov)
+        try:
+            # censor FIRST in the failover list: without the monitor
+            # the peer would hang on its silent stream forever
+            ch.start_deliver(
+                [("127.0.0.1", censor.port),
+                 ("127.0.0.1", orderer.port)],
+                censorship_check_s=0.5,
+            )
+            assert await _wait(lambda: ch.height >= 3, 25), ch.height
+        finally:
+            await peer.stop()
+            await censor.stop()
+            await orderer.stop()
+
+    run(scenario())
