@@ -247,6 +247,47 @@ def _cmd_invoke(args, evaluate=False):
     asyncio.run(go())
 
 
+def _cmd_ccpackage(args):
+    from fabric_tpu.peer import ccpackage
+
+    raw = ccpackage.package_ccaas(args.label, args.address)
+    with open(args.output, "wb") as f:
+        f.write(raw)
+    print(json.dumps({
+        "package_id": ccpackage.package_id(args.label, raw),
+        "path": args.output,
+    }))
+
+
+def _cmd_ccinstall(args):
+    from fabric_tpu.comm.rpc import RpcClient
+
+    with open(args.package, "rb") as f:
+        raw = f.read()
+
+    async def go():
+        cli = RpcClient(args.host, args.port, ssl_ctx=_cli_ssl(args))
+        await cli.connect()
+        res = await cli.unary("InstallChaincode", raw, timeout=60.0)
+        await cli.close()
+        print(res.decode())
+
+    asyncio.run(go())
+
+
+def _cmd_ccqueryinstalled(args):
+    from fabric_tpu.comm.rpc import RpcClient
+
+    async def go():
+        cli = RpcClient(args.host, args.port, ssl_ctx=_cli_ssl(args))
+        await cli.connect()
+        res = await cli.unary("QueryInstalled", b"{}")
+        await cli.close()
+        print(res.decode())
+
+    asyncio.run(go())
+
+
 def _cmd_ledgerutil(args):
     from fabric_tpu.tools import ledgerutil as lu
 
@@ -337,6 +378,24 @@ def main(argv=None):
         c.add_argument("--msp-id", required=True)
         c.add_argument("args", nargs="+")
 
+    c = sub.add_parser("ccpackage",
+                       help="build a ccaas chaincode package")
+    c.add_argument("--label", required=True)
+    c.add_argument("--address", required=True,
+                   help="ccaas endpoint host:port (connection.json)")
+    c.add_argument("--output", required=True)
+
+    c = sub.add_parser("ccinstall",
+                       help="install a chaincode package on a peer")
+    c.add_argument("--host", default="127.0.0.1")
+    c.add_argument("--port", type=int, required=True)
+    c.add_argument("--package", required=True)
+
+    c = sub.add_parser("ccqueryinstalled",
+                       help="list packages installed on a peer")
+    c.add_argument("--host", default="127.0.0.1")
+    c.add_argument("--port", type=int, required=True)
+
     c = sub.add_parser("ledgerutil", help="offline ledger forensics")
     c.add_argument("action", choices=["verify", "compare"])
     c.add_argument("dirs", nargs="+")
@@ -396,6 +455,12 @@ def main(argv=None):
         _cmd_invoke(args)
     elif args.cmd == "query":
         _cmd_invoke(args, evaluate=True)
+    elif args.cmd == "ccpackage":
+        _cmd_ccpackage(args)
+    elif args.cmd == "ccinstall":
+        _cmd_ccinstall(args)
+    elif args.cmd == "ccqueryinstalled":
+        _cmd_ccqueryinstalled(args)
     elif args.cmd == "ledgerutil":
         _cmd_ledgerutil(args)
     elif args.cmd == "snapshot":

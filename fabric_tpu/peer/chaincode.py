@@ -42,13 +42,14 @@ class ContractStub:
 
     def __init__(self, runtime: "ChaincodeRuntime", sim, namespace: str,
                  args: list[bytes], transient: dict | None = None,
-                 creator: bytes = b""):
+                 creator: bytes = b"", channel: str = ""):
         self._rt = runtime
         self._sim = sim
         self.namespace = namespace
         self.args = args
         self.transient = transient or {}
         self.creator = creator
+        self.channel = channel
         self.events: list[tuple[str, bytes]] = []
 
     # state ---------------------------------------------------------------
@@ -95,7 +96,8 @@ class ContractStub:
         its rwset into the SAME simulator under its own namespace
         (handler.go HandleInvokeChaincode semantics)."""
         return self._rt.execute(self._sim, chaincode, args,
-                                transient=self.transient, creator=self.creator)
+                                transient=self.transient,
+                                creator=self.creator, channel=self.channel)
 
 
 class Contract:
@@ -125,8 +127,19 @@ class ChaincodeRuntime:
     """namespace → executable contract (the ChaincodeSupport registry
     analog; launchers register in-process or ccaas-backed handlers)."""
 
-    def __init__(self):
+    def __init__(self, resolver=None):
         self._contracts: dict[str, object] = {}
+        # resolver(name, channel) → Contract | None: called on a
+        # registry miss — the peer binds it to the lifecycle install
+        # store so a COMMITTED definition whose approved package is
+        # installed launches without manual registration (the
+        # reference's lifecycle → external-builder launch path).
+        # Resolutions cache PER (channel, name) — the same name on two
+        # channels may bind different packages — and are dropped when
+        # a committed block writes the lifecycle namespace (upgrades
+        # must rebind).
+        self.resolver = resolver
+        self._resolved: dict[tuple, object] = {}
 
     def register(self, name: str, contract) -> None:
         self._contracts[name] = contract
@@ -134,12 +147,25 @@ class ChaincodeRuntime:
     def registered(self, name: str) -> bool:
         return name in self._contracts
 
+    def invalidate_resolved(self) -> None:
+        """Lifecycle state changed (commit/upgrade): re-resolve on the
+        next invoke instead of serving a stale endpoint."""
+        self._resolved.clear()
+
     def execute(self, sim, name: str, args: list[bytes],
-                transient: dict | None = None, creator: bytes = b"") -> Response:
+                transient: dict | None = None, creator: bytes = b"",
+                channel: str = "") -> Response:
         contract = self._contracts.get(name)
         if contract is None:
+            contract = self._resolved.get((channel, name))
+        if contract is None and self.resolver is not None:
+            contract = self.resolver(name, channel)
+            if contract is not None:
+                self._resolved[(channel, name)] = contract
+        if contract is None:
             raise ChaincodeError(f"chaincode {name} not installed")
-        stub = ContractStub(self, sim, name, args, transient, creator)
+        stub = ContractStub(self, sim, name, args, transient, creator,
+                            channel=channel)
         resp = contract.invoke(stub)
         resp.events = stub.events  # type: ignore[attr-defined]
         return resp
@@ -228,12 +254,14 @@ class LayeredRuntime(ChaincodeRuntime):
     def registered(self, name: str) -> bool:
         return name in self._contracts or self._base.registered(name)
 
-    def execute(self, sim, name: str, args, transient=None, creator=b""):
+    def execute(self, sim, name: str, args, transient=None, creator=b"",
+                channel: str = ""):
         if name in self._contracts:
             contract = self._contracts[name]
-            stub = ContractStub(self, sim, name, args, transient, creator)
+            stub = ContractStub(self, sim, name, args, transient, creator,
+                                channel=channel)
             resp = contract.invoke(stub)
             resp.events = stub.events  # type: ignore[attr-defined]
             return resp
         return self._base.execute(sim, name, args, transient=transient,
-                                  creator=creator)
+                                  creator=creator, channel=channel)

@@ -74,7 +74,8 @@ class Endorser:
         sim = TxSimulator(self.state)
         try:
             resp = self.runtime.execute(
-                sim, cc_name, args, transient=transient, creator=sh.creator
+                sim, cc_name, args, transient=transient, creator=sh.creator,
+                channel=ch.channel_id,
             )
         except ChaincodeError as e:
             return self._err(500, str(e))

@@ -127,12 +127,27 @@ class LifecycleContract(Contract):
         ready = self._approvals(stub, name.decode(), int(sequence), spec)
         return json.dumps(ready, sort_keys=True).encode()
 
+    @staticmethod
+    def _norm_spec(raw: bytes) -> bytes:
+        """Approval-comparison form: the package id is an ORG-LOCAL
+        binding (which build this org runs), not part of the agreed
+        definition — the reference likewise excludes packageID from
+        the definition hash, so orgs running different builds of the
+        same contract still converge."""
+        d = json.loads(raw or b"{}")
+        if not isinstance(d, dict):
+            # a non-object approval can never normalize-match a real
+            # spec; canonicalize without crashing commit for everyone
+            return json.dumps(d, sort_keys=True).encode()
+        d.pop("package_id", None)
+        return json.dumps(d, sort_keys=True).encode()
+
     def _approvals(self, stub, name: str, seq: int, spec: bytes) -> dict:
-        want = json.dumps(json.loads(spec or b"{}"), sort_keys=True).encode()
+        want = self._norm_spec(spec)
         out = {}
         for org in self.org_lister():
             got = stub.get_state(approval_key(name, seq, org))
-            out[org] = got is not None and got == want
+            out[org] = got is not None and self._norm_spec(got) == want
         return out
 
     def commit(self, stub, name: bytes, sequence: bytes, spec: bytes = b"{}"):

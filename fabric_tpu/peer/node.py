@@ -304,6 +304,14 @@ class PeerChannel:
         # (confighistory/mgr.go, reconciler eligibility on old blocks)
         from fabric_tpu.peer.lifecycle import LIFECYCLE_NS
 
+        # an upgrade (new committed sequence → possibly a new package/
+        # endpoint) must drop lazily-resolved ccaas bindings
+        rt = getattr(self, "runtime", None)
+        if rt is not None and any(
+            ns == LIFECYCLE_NS for (ns, _k) in batch.updates
+        ):
+            rt.invalidate_resolved()
+
         prefix = "namespaces/fields/"
         for (ns, key), vv in batch.items():
             if ns == LIFECYCLE_NS and key.startswith(prefix)                     and key.endswith("/Definition") and vv.value:
@@ -644,6 +652,11 @@ class PeerNode:
         self.msp = msp_manager
         self.signer = signer
         self.runtime = runtime or ChaincodeRuntime()
+        from fabric_tpu.peer.ccpackage import PackageStore
+
+        self.packages = PackageStore(data_dir)
+        if self.runtime.resolver is None:
+            self.runtime.resolver = self._resolve_chaincode
         self.tls = tls  # comm.rpc.TlsProfile: mTLS on every surface
         self.channels: dict[str, PeerChannel] = {}
         self.server = RpcServer(
@@ -652,6 +665,70 @@ class PeerNode:
         from fabric_tpu.discovery import PeerRegistry
 
         self.registry = PeerRegistry()  # org → endorsing peers (gateway/discovery)
+
+    # -- lifecycle install / package resolution ------------------------------
+
+    async def _on_install(self, req: bytes) -> bytes:
+        """InstallChaincode: persist a package to the install store
+        (internal/peer/lifecycle/chaincode/install.go; transport-level
+        admission is the node's mTLS client auth)."""
+        try:
+            info = self.packages.install(req)
+        except ValueError as e:
+            return json.dumps({"status": 400, "message": str(e)}).encode()
+        return json.dumps({"status": 200, **info}).encode()
+
+    async def _on_query_installed(self, req: bytes) -> bytes:
+        return json.dumps(
+            {"status": 200, "installed": self.packages.list()}
+        ).encode()
+
+    def _resolve_chaincode(self, name: str, channel: str = ""):
+        """Registry-miss launcher: a namespace with a COMMITTED
+        lifecycle definition ON THIS CHANNEL whose package (the id
+        bound by my org's approval) is installed here gets a ccaas
+        proxy to the endpoint its connection.json names — the
+        external-builder launch path, minus Docker (by design).  The
+        channel scoping matters: the same name on two channels may
+        bind different packages."""
+        import re as _re
+
+        from fabric_tpu.peer.ccaas import CCaaSProxy
+        from fabric_tpu.peer.lifecycle import (
+            LIFECYCLE_NS, ChaincodeDefinition, approval_key,
+            definition_key,
+        )
+
+        ch = self.channels.get(channel)
+        if ch is None:
+            return None
+        my_msp = getattr(self.signer, "msp_id", None)
+        state = ch.ledger.state
+        vv = state.get_state(LIFECYCLE_NS, definition_key(name))
+        if vv is None:
+            return None
+        try:
+            cd = ChaincodeDefinition.from_bytes(vv.value)
+        except Exception:
+            return None
+        # the package THIS ORG approved for the current sequence
+        av = state.get_state(
+            LIFECYCLE_NS, approval_key(name, cd.sequence, my_msp or "")
+        )
+        if av is None:
+            return None
+        try:
+            spec = json.loads(av.value)
+            pkg_id = spec.get("package_id", "") if isinstance(
+                spec, dict) else ""
+        except Exception:
+            return None
+        conn = self.packages.connection(pkg_id) if pkg_id else None
+        addr = (conn or {}).get("address", "")
+        m = _re.fullmatch(r"(.+):(\d+)", addr)
+        if m:
+            return CCaaSProxy(name, m.group(1), int(m.group(2)))
+        return None
 
     def join_channel(self, channel_id: str, policy_provider: PolicyProvider | None = None,
                      state_db=None, config_processor=None,
@@ -664,6 +741,7 @@ class PeerNode:
             genesis_block=genesis_block, snapshot_dir=snapshot_dir,
         )
         ch.client_ssl = self.tls.client_ctx() if self.tls else None
+        ch.runtime = self.runtime  # resolved-binding invalidation hook
         self.channels[channel_id] = ch
         gsvc = getattr(self, "gossip_service", None)
         if gsvc is not None:
@@ -679,6 +757,8 @@ class PeerNode:
         self.server.register_unary("Info", self._on_info)
         self.server.register_unary("Discover", self._on_discover)
         self.server.register_unary("Snapshot", self._on_snapshot)
+        self.server.register_unary("InstallChaincode", self._on_install)
+        self.server.register_unary("QueryInstalled", self._on_query_installed)
         from fabric_tpu.peer import gateway as gw
 
         self.gateway = gw.register(self)

@@ -128,7 +128,9 @@ def test_cli_network(tmp_path):
             "msp_dir": f"{org_dir}/nodes/peer0.{os.path.basename(org_dir)}/msp",
             "tls": tls_cfg(org_dir, f"peer0.{os.path.basename(org_dir)}"),
             "org_msps": [org1, org2],
-            "chaincodes": [{"name": CC, "host": "127.0.0.1", "port": cc_port}],
+            # NO static chaincode registration: the peers must resolve
+            # CC from the INSTALLED package bound by their org's
+            # approval (the install/package flow under test)
             "peers": [{"msp_id": other_msp, "host": "127.0.0.1",
                        "port": other_port}],
             "channels": [{
@@ -163,9 +165,30 @@ def test_cli_network(tmp_path):
                    "--tls-key",
                    f"{org1}/nodes/peer0.org1.example.com/tls/key.pem")
 
-        # chaincode lifecycle: approve from EACH org, then commit — the
-        # reference's approve/commit flow driven through the gateway
-        spec = json.dumps({"policy": {"ref": "Endorsement"}})
+        # chaincode package + install on BOTH peers (package.go /
+        # install.go): the approve step then binds the package id
+        pkg_path = str(tmp_path / "kv.tgz")
+        res = _cli("ccpackage", "--label", "kv_1",
+                   "--address", f"127.0.0.1:{cc_port}",
+                   "--output", pkg_path)
+        assert res.returncode == 0, res.stdout + res.stderr
+        pkg_id = json.loads(res.stdout.strip().splitlines()[-1])["package_id"]
+        for pp in (p1_port, p2_port):
+            res = _cli(*cli_tls, "ccinstall", "--port", str(pp),
+                       "--package", pkg_path)
+            assert res.returncode == 0, res.stdout + res.stderr
+            out = json.loads(res.stdout.strip().splitlines()[-1])
+            assert out["status"] == 200 and out["package_id"] == pkg_id
+        res = _cli(*cli_tls, "ccqueryinstalled", "--port", str(p1_port))
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert json.loads(res.stdout.strip().splitlines()[-1])[
+            "installed"] == [{"package_id": pkg_id, "label": "kv_1"}]
+
+        # chaincode lifecycle: approve from EACH org (binding the
+        # installed package id), then commit — the reference's
+        # approve/commit flow driven through the gateway
+        spec = json.dumps({"policy": {"ref": "Endorsement"},
+                           "package_id": pkg_id})
         for msp_id, org_dir in (("Org1MSP", org1), ("Org2MSP", org2)):
             u = f"{org_dir}/users/User1@{os.path.basename(org_dir)}/msp"
             res = _cli(
