@@ -67,101 +67,119 @@ def _cmd_configtxgen(args):
     print(f"wrote genesis block for {prof['channel']} to {args.output}")
 
 
-def _node_tls(cfg: dict):
-    """Node mTLS material from the JSON config's ``tls`` section:
-    {"cert": ..., "key": ..., "ca": ...} file paths (cryptogen's
+def _node_tls(cfg):
+    """Node mTLS material from the typed ``tls`` section (cryptogen's
     nodes/<name>/tls layout)."""
-    t = cfg.get("tls")
-    if not t:
+    t = cfg.tls
+    if t is None or not t.cert:
         return None
     from fabric_tpu.comm.rpc import TlsProfile
 
-    return TlsProfile.load(t["cert"], t["key"], t["ca"])
+    return TlsProfile.load(t.cert, t.key, t.ca)
 
 
-async def _run_orderer(cfg: dict):
+async def _run_orderer(cfg):
     from fabric_tpu.crypto import cryptogen as cg
+    from fabric_tpu.nodeconfig import OrdererConfig
     from fabric_tpu.ordering.blockcutter import BatchConfig
     from fabric_tpu.ordering.node import OrdererNode
     from fabric_tpu.protos import common_pb2
 
+    assert isinstance(cfg, OrdererConfig)
     signer = None
-    if cfg.get("msp_dir"):
-        signer = cg.load_signing_identity(cfg["msp_dir"], cfg["msp_id"])
+    if cfg.msp_dir:
+        signer = cg.load_signing_identity(cfg.msp_dir, cfg.msp_id)
     node = OrdererNode(
-        cfg["id"], cfg["data_dir"],
-        {k: tuple(v) for k, v in cfg.get("cluster", {}).items()},
-        host=cfg.get("host", "127.0.0.1"), port=cfg.get("port", 0),
+        cfg.id, cfg.data_dir, cfg.cluster,
+        host=cfg.host, port=cfg.port,
         batch_config=BatchConfig(
-            max_message_count=cfg.get("max_message_count", 500),
-            batch_timeout_s=cfg.get("batch_timeout_s", 0.2),
+            max_message_count=cfg.max_message_count,
+            batch_timeout_s=cfg.batch_timeout_s,
         ),
+        consensus=cfg.consensus, view_timeout=cfg.view_timeout,
         signer=signer,
         tls=_node_tls(cfg),
     )
-    await node.start(operations_port=cfg.get("operations_port"))
+    node.broadcast_rate = cfg.broadcast_rate
+    await node.start(operations_port=cfg.operations_port)
     print(f"orderer {node.id} serving on :{node.port}", flush=True)
-    for ch in cfg.get("channels", []):
+    for ch in cfg.channels:
+        name = ch if isinstance(ch, str) else ch.name
         genesis = None
-        if isinstance(ch, dict) and ch.get("genesis"):
+        if not isinstance(ch, str) and ch.genesis:
             genesis = common_pb2.Block()
-            with open(ch["genesis"], "rb") as f:
+            with open(ch.genesis, "rb") as f:
                 genesis.ParseFromString(f.read())
-            node.join_channel(ch["name"], genesis)
-        else:
-            node.join_channel(ch if isinstance(ch, str) else ch["name"])
+        chain = node.join_channel(name, genesis)
+        chain.wal_retention = cfg.wal_retention
     await asyncio.Event().wait()
 
 
-async def _run_peer(cfg: dict):
+async def _run_peer(cfg):
     from fabric_tpu.crypto import cryptogen as cg
     from fabric_tpu.crypto.msp import MSPManager
     from fabric_tpu.discovery import PeerInfo
+    from fabric_tpu.nodeconfig import PeerConfig
     from fabric_tpu.peer.ccaas import CCaaSProxy
     from fabric_tpu.peer.chaincode import ChaincodeRuntime
     from fabric_tpu.peer.node import PeerNode
     from fabric_tpu.protos import common_pb2
 
-    signer = cg.load_signing_identity(cfg["msp_dir"], cfg["msp_id"])
+    assert isinstance(cfg, PeerConfig)
+    signer = cg.load_signing_identity(cfg.msp_dir, cfg.msp_id)
     mgr = MSPManager()
-    for org_dir in cfg.get("org_msps", []):
+    for org_dir in cfg.org_msps:
         mgr.add(cg.load_org_msp(org_dir))
     runtime = ChaincodeRuntime()
-    for cc in cfg.get("chaincodes", []):
-        runtime.register(
-            cc["name"], CCaaSProxy(cc["name"], cc["host"], cc["port"])
-        )
+    for cc in cfg.chaincodes:
+        runtime.register(cc.name, CCaaSProxy(cc.name, cc.host, cc.port))
     node = PeerNode(
-        cfg["id"], cfg["data_dir"], mgr, signer, runtime,
-        host=cfg.get("host", "127.0.0.1"), port=cfg.get("port", 0),
+        cfg.id, cfg.data_dir, mgr, signer, runtime,
+        host=cfg.host, port=cfg.port,
         tls=_node_tls(cfg),
     )
-    await node.start(operations_port=cfg.get("operations_port"))
+    await node.start(operations_port=cfg.operations_port)
     print(f"peer {node.id} serving on :{node.port}", flush=True)
-    for p in cfg.get("peers", []):
-        node.registry.add(PeerInfo(p["msp_id"], p["host"], p["port"]))
-    for ch in cfg.get("channels", []):
+    for p in cfg.peers:
+        node.registry.add(PeerInfo(p.msp_id, p.host, p.port))
+    for ch in cfg.channels:
+        name = ch if isinstance(ch, str) else ch.name
         genesis = None
-        if ch.get("genesis"):
+        if not isinstance(ch, str) and ch.genesis:
             genesis = common_pb2.Block()
-            with open(ch["genesis"], "rb") as f:
+            with open(ch.genesis, "rb") as f:
                 genesis.ParseFromString(f.read())
         chan = node.join_channel(
-            ch["name"], genesis_block=genesis,
-            snapshot_dir=ch.get("snapshot_dir"),
+            name, genesis_block=genesis,
+            snapshot_dir=(None if isinstance(ch, str) or not ch.snapshot_dir
+                          else ch.snapshot_dir),
         )
-        orderers = [tuple(o) for o in ch.get("orderers", [])]
+        chan.ledger.blocks.group_commit = cfg.group_commit
+        chan.transient_retention = cfg.transient_retention
+        orderers = ([] if isinstance(ch, str)
+                    else [tuple(o) for o in ch.orderers])
         if orderers:
-            chan.start_deliver(orderers)
-        if ch.get("anti_entropy"):
-            node.gossip_service.start_anti_entropy(ch["name"])
-        node.gossip_service.start_reconciler(ch["name"])
+            chan.start_deliver(
+                orderers,
+                censorship_check_s=cfg.deliver_censorship_check_s,
+            )
+        if not isinstance(ch, str) and ch.anti_entropy:
+            node.gossip_service.start_anti_entropy(name)
+        node.gossip_service.start_reconciler(name)
     await asyncio.Event().wait()
 
 
 def _cmd_node(args, runner):
-    with open(args.config) as f:
-        cfg = json.load(f)
+    from fabric_tpu.nodeconfig import (
+        ConfigError, load_orderer_config, load_peer_config,
+    )
+
+    loader = load_peer_config if runner is _run_peer else load_orderer_config
+    try:
+        cfg = loader(args.config)
+    except ConfigError as e:
+        print(f"config error: {e}", file=sys.stderr)
+        sys.exit(2)
     try:
         asyncio.run(runner(cfg))
     except KeyboardInterrupt:

@@ -361,6 +361,7 @@ static Span field_bytes(const uint8_t* p, size_t n, uint32_t field) {
     uint64_t key;
     if (!varint(p, end, key)) return {};
     uint32_t f = uint32_t(key >> 3), wt = uint32_t(key & 7);
+    if (f == 0) return {};  // upb rejects field number 0
     if (wt == 2) {
       uint64_t len;
       if (!varint(p, end, len) || len > uint64_t(end - p)) return {};
@@ -391,6 +392,7 @@ static bool field_varint(const uint8_t* p, size_t n, uint32_t field,
     uint64_t key;
     if (!varint(p, end, key)) return false;
     uint32_t f = uint32_t(key >> 3), wt = uint32_t(key & 7);
+    if (f == 0) return false;  // upb rejects field number 0
     if (wt == 0) {
       uint64_t v;
       if (!varint(p, end, v)) return false;
@@ -452,6 +454,232 @@ static bool der_sig(const uint8_t* p, size_t n, uint8_t r[32], uint8_t s[32]) {
 static void put_span(int64_t* arr, int i, const uint8_t* base, Span s) {
   arr[2 * i] = s.ok ? (s.p - base) : -1;
   arr[2 * i + 1] = s.ok ? int64_t(s.n) : 0;
+}
+
+// upb rejects invalid UTF-8 in proto3 STRING fields; anything the
+// Python parser would refuse must leave the fast path, or peers built
+// with and without the toolchain would fork on the same block.
+static bool valid_utf8(const uint8_t* p, size_t n) {
+  size_t i = 0;
+  while (i < n) {
+    uint8_t c = p[i];
+    if (c < 0x80) { i++; continue; }
+    int extra;
+    uint32_t cp, min;
+    if ((c & 0xE0) == 0xC0) { extra = 1; cp = c & 0x1F; min = 0x80; }
+    else if ((c & 0xF0) == 0xE0) { extra = 2; cp = c & 0x0F; min = 0x800; }
+    else if ((c & 0xF8) == 0xF0) { extra = 3; cp = c & 0x07; min = 0x10000; }
+    else return false;
+    if (i + extra >= n) return false;
+    for (int k = 1; k <= extra; k++) {
+      uint8_t cc = p[i + k];
+      if ((cc & 0xC0) != 0x80) return false;
+      cp = (cp << 6) | (cc & 0x3F);
+    }
+    if (cp < min || cp > 0x10FFFF || (cp >= 0xD800 && cp <= 0xDFFF))
+      return false;
+    i += extra + 1;
+  }
+  return true;
+}
+
+// one-level wire-framing walk: true iff every field's framing parses
+// (the acceptance bar upb applies to every submessage it decodes —
+// unknown fields with VALID framing are fine, torn ones are not)
+static bool frame_ok(const uint8_t* p, size_t n) {
+  const uint8_t* end = p + n;
+  while (p < end) {
+    uint64_t key;
+    if (!varint(p, end, key)) return false;
+    if ((key >> 3) == 0) return false;  // upb rejects field number 0
+    uint32_t wt = uint32_t(key & 7);
+    if (wt == 2) {
+      uint64_t len;
+      if (!varint(p, end, len) || len > uint64_t(end - p)) return false;
+      p += len;
+    } else if (wt == 0) {
+      uint64_t v;
+      if (!varint(p, end, v)) return false;
+    } else if (wt == 5) {
+      if (uint64_t(end - p) < 4) return false;
+      p += 4;
+    } else if (wt == 1) {
+      if (uint64_t(end - p) < 8) return false;
+      p += 8;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+// TODO(cleanup): the strict helpers below share one wire-walk
+// skeleton; consolidating them onto a visitor template (the
+// mvccprep.cpp walk() shape) would remove the duplication.  Deferred
+// deliberately: their behavior is pinned by the randomized fuzz +
+// equivalence sweep (tests/test_native_fuzz.py), and a mechanical
+// refactor of the adversarial-input parser is higher risk than the
+// duplication it removes.
+//
+// occurrences of length-delimited field `field` — upb MERGES duplicate
+// singular submessages (their repeated subfields concatenate), which
+// last-occurrence extraction cannot replicate: any submessage the fast
+// path descends into must appear exactly once or the envelope takes
+// the python lane
+static int count_wt2(const uint8_t* p, size_t n, uint32_t field) {
+  const uint8_t* end = p + n;
+  int cnt = 0;
+  while (p < end) {
+    uint64_t key;
+    if (!varint(p, end, key)) return -1;
+    uint32_t f = uint32_t(key >> 3), wt = uint32_t(key & 7);
+    if (f == 0) return -1;
+    if (wt == 2) {
+      uint64_t len;
+      if (!varint(p, end, len) || len > uint64_t(end - p)) return -1;
+      if (f == field) cnt++;
+      p += len;
+    } else if (wt == 0) {
+      uint64_t v;
+      if (!varint(p, end, v)) return -1;
+    } else if (wt == 5) {
+      if (uint64_t(end - p) < 4) return -1;
+      p += 4;
+    } else if (wt == 1) {
+      if (uint64_t(end - p) < 8) return -1;
+      p += 8;
+    } else {
+      return -1;
+    }
+  }
+  return cnt;
+}
+
+// ChannelHeader strictness: upb validates the Timestamp submessage's
+// framing (field 3) and the UTF-8 of channel_id(4) / tx_id(5)
+static bool chdr_strict(const uint8_t* p, size_t n) {
+  const uint8_t* end = p + n;
+  while (p < end) {
+    uint64_t key;
+    if (!varint(p, end, key)) return false;
+    uint32_t f = uint32_t(key >> 3), wt = uint32_t(key & 7);
+    if (f == 0) return false;  // upb rejects field number 0
+    if (wt == 2) {
+      uint64_t len;
+      if (!varint(p, end, len) || len > uint64_t(end - p)) return false;
+      if (f == 3 && !frame_ok(p, size_t(len))) return false;
+      if ((f == 4 || f == 5) && !valid_utf8(p, size_t(len))) return false;
+      p += len;
+    } else if (wt == 0) {
+      uint64_t v;
+      if (!varint(p, end, v)) return false;
+    } else if (wt == 5) {
+      if (uint64_t(end - p) < 4) return false;
+      p += 4;
+    } else if (wt == 1) {
+      if (uint64_t(end - p) < 8) return false;
+      p += 8;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+// ChaincodeAction strictness: Response(3) framing + message UTF-8,
+// ChaincodeID(4) framing + path/name/version UTF-8 — all parsed by
+// the Python lane's ChaincodeAction unmarshal
+static bool strings_strict(const uint8_t* p, size_t n, uint32_t lo,
+                           uint32_t hi) {
+  const uint8_t* end = p + n;
+  while (p < end) {
+    uint64_t key;
+    if (!varint(p, end, key)) return false;
+    uint32_t f = uint32_t(key >> 3), wt = uint32_t(key & 7);
+    if (f == 0) return false;  // upb rejects field number 0
+    if (wt == 2) {
+      uint64_t len;
+      if (!varint(p, end, len) || len > uint64_t(end - p)) return false;
+      if (f >= lo && f <= hi && !valid_utf8(p, size_t(len))) return false;
+      p += len;
+    } else if (wt == 0) {
+      uint64_t v;
+      if (!varint(p, end, v)) return false;
+    } else if (wt == 5) {
+      if (uint64_t(end - p) < 4) return false;
+      p += 4;
+    } else if (wt == 1) {
+      if (uint64_t(end - p) < 8) return false;
+      p += 8;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+static bool cca_strict(const uint8_t* p, size_t n) {
+  const uint8_t* end = p + n;
+  while (p < end) {
+    uint64_t key;
+    if (!varint(p, end, key)) return false;
+    uint32_t f = uint32_t(key >> 3), wt = uint32_t(key & 7);
+    if (f == 0) return false;  // upb rejects field number 0
+    if (wt == 2) {
+      uint64_t len;
+      if (!varint(p, end, len) || len > uint64_t(end - p)) return false;
+      if (f == 3 && !strings_strict(p, size_t(len), 2, 2)) return false;
+      if (f == 4 && !strings_strict(p, size_t(len), 1, 3)) return false;
+      p += len;
+    } else if (wt == 0) {
+      uint64_t v;
+      if (!varint(p, end, v)) return false;
+    } else if (wt == 5) {
+      if (uint64_t(end - p) < 4) return false;
+      p += 4;
+    } else if (wt == 1) {
+      if (uint64_t(end - p) < 8) return false;
+      p += 8;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+// Transaction strictness: python uses actions[0] (FIRST, not last) and
+// upb validates the framing of EVERY action — return the first
+// action's span iff all actions frame-parse
+static Span first_action_strict(const uint8_t* p, size_t n) {
+  const uint8_t* end = p + n;
+  Span first{};
+  while (p < end) {
+    uint64_t key;
+    if (!varint(p, end, key)) return {};
+    uint32_t f = uint32_t(key >> 3), wt = uint32_t(key & 7);
+    if (f == 0) return {};  // upb rejects field number 0
+    if (wt == 2) {
+      uint64_t len;
+      if (!varint(p, end, len) || len > uint64_t(end - p)) return {};
+      if (f == 1) {
+        if (!frame_ok(p, size_t(len))) return {};
+        if (!first.ok) first = {p, size_t(len), true};
+      }
+      p += len;
+    } else if (wt == 0) {
+      uint64_t v;
+      if (!varint(p, end, v)) return {};
+    } else if (wt == 5) {
+      if (uint64_t(end - p) < 4) return {};
+      p += 4;
+    } else if (wt == 1) {
+      if (uint64_t(end - p) < 8) return {};
+      p += 8;
+    } else {
+      return {};
+    }
+  }
+  return first;
 }
 
 }  // namespace
@@ -530,12 +758,19 @@ int64_t parse_block(
     Span header = field_bytes(payload.p, payload.n, 1);
     Span data = field_bytes(payload.p, payload.n, 2);
     if (!header.ok) continue;
+    // Payload.header is a SUBMESSAGE: duplicates merge under upb
+    if (count_wt2(payload.p, payload.n, 1) != 1) continue;
     Span chdr = field_bytes(header.p, header.n, 1);
     Span shdr = field_bytes(header.p, header.n, 2);
     if (!chdr.ok || !shdr.ok) continue;
+    // upb parses the SignatureHeader as part of the structural
+    // BAD_PAYLOAD gate — a torn one must take the python lane, not
+    // ride on with empty creator/nonce spans
+    if (!frame_ok(shdr.p, shdr.n)) continue;
     uint64_t type = 0;
     field_varint(chdr.p, chdr.n, 1, type);
     ch_type[i] = int64_t(type);
+    if (!chdr_strict(chdr.p, chdr.n)) continue;  // python lane decides
     Span channel = field_bytes(chdr.p, chdr.n, 4);
     Span txid = field_bytes(chdr.p, chdr.n, 5);
     Span creator = field_bytes(shdr.p, shdr.n, 1);
@@ -563,16 +798,21 @@ int64_t parse_block(
       creator_sig_ok[i] = 1;
 
     if (type != 3 /* ENDORSER_TRANSACTION */ || !data.ok) continue;
-    Span action = field_bytes(data.p, data.n, 1);  // Transaction.actions[0]
+    // FIRST action (python semantics), with every action frame-checked
+    Span action = first_action_strict(data.p, data.n);
     if (!action.ok) continue;
     Span cap = field_bytes(action.p, action.n, 2);  // TransactionAction.payload
     if (!cap.ok) continue;
     Span cea = field_bytes(cap.p, cap.n, 2);  // ChaincodeActionPayload.action
     if (!cea.ok) continue;
+    // .action is a SUBMESSAGE: duplicate occurrences would merge
+    // (endorsements concatenating across them) under upb
+    if (count_wt2(cap.p, cap.n, 2) != 1) continue;
     Span prp = field_bytes(cea.p, cea.n, 1);
     if (!prp.ok) continue;
     Span cca = field_bytes(prp.p, prp.n, 2);  // prp.extension
     if (!cca.ok) continue;
+    if (!cca_strict(cca.p, cca.n)) continue;  // Response/ChaincodeID
     Span results = field_bytes(cca.p, cca.n, 1);
     Span events = field_bytes(cca.p, cca.n, 2);
     put_span(results_span, i, blob, results);
@@ -584,17 +824,22 @@ int64_t parse_block(
     bool endo_fail = false;
     while (p < cend) {
       uint64_t key;
-      if (!varint(p, cend, key)) break;
+      if (!varint(p, cend, key)) { endo_fail = true; break; }
       uint32_t f = uint32_t(key >> 3), wt = uint32_t(key & 7);
+      if (f == 0) { endo_fail = true; break; }  // upb rejects field number 0
       if (wt != 2) {
         uint64_t v;
-        if (wt == 0) { if (!varint(p, cend, v)) break; continue; }
-        if (wt == 5) { if (uint64_t(cend - p) < 4) break; p += 4; continue; }
-        if (wt == 1) { if (uint64_t(cend - p) < 8) break; p += 8; continue; }
-        break;
+        if (wt == 0) { if (!varint(p, cend, v)) { endo_fail = true; break; } continue; }
+        if (wt == 5) { if (uint64_t(cend - p) < 4) { endo_fail = true; break; } p += 4; continue; }
+        if (wt == 1) { if (uint64_t(cend - p) < 8) { endo_fail = true; break; } p += 8; continue; }
+        endo_fail = true;  // malformed framing: upb rejects the WHOLE
+        break;             // ChaincodeActionPayload — python lane decides
       }
       uint64_t flen;
-      if (!varint(p, cend, flen) || flen > uint64_t(cend - p)) break;
+      if (!varint(p, cend, flen) || flen > uint64_t(cend - p)) {
+        endo_fail = true;
+        break;
+      }
       const uint8_t* fp = p;
       p += flen;
       if (f != 2) continue;

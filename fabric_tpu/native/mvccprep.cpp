@@ -55,6 +55,7 @@ static bool walk(const uint8_t* p, size_t n, F&& visit) {
     uint64_t key;
     if (!varint(p, end, key)) return false;
     uint32_t f = uint32_t(key >> 3), wt = uint32_t(key & 7);
+    if (f == 0) return false;  // upb rejects field number 0
     if (wt == 2) {
       uint64_t len;
       if (!varint(p, end, len) || len > uint64_t(end - p)) return false;

@@ -1,0 +1,314 @@
+"""Typed node configuration with defaults, validation, and env-var
+overrides.
+
+Analog of the reference's viper-backed core.yaml / orderer.yaml
+(core/peer/config.go, orderer/common/localconfig/config.go,
+common/viperutil): operators get a SCHEMA — unknown keys are errors
+that name the key (with a did-you-mean), type mismatches are errors
+that name the key and both types, and every scalar knob can be
+overridden without editing files via ``FABTPU_<KEY>`` environment
+variables (``FABTPU_PORT=7051``, ``FABTPU_TLS_CA=/path``,
+``FABTPU_WAL_RETENTION=512`` — the ``CORE_``/``ORDERER_`` prefix
+convention, unified).
+
+The on-disk format stays JSON (what the CLI already reads); this
+module is the typing/validation layer over it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import difflib
+import json
+import os
+from dataclasses import dataclass, field
+
+
+class ConfigError(ValueError):
+    """A configuration problem, phrased so the operator can fix it."""
+
+
+# -- leaf sections ----------------------------------------------------------
+
+
+@dataclass
+class TlsConfig:
+    """Node mTLS material (cryptogen's nodes/<name>/tls layout)."""
+
+    cert: str = ""
+    key: str = ""
+    ca: str = ""
+
+
+@dataclass
+class ChannelRef:
+    name: str = ""
+    genesis: str = ""            # path to the genesis block
+    snapshot_dir: str = ""       # join-from-snapshot directory
+    orderers: list = field(default_factory=list)  # [[host, port], ...]
+    anti_entropy: bool = False   # background gossip catch-up pulls
+
+
+@dataclass
+class ChaincodeRef:
+    """Statically registered ccaas endpoint (the lifecycle install
+    flow resolves chaincodes dynamically; this is the operator
+    shortcut)."""
+
+    name: str = ""
+    host: str = "127.0.0.1"
+    port: int = 0
+
+
+@dataclass
+class PeerRef:
+    msp_id: str = ""
+    host: str = "127.0.0.1"
+    port: int = 0
+
+
+# -- node configs -----------------------------------------------------------
+
+
+@dataclass
+class PeerConfig:
+    """The peer's knob surface (core/peer/config.go analog)."""
+
+    id: str = ""
+    data_dir: str = ""
+    msp_id: str = ""
+    msp_dir: str = ""
+    host: str = "127.0.0.1"
+    port: int = 0
+    operations_port: int | None = None
+    org_msps: list = field(default_factory=list)      # org MSP dirs
+    chaincodes: list = field(default_factory=list)    # [ChaincodeRef]
+    peers: list = field(default_factory=list)         # [PeerRef]
+    channels: list = field(default_factory=list)      # [ChannelRef]
+    tls: TlsConfig | None = None
+    # ledger/commit knobs
+    group_commit: int = 8            # blockstore fsync window (blocks)
+    transient_retention: int = 100   # transient-store purge horizon
+    deliver_censorship_check_s: float = 2.0
+
+
+@dataclass
+class OrdererConfig:
+    """The orderer's knob surface (orderer/common/localconfig)."""
+
+    id: str = ""
+    data_dir: str = ""
+    msp_id: str = ""
+    msp_dir: str = ""
+    host: str = "127.0.0.1"
+    port: int = 0
+    operations_port: int | None = None
+    cluster: dict = field(default_factory=dict)   # id -> [host, port]
+    channels: list = field(default_factory=list)  # [ChannelRef | name]
+    tls: TlsConfig | None = None
+    # blockcutter (orderer.yaml BatchSize/BatchTimeout)
+    max_message_count: int = 500
+    batch_timeout_s: float = 0.2
+    # consensus
+    consensus: str = "raft"          # "raft" | "bft"
+    view_timeout: float = 2.0
+    wal_retention: int = 256
+    broadcast_rate: float = 0.0      # msgs/s per channel; 0 = unlimited
+
+
+_REQUIRED = {"id", "data_dir"}
+
+
+def _is_union(origin) -> bool:
+    import types
+    import typing
+
+    # PEP 604 unions (int | None) have origin types.UnionType, NOT
+    # typing.Union — missing that silently skipped Optional fields
+    return origin is typing.Union or origin is types.UnionType
+
+
+def _coerce(name: str, val, typ):
+    """Type-check/coerce one scalar with an operator-grade error."""
+    import typing
+
+    origin = typing.get_origin(typ)
+    if _is_union(origin):  # Optional[...]
+        args = [a for a in typing.get_args(typ) if a is not type(None)]
+        if val is None:
+            return None
+        return _coerce(name, val, args[0])
+    if typ is float and isinstance(val, int):
+        return float(val)
+    if typ is int and isinstance(val, bool):
+        raise ConfigError(f"key '{name}': expected int, got bool")
+    if typ in (int, float, str, bool) and not isinstance(val, typ):
+        # env vars arrive as strings: coerce them
+        if isinstance(val, str) and typ in (int, float):
+            try:
+                return typ(val)
+            except ValueError:
+                raise ConfigError(
+                    f"key '{name}': cannot parse {val!r} as {typ.__name__}"
+                ) from None
+        if isinstance(val, str) and typ is bool:
+            if val.lower() in ("true", "1", "yes"):
+                return True
+            if val.lower() in ("false", "0", "no"):
+                return False
+            raise ConfigError(
+                f"key '{name}': cannot parse {val!r} as bool"
+            )
+        raise ConfigError(
+            f"key '{name}': expected {typ.__name__}, "
+            f"got {type(val).__name__} ({val!r})"
+        )
+    return val
+
+
+def _build(cls, raw: dict, prefix: str = ""):
+    """dict → dataclass with unknown-key / type errors naming keys."""
+    if not isinstance(raw, dict):
+        raise ConfigError(
+            f"section '{prefix or cls.__name__}': expected an object, "
+            f"got {type(raw).__name__}"
+        )
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    out = {}
+    for key, val in raw.items():
+        if key not in fields:
+            hint = difflib.get_close_matches(key, fields, n=1)
+            did = f" — did you mean '{hint[0]}'?" if hint else ""
+            raise ConfigError(
+                f"unknown key '{prefix}{key}' in {cls.__name__}{did}"
+            )
+        f = fields[key]
+        qual = f"{prefix}{key}"
+        if key == "tls":
+            out[key] = None if val in (None, {}) else _build(
+                TlsConfig, val, prefix=f"{qual}."
+            )
+        elif key == "channels":
+            out[key] = [
+                c if isinstance(c, str)
+                else _build(ChannelRef, c, prefix=f"{qual}[].")
+                for c in _want_list(qual, val)
+            ]
+        elif key == "chaincodes":
+            out[key] = [
+                _build(ChaincodeRef, c, prefix=f"{qual}[].")
+                for c in _want_list(qual, val)
+            ]
+        elif key == "peers":
+            out[key] = [
+                _build(PeerRef, c, prefix=f"{qual}[].")
+                for c in _want_list(qual, val)
+            ]
+        elif key in ("org_msps",):
+            out[key] = _want_list(qual, val)
+        elif key == "cluster":
+            if not isinstance(val, dict):
+                raise ConfigError(f"key '{qual}': expected an object")
+            out[key] = {k: tuple(v) for k, v in val.items()}
+        else:
+            out[key] = _coerce(qual, val, f.type if not isinstance(
+                f.type, str) else _ANNOT[cls.__name__][key])
+    return cls(**out)
+
+
+def _want_list(name, val):
+    if not isinstance(val, list):
+        raise ConfigError(f"key '{name}': expected a list")
+    return val
+
+
+# dataclass annotations arrive as strings under
+# `from __future__ import annotations` — resolve them once
+import typing as _t
+
+_ANNOT = {
+    cls.__name__: _t.get_type_hints(cls)
+    for cls in (PeerConfig, OrdererConfig, TlsConfig, ChannelRef,
+                ChaincodeRef, PeerRef)
+}
+
+ENV_PREFIX = "FABTPU_"
+
+
+def _apply_env(cfg, environ=None):
+    """FABTPU_<FIELD> (and FABTPU_TLS_<FIELD>) override scalars —
+    the CORE_/ORDERER_ env-override convention."""
+    env = os.environ if environ is None else environ
+    hints = _ANNOT[type(cfg).__name__]
+    for f in dataclasses.fields(cfg):
+        typ = hints[f.name]
+        if typ not in (int, float, str, bool) and not _is_union(
+                _t.get_origin(typ)):
+            continue
+        key = ENV_PREFIX + f.name.upper()
+        if key in env:
+            setattr(cfg, f.name, _coerce(f"${key}", env[key], typ))
+    tls_hints = _ANNOT["TlsConfig"]
+    tls_envs = {
+        k: v for k, v in env.items()
+        if k.startswith(ENV_PREFIX + "TLS_")
+    }
+    if tls_envs:
+        if cfg.tls is None:
+            cfg.tls = TlsConfig()
+        for k, v in tls_envs.items():
+            fname = k[len(ENV_PREFIX) + 4:].lower()
+            if fname not in tls_hints:
+                raise ConfigError(f"unknown env override '{k}'")
+            setattr(cfg.tls, fname, v)
+    return cfg
+
+
+def _load(cls, source, environ=None):
+    if isinstance(source, str):
+        try:
+            with open(source) as f:
+                raw = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ConfigError(f"{source}: invalid JSON: {e}") from None
+    else:
+        raw = source
+    cfg = _build(cls, raw)
+    _apply_env(cfg, environ)
+    required = set(_REQUIRED)
+    if cls is PeerConfig:
+        # the peer cannot start without a signing identity (the
+        # orderer can — unsigned dev channels exist)
+        required |= {"msp_dir", "msp_id"}
+    missing = [k for k in required if not getattr(cfg, k)]
+    if missing:
+        raise ConfigError(
+            f"{cls.__name__}: missing required key(s): "
+            + ", ".join(sorted(missing))
+        )
+    if cfg.tls is not None:
+        tmiss = [k for k in ("cert", "key", "ca")
+                 if not getattr(cfg.tls, k)]
+        if tmiss and len(tmiss) < 3:
+            raise ConfigError(
+                "tls section: cert, key, and ca must be set together; "
+                "missing: " + ", ".join(tmiss)
+            )
+        if len(tmiss) == 3:
+            cfg.tls = None  # an all-empty section means no TLS
+    if isinstance(cfg, OrdererConfig) and cfg.consensus not in (
+            "raft", "bft"):
+        raise ConfigError(
+            f"key 'consensus': must be 'raft' or 'bft', "
+            f"got {cfg.consensus!r}"
+        )
+    return cfg
+
+
+def load_peer_config(source, environ=None) -> PeerConfig:
+    """``source``: path to a JSON file or an already-loaded dict."""
+    return _load(PeerConfig, source, environ)
+
+
+def load_orderer_config(source, environ=None) -> OrdererConfig:
+    return _load(OrdererConfig, source, environ)

@@ -1,0 +1,257 @@
+"""Randomized fuzz over the native C++ wire parsers.
+
+blockparse.cpp and mvccprep.cpp hand-roll protobuf walking with
+pointer arithmetic on the adversarial input path (any orderer or peer
+can send a block).  The reference leans on memory-safe Go + `-race`
+across its suite; the C++ fast path needs the equivalent posture:
+
+1. **No crash**: thousands of random mutations (bit flips, truncation,
+   splices, random chunks, duplications) over valid envelopes must
+   never kill the process — the parser either handles the envelope or
+   hands it to the Python lane.
+2. **Fallback equivalence**: whatever the native parser ACCEPTS must
+   produce the exact TRANSACTIONS_FILTER / update batch the pure-
+   Python path produces — a mutation the fast lane mis-parses instead
+   of rejecting is a consensus fork between peers built with and
+   without the toolchain.
+"""
+
+import random
+
+import pytest
+
+import fabric_tpu.native as nat
+from fabric_tpu import protoutil as pu
+from fabric_tpu.crypto import cryptogen
+from fabric_tpu.crypto import policy as pol
+from fabric_tpu.crypto.msp import MSPManager
+from fabric_tpu.ledger.rwset import TxRWSet
+from fabric_tpu.ledger.statedb import MemVersionedDB, UpdateBatch
+from fabric_tpu.peer import txassembly as txa
+from fabric_tpu.peer.validator import (
+    BlockValidator, NamespaceInfo, PolicyProvider,
+)
+
+CHANNEL, CC = "fuzzchan", "fuzzcc"
+N_TX = 16  # the native parser's minimum block size
+
+
+@pytest.fixture(scope="module")
+def net():
+    org1 = cryptogen.generate_org("Org1MSP", "org1.example.com",
+                                  peers=1, users=1)
+    org2 = cryptogen.generate_org("Org2MSP", "org2.example.com", peers=1)
+    mgr = MSPManager({"Org1MSP": org1.msp(), "Org2MSP": org2.msp()})
+    client = cryptogen.signing_identity(org1, "User1@org1.example.com")
+    peers = [
+        cryptogen.signing_identity(org1, "peer0.org1.example.com"),
+        cryptogen.signing_identity(org2, "peer0.org2.example.com"),
+    ]
+    envs = []
+    for i in range(N_TX):
+        _, _, prop = txa.create_signed_proposal(
+            client, CHANNEL, CC, [b"invoke", b"%d" % i]
+        )
+        tx = TxRWSet()
+        n = tx.ns_rwset(CC)
+        n.reads[f"seed{i}"] = (1, i)
+        n.writes[f"w{i}"] = b"value-%d" % i
+        rw = tx.to_proto().SerializeToString()
+        resps = [
+            txa.create_proposal_response(prop, rw, e, CC) for e in peers
+        ]
+        envs.append(
+            txa.assemble_transaction(prop, resps, client).SerializeToString()
+        )
+    prov = PolicyProvider({CC: NamespaceInfo(policy=pol.from_dsl(
+        "OutOf(2, 'Org1MSP.peer', 'Org2MSP.peer')"))})
+    return {"mgr": mgr, "prov": prov, "envs": envs, "client": client}
+
+
+def _seed_state():
+    db = MemVersionedDB()
+    b = UpdateBatch()
+    for i in range(N_TX):
+        b.put(CC, f"seed{i}", b"v", (1, i))
+    db.apply_updates(b, (1, 0))
+    return db
+
+
+def _mutate(rng: random.Random, raw: bytes) -> bytes:
+    """One random structural mutation."""
+    if not raw:
+        return raw
+    op = rng.randrange(6)
+    b = bytearray(raw)
+    if op == 0:  # flip 1-4 random bytes
+        for _ in range(rng.randrange(1, 5)):
+            b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+        return bytes(b)
+    if op == 1:  # truncate
+        return bytes(b[: rng.randrange(len(b))])
+    if op == 2:  # splice a random slice of itself somewhere else
+        i, j = sorted(rng.randrange(len(b)) for _ in range(2))
+        k = rng.randrange(len(b))
+        return bytes(b[:k] + b[i:j] + b[k:])
+    if op == 3:  # overwrite a chunk with random bytes
+        k = rng.randrange(len(b))
+        n = min(len(b) - k, rng.randrange(1, 64))
+        b[k:k + n] = bytes(rng.getrandbits(8) for _ in range(n))
+        return bytes(b)
+    if op == 4:  # duplicate a chunk (length fields now lie)
+        i, j = sorted(rng.randrange(len(b)) for _ in range(2))
+        return bytes(b[:j] + b[i:j] + b[j:])
+    return b""  # empty envelope
+
+
+def _mutated_block(rng, envs, num=2):
+    envs = list(envs)
+    for _ in range(rng.randrange(1, 4)):  # mutate 1-3 envelopes
+        i = rng.randrange(len(envs))
+        envs[i] = _mutate(rng, envs[i])
+    blk = pu.new_block(num, b"prev")
+    for e in envs:
+        blk.data.data.append(e)
+    return pu.finalize_block(blk)
+
+
+def test_fuzz_blockparse_mvccprep_no_crash(net):
+    """10k mutated blocks through the native pre-parse + rwset prep:
+    the process must survive every one (reject → Python lane is fine;
+    a segfault is not)."""
+    from fabric_tpu.native import blockparse as nbp
+    from fabric_tpu.native import mvccprep_py
+
+    if nat.blockparse_lib() is None:
+        pytest.skip("no native toolchain")
+    import numpy as np
+
+    rng = random.Random(0xFAB)
+    base = net["envs"]
+    for it in range(10_000):
+        envs = list(base)
+        i = rng.randrange(len(envs))
+        envs[i] = _mutate(rng, envs[i])
+        if it % 7 == 0:  # sometimes mutate several
+            j = rng.randrange(len(envs))
+            envs[j] = _mutate(rng, envs[j])
+        out = nbp.parse_envelopes(envs)
+        if out is None:
+            continue
+        if it % 5 == 0 and out.ok.any():
+            rwp = mvccprep_py.prep(out, np.ascontiguousarray(out.ok))
+            if rwp is not None:
+                # outputs must stay within their declared bounds —
+                # garbage counts/statuses are the pre-segfault smell
+                assert set(np.unique(rwp.status)) <= {0, 1, 2}
+                assert 0 <= rwp.n_reads <= len(rwp.r_uid)
+                assert 0 <= rwp.n_writes <= len(rwp.w_uid)
+                assert 0 <= rwp.n_keys <= len(rwp.ukey_span)
+
+
+def test_fuzz_native_python_verdict_equivalence(net):
+    """Mutated blocks validated WITH the native fast lane and with it
+    force-disabled must produce identical filters, update batches, and
+    history — the fallback-equivalence contract
+    (tests/test_native_parse.py pins targeted cases; this sweeps
+    randomized ones)."""
+    if nat.blockparse_lib() is None:
+        pytest.skip("no native toolchain")
+    rng = random.Random(0xC0FFEE)
+    mismatches = []
+    for it in range(300):
+        blk = _mutated_block(rng, net["envs"], num=2 + it)
+
+        v_nat = BlockValidator(net["mgr"], net["prov"], _seed_state())
+        flt_n, batch_n, hist_n = v_nat.validate(blk)
+
+        nat._lib_failed.add("blockparse")
+        nat._libs.pop("blockparse", None)
+        try:
+            v_py = BlockValidator(net["mgr"], net["prov"], _seed_state())
+            flt_p, batch_p, hist_p = v_py.validate(blk)
+        finally:
+            nat._lib_failed.discard("blockparse")
+
+        def rows(b):
+            return sorted(
+                (k, vv.value, vv.metadata, vv.version)
+                for k, vv in b.updates.items()
+            )
+
+        if (bytes(flt_n) != bytes(flt_p)
+                or rows(batch_n) != rows(batch_p)
+                or hist_n != hist_p):
+            diff = [
+                (i, a, b)
+                for i, (a, b) in enumerate(zip(flt_n, flt_p)) if a != b
+            ]
+            # persist the repro for offline analysis
+            with open(f"/tmp/fuzz_mismatch_{it}.bin", "wb") as f:
+                f.write(blk.SerializeToString())
+            mismatches.append((it, diff, rows(batch_n) == rows(batch_p),
+                               hist_n == hist_p))
+    assert not mismatches, mismatches[:3]
+
+
+def test_duplicate_action_submessage_agrees(net):
+    """upb MERGES duplicate singular submessages (endorsements
+    concatenate across two `action` occurrences); last-occurrence
+    extraction cannot replicate that, so the native lane must route
+    such envelopes to Python — both lanes must agree on the verdict."""
+    if nat.blockparse_lib() is None:
+        pytest.skip("no native toolchain")
+    from fabric_tpu.protos import common_pb2, proposal_pb2, transaction_pb2
+
+    def varint(n):
+        out = b""
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            out += bytes([b | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    base = net["envs"][0]
+    env = pu.unmarshal(common_pb2.Envelope, base)
+    payload = pu.unmarshal(common_pb2.Payload, env.payload)
+    tx = pu.unmarshal(transaction_pb2.Transaction, payload.data)
+    cap = pu.unmarshal(
+        transaction_pb2.ChaincodeActionPayload, tx.actions[0].payload
+    )
+    # split the two endorsements across TWO action occurrences
+    cea1 = transaction_pb2.ChaincodeEndorsedAction()
+    cea1.endorsements.add().CopyFrom(cap.action.endorsements[0])
+    cea2 = transaction_pb2.ChaincodeEndorsedAction()
+    cea2.proposal_response_payload = cap.action.proposal_response_payload
+    cea2.endorsements.add().CopyFrom(cap.action.endorsements[1])
+    b1, b2 = cea1.SerializeToString(), cea2.SerializeToString()
+    wire = (b"\x0a" + varint(len(cap.chaincode_proposal_payload))
+            + cap.chaincode_proposal_payload
+            + b"\x12" + varint(len(b1)) + b1
+            + b"\x12" + varint(len(b2)) + b2)
+    # sanity: upb merges the endorsements back together
+    merged = transaction_pb2.ChaincodeActionPayload()
+    merged.ParseFromString(wire)
+    assert len(merged.action.endorsements) == 2
+    tx.actions[0].payload = wire
+    payload.data = tx.SerializeToString()
+    env2 = pu.sign_envelope(payload, net["client"])
+    blk = pu.new_block(2, b"prev")
+    blk.data.data.append(env2.SerializeToString())
+    for e in net["envs"][1:]:
+        blk.data.data.append(e)
+    blk = pu.finalize_block(blk)
+
+    v_nat = BlockValidator(net["mgr"], net["prov"], _seed_state())
+    flt_n, _, _ = v_nat.validate(blk)
+    nat._lib_failed.add("blockparse")
+    nat._libs.pop("blockparse", None)
+    try:
+        v_py = BlockValidator(net["mgr"], net["prov"], _seed_state())
+        flt_p, _, _ = v_py.validate(blk)
+    finally:
+        nat._lib_failed.discard("blockparse")
+    assert bytes(flt_n) == bytes(flt_p)
+    # and the merged-endorsement tx is VALID under the 2-of-2 policy
+    assert flt_n[0] == 0, list(flt_n)

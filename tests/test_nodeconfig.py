@@ -1,0 +1,114 @@
+"""Typed node configuration (core/peer/config.go +
+orderer/common/localconfig analog): schema validation naming the bad
+key, defaults, and FABTPU_ env-var overrides."""
+
+import pytest
+
+from fabric_tpu.nodeconfig import (
+    ConfigError, OrdererConfig, PeerConfig, TlsConfig,
+    load_orderer_config, load_peer_config,
+)
+
+
+PEER_MIN = {"id": "p0", "data_dir": "/tmp/p0",
+            "msp_id": "Org1MSP", "msp_dir": "/tmp/msp"}
+
+
+def test_defaults_and_required():
+    cfg = load_peer_config(dict(PEER_MIN))
+    assert isinstance(cfg, PeerConfig)
+    assert cfg.port == 0 and cfg.host == "127.0.0.1"
+    assert cfg.group_commit == 8 and cfg.transient_retention == 100
+    assert cfg.tls is None
+    with pytest.raises(ConfigError, match="missing required"):
+        load_peer_config({"id": "p0"})
+    # a peer cannot start without its signing identity
+    with pytest.raises(ConfigError, match="msp_dir"):
+        load_peer_config({"id": "p0", "data_dir": "d", "msp_id": "O"})
+    # the orderer can (unsigned dev channels)
+    load_orderer_config({"id": "o0", "data_dir": "/tmp/o0"})
+
+
+def test_optional_fields_validated():
+    # int | None (PEP 604) fields must still be type-checked
+    with pytest.raises(ConfigError, match="operations_port"):
+        load_peer_config({**PEER_MIN, "operations_port": "not-a-port"})
+    cfg = load_peer_config({**PEER_MIN, "operations_port": 9443})
+    assert cfg.operations_port == 9443
+    # ... and env-overridable
+    cfg = load_peer_config(
+        dict(PEER_MIN), environ={"FABTPU_OPERATIONS_PORT": "9444"}
+    )
+    assert cfg.operations_port == 9444
+
+
+def test_partial_tls_rejected():
+    with pytest.raises(ConfigError, match="cert, key, and ca.*missing"):
+        load_peer_config({**PEER_MIN, "tls": {"cert": "c.pem"}})
+    # an all-empty section means no TLS
+    assert load_peer_config({**PEER_MIN, "tls": {}}).tls is None
+
+
+def test_unknown_key_named_with_suggestion():
+    with pytest.raises(ConfigError, match="unknown key 'prot'.*'port'"):
+        load_peer_config({**PEER_MIN, "prot": 7051})
+    with pytest.raises(ConfigError, match="tls.certt"):
+        load_peer_config({**PEER_MIN, "tls": {"certt": "x"}})
+    with pytest.raises(ConfigError, match="channels\\[\\]"):
+        load_peer_config({**PEER_MIN, "channels": [{"nam": "ch"}]})
+
+
+def test_type_errors_name_key_and_types():
+    with pytest.raises(ConfigError, match="key 'port'.*int"):
+        load_peer_config({**PEER_MIN, "port": "abc"})
+    with pytest.raises(ConfigError, match="batch_timeout_s"):
+        load_orderer_config({
+            "id": "o", "data_dir": "d", "batch_timeout_s": [],
+        })
+    with pytest.raises(ConfigError, match="consensus.*raft.*bft"):
+        load_orderer_config({
+            "id": "o", "data_dir": "d", "consensus": "paxos",
+        })
+
+
+def test_orderer_knobs_and_nested_sections():
+    cfg = load_orderer_config({
+        "id": "o0", "data_dir": "/tmp/o0",
+        "cluster": {"o0": ["127.0.0.1", 7050]},
+        "max_message_count": 10, "batch_timeout_s": 0.5,
+        "consensus": "bft", "view_timeout": 1.5, "wal_retention": 64,
+        "tls": {"cert": "c.pem", "key": "k.pem", "ca": "ca.pem"},
+        "channels": [{"name": "ch1", "genesis": "g.block"}, "devch"],
+    })
+    assert isinstance(cfg, OrdererConfig)
+    assert cfg.cluster["o0"] == ("127.0.0.1", 7050)
+    assert cfg.consensus == "bft" and cfg.wal_retention == 64
+    assert isinstance(cfg.tls, TlsConfig) and cfg.tls.cert == "c.pem"
+    assert cfg.channels[0].name == "ch1"
+    assert cfg.channels[1] == "devch"
+
+
+def test_env_overrides():
+    env = {
+        "FABTPU_PORT": "7051",
+        "FABTPU_GROUP_COMMIT": "16",
+        "FABTPU_DELIVER_CENSORSHIP_CHECK_S": "0.75",
+        "FABTPU_TLS_CA": "/etc/ca.pem",
+        "FABTPU_TLS_CERT": "/etc/cert.pem",
+        "FABTPU_TLS_KEY": "/etc/key.pem",
+        "IRRELEVANT": "x",
+    }
+    cfg = load_peer_config({**PEER_MIN, "port": 1}, environ=env)
+    assert cfg.port == 7051               # env beats the file
+    assert cfg.group_commit == 16
+    assert cfg.deliver_censorship_check_s == 0.75
+    assert cfg.tls is not None and cfg.tls.ca == "/etc/ca.pem"
+    # bad env values are named by their variable
+    with pytest.raises(ConfigError, match="FABTPU_PORT"):
+        load_peer_config(
+            dict(PEER_MIN), environ={"FABTPU_PORT": "not-a-port"}
+        )
+    with pytest.raises(ConfigError, match="unknown env override"):
+        load_peer_config(
+            dict(PEER_MIN), environ={"FABTPU_TLS_BOGUS": "x"}
+        )
