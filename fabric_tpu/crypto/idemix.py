@@ -117,35 +117,90 @@ def _fs_challenge(*parts) -> int:
 
 
 class IssuerPublicKey:
-    """(n, S, Z, R_sk, R_ou, R_role) — everything a verifier needs."""
+    """(n, S, Z, R_sk, R_ou, R_role, R_epoch) plus the revocation
+    authority's ECDSA public point — everything a verifier needs."""
 
-    __slots__ = ("n", "S", "Z", "R_sk", "R_ou", "R_role")
+    __slots__ = ("n", "S", "Z", "R_sk", "R_ou", "R_role", "R_epoch",
+                 "ra_pub")
 
-    def __init__(self, n, S, Z, R_sk, R_ou, R_role):
+    def __init__(self, n, S, Z, R_sk, R_ou, R_role, R_epoch=1,
+                 ra_pub=(0, 0)):
         self.n, self.S, self.Z = n, S, Z
         self.R_sk, self.R_ou, self.R_role = R_sk, R_ou, R_role
+        self.R_epoch = R_epoch
+        self.ra_pub = tuple(ra_pub)
 
     def to_json(self) -> str:
-        return json.dumps({
+        d = {
             k: hex(getattr(self, k))
-            for k in ("n", "S", "Z", "R_sk", "R_ou", "R_role")
-        }, sort_keys=True)
+            for k in ("n", "S", "Z", "R_sk", "R_ou", "R_role", "R_epoch")
+        }
+        d["ra_pub"] = [hex(self.ra_pub[0]), hex(self.ra_pub[1])]
+        return json.dumps(d, sort_keys=True)
 
     @classmethod
     def from_json(cls, raw: str) -> "IssuerPublicKey":
         d = json.loads(raw)
-        return cls(**{k: int(v, 16) for k, v in d.items()})
+        ra = d.pop("ra_pub", ["0x0", "0x0"])
+        return cls(**{k: int(v, 16) for k, v in d.items()},
+                   ra_pub=(int(ra[0], 16), int(ra[1], 16)))
 
     def _digest_parts(self):
-        return (self.n, self.S, self.Z, self.R_sk, self.R_ou, self.R_role)
+        return (self.n, self.S, self.Z, self.R_sk, self.R_ou,
+                self.R_role, self.R_epoch)
 
 
 class Credential:
-    __slots__ = ("A", "e", "v", "sk", "ou", "role")
+    __slots__ = ("A", "e", "v", "sk", "ou", "role", "epoch")
 
-    def __init__(self, A, e, v, sk, ou, role):
+    def __init__(self, A, e, v, sk, ou, role, epoch=0):
         self.A, self.e, self.v = A, e, v
         self.sk, self.ou, self.role = sk, ou, role
+        self.epoch = epoch
+
+
+class EpochRecord:
+    """The revocation authority's signed epoch statement — the CRI
+    analog of the reference's vendored idemix revocation handler:
+    verifiers require presentations to DISCLOSE the current epoch, and
+    revocation works by advancing the epoch and re-issuing credentials
+    to every still-authorized holder (a revoked holder cannot obtain
+    the new epoch, so its old credentials stop verifying the moment
+    the verifier learns the new record)."""
+
+    __slots__ = ("epoch", "r", "s")
+
+    def __init__(self, epoch: int, r: int, s: int):
+        self.epoch, self.r, self.s = epoch, r, s
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"epoch": self.epoch, "r": hex(self.r), "s": hex(self.s)},
+            sort_keys=True,
+        )
+
+    @classmethod
+    def from_json(cls, raw: str) -> "EpochRecord":
+        d = json.loads(raw)
+        return cls(int(d["epoch"]), int(d["r"], 16), int(d["s"], 16))
+
+    def digest(self, ipk: "IssuerPublicKey") -> int:
+        import hashlib as _h
+
+        return int.from_bytes(_h.sha256(
+            b"idemix-epoch|" + ipk.to_json().encode()
+            + b"|%d" % self.epoch
+        ).digest(), "big")
+
+    def verify(self, ipk: "IssuerPublicKey") -> bool:
+        from fabric_tpu.crypto import ec_ref
+
+        try:
+            return ec_ref.verify_digest(
+                ipk.ra_pub, self.digest(ipk), self.r, self.s
+            )
+        except Exception:
+            return False
 
 
 class IdemixIssuer:
@@ -166,12 +221,51 @@ class IdemixIssuer:
         def qr():
             x = secrets.randbelow(self.n - 2) + 2
             return pow(x, 2, self.n)
-        self.ipk = IssuerPublicKey(self.n, qr(), qr(), qr(), qr(), qr())
+        from fabric_tpu.crypto import ec_ref
 
-    def issue(self, commitment: int, proof: dict, ou: str, role: str):
+        self._ra_key = ec_ref.SigningKey.generate()
+        self.ipk = IssuerPublicKey(
+            self.n, qr(), qr(), qr(), qr(), qr(), qr(),
+            ra_pub=self._ra_key.public,
+        )
+        # revocation state: epoch counter + revoked handle set.  A
+        # handle identifies a HOLDER to the issuer only (assigned at
+        # first issuance); it never appears in presentations, so
+        # unlinkability is untouched.
+        self.epoch = 0
+        self._revoked: set = set()
+        self._epoch_record = self._sign_epoch()
+
+    def _sign_epoch(self) -> EpochRecord:
+        rec = EpochRecord(self.epoch, 0, 0)
+        rec.r, rec.s = self._ra_key.sign_digest(rec.digest(self.ipk))
+        return rec
+
+    @property
+    def epoch_record(self) -> EpochRecord:
+        return self._epoch_record
+
+    def revoke(self, handle) -> None:
+        """Mark a holder revoked and ADVANCE THE EPOCH: every
+        still-authorized holder re-issues into the new epoch; the
+        revoked one cannot, so its credentials die with the old
+        epoch everywhere the new record propagates."""
+        self._revoked.add(handle)
+        self.epoch += 1
+        self._epoch_record = self._sign_epoch()
+
+    def is_revoked(self, handle) -> bool:
+        return handle in self._revoked
+
+    def issue(self, commitment: int, proof: dict, ou: str, role: str,
+              handle=None):
         """Blind issuance: the holder supplies U = R_sk^sk · S^v_u with
         a Schnorr proof of representation; the issuer never sees sk.
-        → (A, e, v_issuer) to be combined holder-side."""
+        → (A, e, v_issuer) to be combined holder-side.  ``handle``:
+        the issuer-side holder identifier for revocation — issuance
+        (and epoch re-issuance) is refused for revoked handles."""
+        if handle is not None and handle in self._revoked:
+            raise ValueError(f"holder {handle!r} is revoked")
         ipk = self.ipk
         # verify PoK of (sk, v_u) for U
         c = _fs_challenge(ipk.to_json(), commitment, proof["t"], "issue")
@@ -185,7 +279,8 @@ class IdemixIssuer:
         m_ou, m_role = _attr_int(ou), _attr_int(role)
         base = (commitment * pow(ipk.S, v_i, ipk.n)
                 * pow(ipk.R_ou, m_ou, ipk.n)
-                * pow(ipk.R_role, m_role, ipk.n)) % ipk.n
+                * pow(ipk.R_role, m_role, ipk.n)
+                * pow(ipk.R_epoch, self.epoch, ipk.n)) % ipk.n
         e_inv = pow(e, -1, self._phi)
         A = pow((ipk.Z * pow(base, -1, ipk.n)) % ipk.n, e_inv, ipk.n)
         return A, e, v_i
@@ -210,14 +305,17 @@ class IdemixHolder:
         c = _fs_challenge(ipk.to_json(), U, t, "issue")
         return U, {"t": t, "s_sk": r_sk + c * self.sk, "s_v": r_v + c * v_u}
 
-    def assemble(self, A: int, e: int, v_i: int, ou: str, role: str) -> Credential:
-        cred = Credential(A, e, v_i + self._v_u, self.sk, ou, role)
+    def assemble(self, A: int, e: int, v_i: int, ou: str, role: str,
+                 epoch: int = 0) -> Credential:
+        cred = Credential(A, e, v_i + self._v_u, self.sk, ou, role,
+                          epoch=epoch)
         ipk = self.ipk
-        # sanity: A^e S^v R_sk^sk R_ou^ou R_role^role == Z
+        # sanity: A^e S^v R_sk^sk R_ou^ou R_role^role R_epoch^epoch == Z
         lhs = (pow(A, e, ipk.n) * pow(ipk.S, cred.v, ipk.n)
                * pow(ipk.R_sk, self.sk, ipk.n)
                * pow(ipk.R_ou, _attr_int(ou), ipk.n)
-               * pow(ipk.R_role, _attr_int(role), ipk.n)) % ipk.n
+               * pow(ipk.R_role, _attr_int(role), ipk.n)
+               * pow(ipk.R_epoch, epoch, ipk.n)) % ipk.n
         if lhs != ipk.Z % ipk.n:
             raise ValueError("credential does not verify")
         return cred
@@ -241,9 +339,11 @@ def sign(ipk: IssuerPublicKey, cred: Credential, msg: bytes) -> bytes:
     t = (pow(A2, r_e, n) * pow(ipk.S, r_v, n)
          * pow(ipk.R_sk, r_sk, n)) % n
     nonce = secrets.token_hex(16)
-    c = _fs_challenge(ipk.to_json(), A2, t, cred.ou, cred.role, nonce, msg)
+    c = _fs_challenge(ipk.to_json(), A2, t, cred.ou, cred.role,
+                      cred.epoch, nonce, msg)
     return json.dumps({
         "A2": hex(A2), "c": hex(c), "nonce": nonce,
+        "epoch": cred.epoch,
         "s_e": hex(r_e + c * e_off),
         "s_v": hex(r_v + c * v2) if r_v + c * v2 >= 0
                else "-" + hex(-(r_v + c * v2)),
@@ -256,10 +356,18 @@ def _parse_signed(h: str) -> int:
 
 
 def verify(ipk: IssuerPublicKey, ou: str, role: str, msg: bytes,
-           sig: bytes) -> bool:
+           sig: bytes, epoch_record: "EpochRecord | None" = None) -> bool:
     """Verify a presentation proof: a few modexps on host (the
     batched-TPU path is pointless here — idemix creators are rare and
-    cannot endorse)."""
+    cannot endorse).
+
+    ``epoch_record``: the latest RA-signed epoch statement the
+    verifier holds.  When given, the presentation must DISCLOSE that
+    exact epoch — the revocation check: a revoked holder is frozen
+    out of new epochs at re-issuance, so its credentials only prove
+    stale epochs.  The disclosed epoch is bound by the credential
+    equation itself (R_epoch^epoch folds into the proof), so lying
+    about it fails the Σ-protocol."""
     try:
         d = json.loads(sig)
         n = ipk.n
@@ -268,6 +376,12 @@ def verify(ipk: IssuerPublicKey, ou: str, role: str, msg: bytes,
         s_v = _parse_signed(d["s_v"])
         s_sk = int(d["s_sk"], 16)
         nonce = d["nonce"]
+        epoch = int(d.get("epoch", 0))
+        if epoch_record is not None:
+            if not epoch_record.verify(ipk):
+                return False
+            if epoch != epoch_record.epoch:
+                return False
         if not (0 < A2 < n):
             return False
         # soundness range bound: s_e certifies the OFFSET e' = e−2^(L_E-1),
@@ -279,11 +393,12 @@ def verify(ipk: IssuerPublicKey, ou: str, role: str, msg: bytes,
         if not (0 <= s_e < 1 << (L_E_PRIME + L_C + L_STAT + 1)):
             return False
         z_d = (ipk.Z * pow(ipk.R_ou, -_attr_int(ou), n)
-               * pow(ipk.R_role, -_attr_int(role), n)) % n
+               * pow(ipk.R_role, -_attr_int(role), n)
+               * pow(ipk.R_epoch, -epoch, n)) % n
         t_hat = (pow(A2, s_e + (c << (L_E - 1)), n) * pow(ipk.S, s_v, n)
                  * pow(ipk.R_sk, s_sk, n) * pow(z_d, -c, n)) % n
         return _fs_challenge(
-            ipk.to_json(), A2, t_hat, ou, role, nonce, msg
+            ipk.to_json(), A2, t_hat, ou, role, epoch, nonce, msg
         ) == c
     except Exception:
         return False
@@ -299,7 +414,7 @@ class IdemixIdentity:
     host verification for these creators."""
 
     def __init__(self, msp_id: str, ou: str, role: str, ipk: IssuerPublicKey,
-                 serialized: bytes, is_valid: bool):
+                 serialized: bytes, is_valid: bool, epoch_record=None):
         self.msp_id = msp_id
         self.ou_value = ou
         self.ous = (ou,)
@@ -307,13 +422,15 @@ class IdemixIdentity:
         self.ipk = ipk
         self.serialized = serialized
         self.is_valid = is_valid
+        self.epoch_record = epoch_record
 
     @property
     def public_numbers(self):
         raise ValueError("idemix identities carry no EC public key")
 
     def verify(self, message: bytes, sig: bytes) -> bool:
-        return verify(self.ipk, self.ou_value, self.role, message, sig)
+        return verify(self.ipk, self.ou_value, self.role, message, sig,
+                      epoch_record=self.epoch_record)
 
 
 class IdemixSigningIdentity:
@@ -354,9 +471,21 @@ class IdemixMSP:
     presentation proof, so deserialization validates shape and the
     proof check rides Identity.verify."""
 
-    def __init__(self, msp_id: str, ipk: IssuerPublicKey):
+    def __init__(self, msp_id: str, ipk: IssuerPublicKey,
+                 epoch_record: EpochRecord | None = None):
         self.msp_id = msp_id
         self.ipk = ipk
+        # the newest RA-signed epoch statement this MSP has learned;
+        # None = revocation not yet configured (epoch 0 accepted)
+        self.epoch_record = epoch_record
+
+    def set_epoch_record(self, rec: EpochRecord) -> None:
+        """Adopt a newer epoch statement (monotonic: a replayed OLD
+        record must not re-admit a revoked holder's credentials)."""
+        if not rec.verify(self.ipk):
+            raise ValueError("epoch record does not verify")
+        if self.epoch_record is None or rec.epoch > self.epoch_record.epoch:
+            self.epoch_record = rec
 
     def deserialize_identity(self, serialized: bytes):
         from fabric_tpu.protos import common_pb2
@@ -370,7 +499,7 @@ class IdemixMSP:
             d, ok = {}, False
         return IdemixIdentity(
             pb.mspid, d.get("ou", ""), d.get("role", "client"),
-            self.ipk, serialized, ok,
+            self.ipk, serialized, ok, epoch_record=self.epoch_record,
         )
 
     def satisfies_principal(self, ident, principal) -> bool:
@@ -397,10 +526,20 @@ class IdemixMSP:
             type=1,
             config=json.dumps({
                 "msp_id": self.msp_id, "ipk": json.loads(self.ipk.to_json()),
+                "epoch_record": (
+                    json.loads(self.epoch_record.to_json())
+                    if self.epoch_record is not None else None
+                ),
             }, sort_keys=True).encode(),
         )
 
     @classmethod
     def from_config(cls, cfg_bytes: bytes) -> "IdemixMSP":
         d = json.loads(cfg_bytes)
-        return cls(d["msp_id"], IssuerPublicKey.from_json(json.dumps(d["ipk"])))
+        rec = None
+        if d.get("epoch_record"):
+            rec = EpochRecord.from_json(json.dumps(d["epoch_record"]))
+        return cls(
+            d["msp_id"], IssuerPublicKey.from_json(json.dumps(d["ipk"])),
+            epoch_record=rec,
+        )
