@@ -123,8 +123,7 @@ class IssuerPublicKey:
     __slots__ = ("n", "S", "Z", "R_sk", "R_ou", "R_role", "R_epoch",
                  "ra_pub")
 
-    def __init__(self, n, S, Z, R_sk, R_ou, R_role, R_epoch=1,
-                 ra_pub=(0, 0)):
+    def __init__(self, n, S, Z, R_sk, R_ou, R_role, R_epoch, ra_pub):
         self.n, self.S, self.Z = n, S, Z
         self.R_sk, self.R_ou, self.R_role = R_sk, R_ou, R_role
         self.R_epoch = R_epoch
@@ -140,8 +139,11 @@ class IssuerPublicKey:
 
     @classmethod
     def from_json(cls, raw: str) -> "IssuerPublicKey":
+        # R_epoch and ra_pub are REQUIRED: a degenerate epoch generator
+        # (e.g. 1) would make every epoch claim satisfy the proof —
+        # legacy keys must be re-issued, not silently weakened
         d = json.loads(raw)
-        ra = d.pop("ra_pub", ["0x0", "0x0"])
+        ra = d.pop("ra_pub")
         return cls(**{k: int(v, 16) for k, v in d.items()},
                    ra_pub=(int(ra[0], 16), int(ra[1], 16)))
 
@@ -168,10 +170,11 @@ class EpochRecord:
     the new epoch, so its old credentials stop verifying the moment
     the verifier learns the new record)."""
 
-    __slots__ = ("epoch", "r", "s")
+    __slots__ = ("epoch", "r", "s", "_ok_for")
 
     def __init__(self, epoch: int, r: int, s: int):
         self.epoch, self.r, self.s = epoch, r, s
+        self._ok_for = None  # issuer modulus the sig verified against
 
     def to_json(self) -> str:
         return json.dumps(
@@ -185,22 +188,28 @@ class EpochRecord:
         return cls(int(d["epoch"]), int(d["r"], 16), int(d["s"], 16))
 
     def digest(self, ipk: "IssuerPublicKey") -> int:
-        import hashlib as _h
-
-        return int.from_bytes(_h.sha256(
+        return int.from_bytes(hashlib.sha256(
             b"idemix-epoch|" + ipk.to_json().encode()
             + b"|%d" % self.epoch
         ).digest(), "big")
 
     def verify(self, ipk: "IssuerPublicKey") -> bool:
+        # cache per issuer: the record is static between adoptions, and
+        # a pure-Python P-256 verify on EVERY presentation would tax
+        # the validator's host lane for nothing
+        if self._ok_for == ipk.n:
+            return True
         from fabric_tpu.crypto import ec_ref
 
         try:
-            return ec_ref.verify_digest(
+            ok = ec_ref.verify_digest(
                 ipk.ra_pub, self.digest(ipk), self.r, self.s
             )
         except Exception:
             return False
+        if ok:
+            self._ok_for = ipk.n
+        return ok
 
 
 class IdemixIssuer:
@@ -263,7 +272,17 @@ class IdemixIssuer:
         a Schnorr proof of representation; the issuer never sees sk.
         → (A, e, v_issuer) to be combined holder-side.  ``handle``:
         the issuer-side holder identifier for revocation — issuance
-        (and epoch re-issuance) is refused for revoked handles."""
+        (and epoch re-issuance) is refused for revoked handles.
+        Binding a handle to the actual holder is the enrollment
+        layer's job (the fabric-ca registration step); once ANY
+        revocation exists, anonymous issuance is refused outright so
+        a revoked holder cannot re-enroll by simply omitting its
+        handle."""
+        if self._revoked and handle is None:
+            raise ValueError(
+                "revocation is active on this issuer: issuance requires "
+                "a holder handle"
+            )
         if handle is not None and handle in self._revoked:
             raise ValueError(f"holder {handle!r} is revoked")
         ipk = self.ipk
@@ -535,11 +554,19 @@ class IdemixMSP:
 
     @classmethod
     def from_config(cls, cfg_bytes: bytes) -> "IdemixMSP":
+        """Channel-config ingestion.  The record is RA-verified here
+        (fail closed on a forged one); ORDERING protection across
+        configs comes from the channel-config machinery itself — a
+        config update must advance the sequence through the authorized
+        update path, so a node cannot be walked back to an older
+        MSPConfig (and thus an older epoch) without forging a whole
+        config chain.  set_epoch_record covers out-of-band record
+        distribution between config updates, monotonically."""
         d = json.loads(cfg_bytes)
+        ipk = IssuerPublicKey.from_json(json.dumps(d["ipk"]))
         rec = None
         if d.get("epoch_record"):
             rec = EpochRecord.from_json(json.dumps(d["epoch_record"]))
-        return cls(
-            d["msp_id"], IssuerPublicKey.from_json(json.dumps(d["ipk"])),
-            epoch_record=rec,
-        )
+            if not rec.verify(ipk):
+                raise ValueError("idemix epoch record does not verify")
+        return cls(d["msp_id"], ipk, epoch_record=rec)
