@@ -1,0 +1,36 @@
+"""AST-based static analysis for the fabric_tpu tree.
+
+The jitted kernels (ops/), the lock-heavy host code (peer/, ordering/,
+gossip) and the config/crypto layers each have defect classes that
+only surface as a flaky test or a bench regression: impure jitted
+functions, retrace hazards, host-device syncs on the commit path,
+lock-order inversions, swallowed exceptions, and env-override coercion
+of non-scalar config fields.  This package is the `go vet` + race
+detector analog for that tree: a rule registry over the stdlib `ast`
+module, per-rule severity, inline ``# fabtpu: noqa(RULE)``
+suppressions, and a checked-in baseline for grandfathered findings.
+
+Run it:
+
+    python -m fabric_tpu.analysis fabric_tpu/
+    python scripts/lint.py            # same thing
+    python -m fabric_tpu.analysis --json fabric_tpu/ordering/
+
+``tests/test_static_analysis.py`` runs the analyzer over the whole
+package in-process and fails on any non-baselined finding, so tier-1
+enforces a clean tree forever.  See README.md for the rule-writing and
+baseline workflow.
+"""
+
+from fabric_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    ModuleCtx,
+    Rule,
+    all_rules,
+    analyze_paths,
+    load_baseline,
+    register,
+)
+
+# importing the rules package registers the built-in battery
+from fabric_tpu.analysis import rules as _rules  # noqa: F401,E402

@@ -1,0 +1,97 @@
+"""CLI: ``python -m fabric_tpu.analysis [paths...]``.
+
+Exit status 0 = clean (baselined findings allowed), 1 = live
+findings, 2 = usage error.  ``--json`` emits machine-readable output
+for CI; the default renderer prints ``path:line:col: RULE(name)
+[severity] message`` lines plus a summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from fabric_tpu.analysis import (
+    all_rules,
+    analyze_paths,
+    load_baseline,
+)
+from fabric_tpu.analysis.core import default_baseline_path
+
+
+def _repo_root() -> str:
+    # fabric_tpu/analysis/__main__.py → repo root two levels up from
+    # the package directory
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m fabric_tpu.analysis",
+        description="JAX/concurrency static analysis for fabric_tpu",
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: fabric_tpu/)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: the checked-in "
+                         "fabric_tpu/analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show every finding)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule battery and exit")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule id/name (repeatable)")
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id}  {r.name:<24} [{r.severity}] {r.description}")
+        return 0
+
+    if args.rule:
+        want = set(args.rule)
+        rules = [r for r in rules if r.id in want or r.name in want]
+        if not rules:
+            print(f"no rule matches {sorted(want)}", file=sys.stderr)
+            return 2
+
+    root = _repo_root()
+    paths = args.paths or [os.path.join(root, "fabric_tpu")]
+    baseline = (
+        None if args.no_baseline
+        else load_baseline(args.baseline or default_baseline_path())
+    )
+    result = analyze_paths(paths, root=root, rules=rules, baseline=baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in result.findings],
+            "baselined": [f.to_json() for f in result.baselined],
+            "suppressed": result.suppressed,
+            "stale_baseline": [list(k) for k in result.stale_baseline],
+        }, indent=2, sort_keys=True))
+    else:
+        for f in result.findings:
+            print(f.render())
+        bits = [f"{len(result.findings)} finding(s)"]
+        if result.baselined:
+            bits.append(f"{len(result.baselined)} baselined")
+        if result.suppressed:
+            bits.append(f"{result.suppressed} noqa-suppressed")
+        if result.stale_baseline:
+            bits.append(
+                f"{len(result.stale_baseline)} STALE baseline entr"
+                f"{'y' if len(result.stale_baseline) == 1 else 'ies'} "
+                f"(fixed findings — prune them)"
+            )
+        print("fabric_tpu.analysis: " + ", ".join(bits))
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

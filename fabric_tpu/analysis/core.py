@@ -1,0 +1,307 @@
+"""Analysis engine: findings, rule registry, noqa + baseline plumbing.
+
+Rules subclass :class:`Rule` and register with :func:`register`.  A
+rule sees either one module at a time (``check_module``) or the whole
+analyzed set at once (``check_project`` — cross-module rules like
+lock-order build a project graph first).  The engine owns everything
+else: file discovery, parsing, ``# fabtpu: noqa(...)`` suppression,
+and the baseline multiset for grandfathered findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import tokenize
+from collections import Counter
+from dataclasses import asdict, dataclass
+
+SEVERITIES = ("error", "warning")
+
+# matches `# fabtpu: noqa` (suppress every rule on the line) or
+# `# fabtpu: noqa(FT003)` / `# fabtpu: noqa(FT001, lock-discipline)`
+_NOQA_RE = re.compile(
+    r"#\s*fabtpu:\s*noqa(?:\s*\(\s*([A-Za-z0-9_,\-\s]*?)\s*\))?",
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: ``path:line:col RULE(name) message``."""
+
+    rule: str      # stable id, e.g. "FT003"
+    name: str      # human slug, e.g. "host-sync-in-hot-path"
+    path: str      # repo-relative posix path
+    line: int
+    col: int
+    severity: str  # "error" | "warning"
+    message: str
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.rule}({self.name}) [{self.severity}] {self.message}"
+        )
+
+    def baseline_key(self) -> tuple:
+        # line numbers drift with unrelated edits; a baseline entry
+        # pins (rule, path, message) instead
+        return (self.rule, self.path, self.message)
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+
+class ModuleCtx:
+    """One parsed module: tree + source + noqa map."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.noqa = self._parse_noqa(source)
+
+    @staticmethod
+    def _parse_noqa(source: str) -> dict[int, set[str] | None]:
+        """line → suppressed rule ids/names (None = every rule).
+
+        Comments are found with the tokenizer, not a per-line regex,
+        so a ``# fabtpu: noqa`` inside a string literal is inert."""
+        out: dict[int, set[str] | None] = {}
+        import io
+
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _NOQA_RE.search(tok.string)
+                if not m:
+                    continue
+                line = tok.start[0]
+                if m.group(1) is None:
+                    out[line] = None
+                elif out.get(line, set()) is not None:
+                    got = out.setdefault(line, set())
+                    got.update(
+                        s.strip() for s in m.group(1).split(",") if s.strip()
+                    )
+        except tokenize.TokenError:
+            pass
+        return out
+
+    def suppressed(self, rule: "Rule", line: int) -> bool:
+        if line not in self.noqa:
+            return False
+        rules = self.noqa[line]
+        return rules is None or rule.id in rules or rule.name in rules
+
+
+class Rule:
+    """Base class: subclass, set ``id``/``name``/``severity``, and
+    implement ``check_module`` (per-file) and/or ``check_project``
+    (cross-file, runs once with every analyzed module)."""
+
+    id: str = ""
+    name: str = ""
+    severity: str = "error"
+    description: str = ""
+
+    def check_module(self, ctx: ModuleCtx) -> list[Finding]:
+        return []
+
+    def check_project(self, modules: list[ModuleCtx]) -> list[Finding]:
+        return []
+
+    def finding(self, ctx_or_path, line: int, col: int, message: str) -> Finding:
+        path = (
+            ctx_or_path.relpath
+            if isinstance(ctx_or_path, ModuleCtx)
+            else ctx_or_path
+        )
+        return Finding(
+            rule=self.id, name=self.name, path=path, line=line, col=col,
+            severity=self.severity, message=message,
+        )
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate + register a rule by id."""
+    rule = cls()
+    if not rule.id or not rule.name:
+        raise ValueError(f"rule {cls.__name__} must set id and name")
+    if rule.severity not in SEVERITIES:
+        raise ValueError(f"rule {rule.id}: bad severity {rule.severity!r}")
+    if rule.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    _REGISTRY[rule.id] = rule
+    return cls
+
+
+def all_rules() -> list[Rule]:
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+# -- discovery + run --------------------------------------------------------
+
+_SKIP_SUFFIXES = ("_pb2.py",)  # generated protobuf modules
+
+
+def _iter_py_files(paths: list[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs
+                if d not in ("__pycache__", ".git", ".jax_cache")
+            )
+            for f in sorted(files):
+                if f.endswith(".py") and not f.endswith(_SKIP_SUFFIXES):
+                    yield os.path.join(root, f)
+
+
+def _relpath(path: str, root: str | None) -> str:
+    if root:
+        try:
+            return os.path.relpath(path, root).replace(os.sep, "/")
+        except ValueError:
+            pass
+    return path.replace(os.sep, "/")
+
+
+def load_modules(paths: list[str], root: str | None = None) -> tuple[list[ModuleCtx], list[Finding]]:
+    """Parse every .py under ``paths``.  Unparseable files become
+    FT000 findings (a syntax error is never 'clean')."""
+    modules: list[ModuleCtx] = []
+    errors: list[Finding] = []
+    for path in _iter_py_files(paths):
+        rel = _relpath(path, root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            modules.append(ModuleCtx(path, rel, source))
+        except (SyntaxError, ValueError, UnicodeDecodeError, OSError) as e:
+            # ValueError: ast.parse on source with NUL bytes
+            errors.append(Finding(
+                rule="FT000", name="parse-error", path=rel,
+                line=getattr(e, "lineno", 0) or 0, col=0,
+                severity="error", message=f"cannot analyze: {e}",
+            ))
+    return modules, errors
+
+
+def load_baseline(path: str | None) -> Counter:
+    """Baseline file → multiset of (rule, path, message) keys.  Each
+    entry absorbs exactly ``count`` (default 1) occurrences — fixing
+    one of two grandfathered findings shrinks the budget, it does not
+    hide the survivor."""
+    if not path or not os.path.exists(path):
+        return Counter()
+    with open(path, encoding="utf-8") as f:
+        raw = json.load(f)
+    counts: Counter = Counter()
+    for entry in raw.get("findings", []):
+        key = (entry["rule"], entry["path"], entry["message"])
+        counts[key] += int(entry.get("count", 1))
+    return counts
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+@dataclass
+class AnalysisResult:
+    findings: list[Finding]          # live (post-noqa, post-baseline)
+    baselined: list[Finding]
+    suppressed: int                  # count silenced by noqa
+    stale_baseline: list[tuple]      # baseline keys nothing matched
+
+    @property
+    def errors(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+
+def analyze_paths(
+    paths: list[str],
+    root: str | None = None,
+    rules: list[Rule] | None = None,
+    baseline: Counter | None = None,
+) -> AnalysisResult:
+    """Run ``rules`` (default: the full registry) over ``paths``."""
+    if rules is None:
+        rules = all_rules()
+    modules, parse_errors = load_modules(paths, root=root)
+    by_rel = {m.relpath: m for m in modules}
+
+    raw: list[Finding] = list(parse_errors)
+    for rule in rules:
+        for m in modules:
+            raw.extend(rule.check_module(m))
+        raw.extend(rule.check_project(modules))
+
+    # noqa pass — a finding carries the rule that made it, so look the
+    # rule back up by id (parse errors are never suppressible)
+    live: list[Finding] = []
+    suppressed = 0
+    for f in raw:
+        rule = _REGISTRY.get(f.rule)
+        m = by_rel.get(f.path)
+        if rule is not None and m is not None and m.suppressed(rule, f.line):
+            suppressed += 1
+        else:
+            live.append(f)
+
+    # baseline pass
+    budget = Counter(baseline or ())
+    kept: list[Finding] = []
+    baselined: list[Finding] = []
+    for f in sorted(live, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        key = f.baseline_key()
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            baselined.append(f)
+        else:
+            kept.append(f)
+    stale = [k for k, n in budget.items() if n > 0]
+    return AnalysisResult(
+        findings=kept, baselined=baselined,
+        suppressed=suppressed, stale_baseline=stale,
+    )
+
+
+# -- shared AST helpers (used by several rules) -----------------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``ast.Attribute``/``ast.Name`` → dotted string ("jax.jit"),
+    else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    return dotted_name(node.func)
+
+
+def walk_functions(tree: ast.AST):
+    """Yield every FunctionDef/AsyncFunctionDef in the tree."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node

@@ -1,0 +1,21 @@
+"""Built-in rule battery — importing this package registers them all.
+
+Rule ids are stable (baseline entries and noqa comments reference
+them); slugs are the human-facing names:
+
+    FT001 jit-purity             impure calls / mutation inside jit
+    FT002 retrace-hazard         non-static Python values reaching jit
+    FT003 host-sync-in-hot-path  device syncs on the validator path
+    FT004 lock-discipline        lock-order cycles + blocking under lock
+    FT005 swallowed-exception    broad except that drops the error
+    FT006 union-env-coercion     env strings coercing non-scalar unions
+"""
+
+from fabric_tpu.analysis.rules import (  # noqa: F401
+    host_sync,
+    jit_purity,
+    lock_discipline,
+    retrace_hazard,
+    swallowed_exception,
+    union_env,
+)

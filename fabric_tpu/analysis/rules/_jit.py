@@ -1,0 +1,127 @@
+"""Shared detection of jit-compiled functions (FT001/FT002).
+
+A function is "jitted" when it is
+
+* decorated with ``jax.jit`` / ``jit`` / ``jax.pmap`` / ``pmap`` /
+  ``shard_map`` (bare or via ``partial(jax.jit, ...)`` /
+  ``jax.jit(...)``-with-kwargs decorator factories), or
+* passed as the first positional argument to a ``jax.jit(...)`` /
+  ``pmap(...)`` / ``shard_map(...)`` call anywhere in the module
+  (``verify = jax.jit(_verify_impl, ...)``).
+
+``static_info`` also extracts ``static_argnums`` / ``static_argnames``
+literals so the retrace rule can reason about which parameters are
+traced.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from fabric_tpu.analysis.core import call_name, dotted_name
+
+_JIT_NAMES = {
+    "jax.jit", "jit", "jax.pmap", "pmap", "shard_map",
+    "jax.experimental.shard_map.shard_map", "checkpoint_name",
+    "jax.named_call",
+}
+_WRAPPER_NAMES = {"partial", "functools.partial"}
+
+
+def _jit_call(node: ast.AST) -> ast.Call | None:
+    """The jit/pmap/shard_map Call inside a (possibly partial-wrapped)
+    expression, or None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = call_name(node)
+    if name in _JIT_NAMES:
+        return node
+    if name in _WRAPPER_NAMES and node.args:
+        inner = dotted_name(node.args[0])
+        if inner in _JIT_NAMES:
+            return node
+    return None
+
+
+def _is_jit_decorator(dec: ast.AST) -> ast.Call | None | bool:
+    """→ the configuring Call for ``@partial(jax.jit, ...)`` /
+    ``@jax.jit(...)``, True for a bare ``@jax.jit``, else False."""
+    if dotted_name(dec) in _JIT_NAMES:
+        return True
+    call = _jit_call(dec)
+    return call if call is not None else False
+
+
+@dataclass
+class JittedFn:
+    node: ast.FunctionDef
+    static_argnums: set[int] = field(default_factory=set)
+    static_argnames: set[str] = field(default_factory=set)
+    via: str = "decorator"  # or "call"
+
+
+def _static_info(call: ast.Call, jf: JittedFn) -> None:
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for v in ast.walk(kw.value):
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    jf.static_argnums.add(v.value)
+        elif kw.arg == "static_argnames":
+            for v in ast.walk(kw.value):
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    jf.static_argnames.add(v.value)
+
+
+def find_jitted(tree: ast.AST) -> dict[str, JittedFn]:
+    """name → JittedFn for every jit-compiled function in the module."""
+    defs: dict[str, ast.FunctionDef] = {}
+    out: dict[str, JittedFn] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+            for dec in node.decorator_list:
+                got = _is_jit_decorator(dec)
+                if got is False:
+                    continue
+                jf = out.setdefault(node.name, JittedFn(node))
+                if isinstance(got, ast.Call):
+                    _static_info(got, jf)
+    # call-form: f = jax.jit(g, ...) with g a module function
+    for node in ast.walk(tree):
+        call = _jit_call(node)
+        if call is None or not call.args:
+            continue
+        target = call.args[0]
+        if call_name(call) in _WRAPPER_NAMES:
+            # partial(jax.jit, ...) as a decorator was handled above;
+            # partial(jax.jit)(g) is not a pattern worth chasing
+            continue
+        tname = dotted_name(target)
+        if tname in defs:
+            jf = out.setdefault(tname, JittedFn(defs[tname], via="call"))
+            _static_info(call, jf)
+    return out
+
+
+def local_names(fn: ast.FunctionDef) -> set[str]:
+    """Names bound inside ``fn`` (params, assignments, loop/with
+    targets, walrus, nested defs) — everything NOT closed over."""
+    names: set[str] = set()
+    a = fn.args
+    for arg in (
+        list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+        + ([a.vararg] if a.vararg else []) + ([a.kwarg] if a.kwarg else [])
+    ):
+        names.add(arg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not fn:
+                names.add(node.name)
+        elif isinstance(node, ast.NamedExpr) and isinstance(
+                node.target, ast.Name):
+            names.add(node.target.id)
+    return names

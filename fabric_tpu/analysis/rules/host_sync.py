@@ -1,0 +1,157 @@
+"""FT003 host-sync-in-hot-path: device syncs on the commit path.
+
+The validator pipeline earns its throughput by keeping exactly ONE
+host-device sync per block (the packed stage-2 readback).  Any stray
+``.block_until_ready()`` / ``jax.device_get`` / ``.item()`` / direct
+``np.asarray(<call>)`` readback inside the commit call graph
+serializes the pipeline and shows up only as a bench regression.
+
+The rule builds a project-wide call graph (name-based resolution:
+``x.foo()`` and ``foo()`` both link to every ``foo`` definition in the
+analyzed set — deliberately over-approximate, never under) rooted at
+the functions of ``peer/validator.py`` and ``peer/coordinator.py``,
+and flags sync constructs in every reachable function.  Intended sync
+points carry a ``# fabtpu: noqa(FT003)`` with a comment saying why.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+
+from fabric_tpu.analysis.core import (
+    Finding,
+    ModuleCtx,
+    Rule,
+    call_name,
+    dotted_name,
+    register,
+    walk_functions,
+)
+
+_ROOT_MODULES = ("peer/validator.py", "peer/coordinator.py")
+
+_SYNC_ATTRS = {"block_until_ready", "item"}
+_SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+_READBACK_CONVERTERS = {"np.asarray", "np.array", "numpy.asarray",
+                        "numpy.array"}
+# builtins whose result is host memory by construction — converting
+# them is a copy at worst, never a device sync
+_HOST_PRODUCERS = {
+    "sorted", "list", "tuple", "set", "dict", "range", "zip", "len",
+    "enumerate", "min", "max", "sum", "reversed",
+}
+
+
+def _fn_key(mod: ModuleCtx, fn: ast.FunctionDef) -> tuple[str, str, int]:
+    # lineno disambiguates same-named methods on different classes
+    return (mod.relpath, fn.name, fn.lineno)
+
+
+@register
+class HostSyncRule(Rule):
+    id = "FT003"
+    name = "host-sync-in-hot-path"
+    severity = "error"
+    description = (
+        "flags device syncs (block_until_ready/device_get/.item()/"
+        "np.asarray(<call>)) reachable from the validator/commit graph"
+    )
+    # overridable in tests
+    root_modules: tuple[str, ...] = _ROOT_MODULES
+    # how many root functions the last check_project seeded the BFS
+    # with — tests pin this > 0 over fabric_tpu/ so a rename of the
+    # root modules cannot silently turn the rule into a no-op
+    last_root_count: int = 0
+
+    def check_project(self, modules: list[ModuleCtx]) -> list[Finding]:
+        # 1. collect every function def, keyed by bare name
+        defs: dict[tuple, ast.FunctionDef] = {}
+        by_name: dict[str, list[tuple]] = {}
+        mod_of: dict[tuple, ModuleCtx] = {}
+        for mod in modules:
+            for fn in walk_functions(mod.tree):
+                key = _fn_key(mod, fn)
+                defs[key] = fn
+                mod_of[key] = mod
+                by_name.setdefault(fn.name, []).append(key)
+
+        # 2. edges: function → called bare names
+        calls_of: dict[tuple, set[str]] = {}
+        for key, fn in defs.items():
+            called: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    name = call_name(node)
+                    if name:
+                        called.add(name.split(".")[-1])
+            calls_of[key] = called
+
+        # 3. BFS from the root modules' functions
+        roots = [
+            key for key, mod in mod_of.items()
+            if any(mod.relpath.endswith(r) for r in self.root_modules)
+        ]
+        self.last_root_count = len(roots)
+        hot: set[tuple] = set(roots)
+        queue = deque(roots)
+        while queue:
+            key = queue.popleft()
+            for bare in calls_of.get(key, ()):
+                for callee in by_name.get(bare, ()):
+                    if callee not in hot:
+                        hot.add(callee)
+                        queue.append(callee)
+
+        # 4. flag sync constructs inside hot functions
+        out: list[Finding] = []
+        seen: set[tuple] = set()
+        for key in hot:
+            fn, mod = defs[key], mod_of[key]
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = self._sync_message(node, fn.name)
+                if msg is None:
+                    continue
+                fkey = (mod.relpath, node.lineno, node.col_offset)
+                if fkey in seen:
+                    continue
+                seen.add(fkey)
+                out.append(self.finding(
+                    mod, node.lineno, node.col_offset, msg,
+                ))
+        return out
+
+    @staticmethod
+    def _sync_message(node: ast.Call, fname: str) -> str | None:
+        name = call_name(node)
+        if name in _SYNC_CALLS:
+            return (
+                f"'{name}' in '{fname}' is reachable from the "
+                f"validator/commit graph — a host-device sync on the "
+                f"hot path"
+            )
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SYNC_ATTRS
+            and not node.args and not node.keywords
+        ):
+            base = dotted_name(node.func.value) or "<expr>"
+            return (
+                f"'.{node.func.attr}()' on '{base}' in '{fname}' is "
+                f"reachable from the validator/commit graph — a "
+                f"host-device sync on the hot path"
+            )
+        if (
+            name in _READBACK_CONVERTERS
+            and node.args and isinstance(node.args[0], ast.Call)
+            and call_name(node.args[0]) not in _HOST_PRODUCERS
+        ):
+            inner = call_name(node.args[0]) or "<call>"
+            return (
+                f"'{name}({inner}(...))' in '{fname}' converts a fresh "
+                f"call result to host memory on the validator/commit "
+                f"graph — a device readback unless proven host-only"
+            )
+        return None

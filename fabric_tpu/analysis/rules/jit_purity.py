@@ -1,0 +1,132 @@
+"""FT001 jit-purity: side effects inside jit-compiled functions.
+
+A jitted function runs ONCE per (shape, dtype, static-arg) signature —
+at trace time — and never again.  ``time.*`` / ``random.*`` /
+``os.environ`` reads bake a single stale value into the compiled
+graph; I/O happens once instead of per call; mutating closed-over
+Python state desynchronizes host state from what the traced graph
+saw.  These are exactly the bugs that pass a single-shape unit test
+and corrupt production traffic after the first retrace.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import (
+    Finding,
+    ModuleCtx,
+    Rule,
+    call_name,
+    dotted_name,
+    register,
+)
+from fabric_tpu.analysis.rules._jit import find_jitted, local_names
+
+# call prefixes that are impure at trace time.  jax.random /
+# np.random-free stdlib `random`, wall clocks, env reads, I/O.
+_IMPURE_PREFIXES = (
+    "time.", "random.", "np.random.", "numpy.random.",
+    "os.environ", "os.getenv", "os.putenv", "os.urandom",
+    "secrets.",
+)
+_IMPURE_CALLS = {"print", "open", "input"}
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "pop", "popitem",
+    "remove", "discard", "clear", "setdefault", "write",
+}
+
+
+def _impure_call(name: str | None) -> bool:
+    if name is None:
+        return False
+    if name in _IMPURE_CALLS:
+        return True
+    return any(name.startswith(p) for p in _IMPURE_PREFIXES)
+
+
+@register
+class JitPurityRule(Rule):
+    id = "FT001"
+    name = "jit-purity"
+    severity = "error"
+    description = (
+        "flags wall-clock/random/env/I-O calls and mutation of "
+        "closed-over state inside jax.jit/pmap/shard_map functions"
+    )
+
+    def check_module(self, ctx: ModuleCtx) -> list[Finding]:
+        out: list[Finding] = []
+        for fname, jf in find_jitted(ctx.tree).items():
+            fn = jf.node
+            locs = local_names(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    name = call_name(node)
+                    if _impure_call(name):
+                        out.append(self.finding(
+                            ctx, node.lineno, node.col_offset,
+                            f"impure call '{name}' inside jitted "
+                            f"function '{fname}' — traced once, then "
+                            f"baked into the compiled graph",
+                        ))
+                        continue
+                    # mutator method on a closed-over name
+                    if (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _MUTATORS
+                    ):
+                        base = node.func.value
+                        bname = dotted_name(base)
+                        root = (bname or "").split(".")[0]
+                        if root and root not in locs and not _is_module_ref(
+                                root):
+                            out.append(self.finding(
+                                ctx, node.lineno, node.col_offset,
+                                f"jitted function '{fname}' mutates "
+                                f"closed-over '{bname}' via "
+                                f".{node.func.attr}() — trace-time only; "
+                                f"the compiled graph never re-runs it",
+                            ))
+                elif isinstance(node, (ast.Attribute, ast.Name)):
+                    name = dotted_name(node)
+                    if name and name.startswith("os.environ") and isinstance(
+                            node.ctx, ast.Load):
+                        out.append(self.finding(
+                            ctx, node.lineno, node.col_offset,
+                            f"os.environ read inside jitted function "
+                            f"'{fname}' — evaluated at trace time only",
+                        ))
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        node.targets if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if isinstance(t, ast.Subscript):
+                            root = (dotted_name(t.value) or "").split(".")[0]
+                            if root and root not in locs:
+                                out.append(self.finding(
+                                    ctx, t.lineno, t.col_offset,
+                                    f"jitted function '{fname}' assigns "
+                                    f"into closed-over "
+                                    f"'{dotted_name(t.value)}[...]' — "
+                                    f"runs at trace time only",
+                                ))
+        return _dedup(out)
+
+
+def _is_module_ref(root: str) -> bool:
+    # conservative: common module aliases never hold closure state
+    return root in {"np", "jnp", "jax", "numpy", "math", "lax", "self"}
+
+
+def _dedup(findings: list[Finding]) -> list[Finding]:
+    seen: set[tuple] = set()
+    out = []
+    for f in findings:
+        key = (f.path, f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out

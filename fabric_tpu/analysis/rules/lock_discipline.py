@@ -1,0 +1,175 @@
+"""FT004 lock-discipline: acquisition-order cycles + blocking under lock.
+
+Two checks over every ``with`` / ``async with`` whose context manager
+looks like a lock (``*.reader()`` / ``*.writer()`` on the
+utils.locks.AsyncRWLock seam, or a bare ``*lock*``-named attribute):
+
+* **order**: nested acquisitions produce directed edges
+  (outer → inner) into one project-wide graph; any cycle means two
+  code paths can acquire the same pair of locks in opposite order —
+  the classic deadlock that only fires under production interleaving.
+* **blocking-under-lock**: synchronous blocking calls
+  (``os.fsync``, ``time.sleep``, ``<future>.result()``,
+  ``run_until_complete``, ``subprocess.*``, gRPC stubs) made while a
+  lock is held stall every other endorser/committer queued on it.
+
+Lock identity is textual (the trailing attribute of the lock
+expression: ``self.commit_lock.writer()`` → ``commit_lock``), which is
+exactly right for a codebase with a handful of named locks and wrong
+in ways a noqa comment can absorb.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import (
+    Finding,
+    ModuleCtx,
+    Rule,
+    call_name,
+    dotted_name,
+    register,
+)
+
+_RW_METHODS = {"reader", "writer", "acquire"}
+_BLOCKING_CALLS = {
+    "os.fsync", "time.sleep", "run_until_complete",
+    "subprocess.run", "subprocess.check_output", "subprocess.call",
+    "socket.create_connection",
+}
+_BLOCKING_ATTRS = {"result", "run_until_complete"}
+
+
+def _lock_id(expr: ast.AST) -> str | None:
+    """Lock name for a with-item context expr, or None if it doesn't
+    look like a lock."""
+    # with lock.reader() / lock.writer() / lock.acquire()
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute):
+        if expr.func.attr in _RW_METHODS:
+            base = dotted_name(expr.func.value)
+            if base:
+                return base.split(".")[-1]
+        return None
+    # with self._lock: / with commit_mutex:
+    name = dotted_name(expr)
+    if name:
+        leaf = name.split(".")[-1]
+        if "lock" in leaf.lower() or "mutex" in leaf.lower():
+            return leaf
+    return None
+
+
+def _is_blocking(node: ast.Call) -> str | None:
+    name = call_name(node)
+    if name in _BLOCKING_CALLS:
+        return name
+    if (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr in _BLOCKING_ATTRS
+        and not node.args and not node.keywords
+    ):
+        base = dotted_name(node.func.value) or "<expr>"
+        return f"{base}.{node.func.attr}"
+    return None
+
+
+class _LockWalker(ast.NodeVisitor):
+    """Collect (outer → inner) edges and blocking calls per module."""
+
+    def __init__(self, rule: Rule, ctx: ModuleCtx):
+        self.rule = rule
+        self.ctx = ctx
+        self.stack: list[str] = []
+        self.edges: dict[tuple[str, str], tuple] = {}  # → first location
+        self.findings: list[Finding] = []
+
+    def _visit_with(self, node):
+        acquired: list[str] = []
+        for item in node.items:
+            lock = _lock_id(item.context_expr)
+            if lock is not None:
+                if self.stack:
+                    edge = (self.stack[-1], lock)
+                    self.edges.setdefault(
+                        edge, (self.ctx, node.lineno, node.col_offset)
+                    )
+                self.stack.append(lock)
+                acquired.append(lock)
+        self.generic_visit(node)
+        for _ in acquired:
+            self.stack.pop()
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def visit_Call(self, node: ast.Call):
+        if self.stack:
+            blocked = _is_blocking(node)
+            if blocked is not None:
+                self.findings.append(self.rule.finding(
+                    self.ctx, node.lineno, node.col_offset,
+                    f"blocking call '{blocked}()' while holding lock "
+                    f"'{self.stack[-1]}' — stalls every waiter queued "
+                    f"on it",
+                ))
+        self.generic_visit(node)
+
+
+@register
+class LockDisciplineRule(Rule):
+    id = "FT004"
+    name = "lock-discipline"
+    severity = "error"
+    description = (
+        "builds a project-wide lock-acquisition graph and flags "
+        "order cycles plus blocking calls made while a lock is held"
+    )
+
+    def check_project(self, modules: list[ModuleCtx]) -> list[Finding]:
+        out: list[Finding] = []
+        edges: dict[tuple[str, str], tuple] = {}
+        for mod in modules:
+            w = _LockWalker(self, mod)
+            w.visit(mod.tree)
+            out.extend(w.findings)
+            for edge, loc in w.edges.items():
+                edges.setdefault(edge, loc)
+
+        # cycle detection over the project-wide order graph
+        graph: dict[str, set[str]] = {}
+        for a, b in edges:
+            if a != b:
+                graph.setdefault(a, set()).add(b)
+        for (a, b), (ctx, line, col) in sorted(
+            edges.items(), key=lambda kv: (kv[1][0].relpath, kv[1][1])
+        ):
+            if a == b:
+                out.append(self.finding(
+                    ctx, line, col,
+                    f"lock '{a}' re-acquired while already held — "
+                    f"self-deadlock on a non-reentrant lock",
+                ))
+            elif self._reaches(graph, b, a):
+                out.append(self.finding(
+                    ctx, line, col,
+                    f"lock-order cycle: '{a}' is acquired while "
+                    f"holding '{b}' elsewhere, and here '{b}' is "
+                    f"acquired while holding '{a}' — opposite orders "
+                    f"deadlock under contention",
+                ))
+        return out
+
+    @staticmethod
+    def _reaches(graph: dict[str, set[str]], src: str, dst: str) -> bool:
+        seen = {src}
+        stack = [src]
+        while stack:
+            cur = stack.pop()
+            for nxt in graph.get(cur, ()):
+                if nxt == dst:
+                    return True
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return False

@@ -1,0 +1,170 @@
+"""FT002 retrace-hazard: non-static Python values reaching jit.
+
+Three shapes, all of which either retrace per call (silent 100×
+slowdowns) or throw ``TypeError: unhashable`` the first time a static
+argument varies:
+
+* a jitted function with a mutable default (``def f(x, opts={})``) —
+  the default is hashed as a static leaf or captured by the trace;
+* a jitted closure reading a module-level list/dict that the module
+  ALSO mutates — the trace bakes the first value and never sees the
+  mutation;
+* a call site passing a list/dict display to a parameter the jit
+  marked static (``static_argnums``/``static_argnames``) — lists are
+  unhashable, so the trace-cache lookup raises.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import (
+    Finding,
+    ModuleCtx,
+    Rule,
+    dotted_name,
+    register,
+)
+from fabric_tpu.analysis.rules._jit import find_jitted
+
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "pop", "popitem",
+    "remove", "discard", "clear", "setdefault",
+}
+
+
+def _module_mutable_bindings(tree: ast.Module) -> dict[str, int]:
+    """Top-level ``NAME = [...]`` / ``NAME = {...}`` bindings."""
+    out: dict[str, int] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, (ast.List, ast.Dict, ast.Set)):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = stmt.lineno
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.value, (ast.List, ast.Dict, ast.Set)):
+            if isinstance(stmt.target, ast.Name):
+                out[stmt.target.id] = stmt.lineno
+    return out
+
+
+def _mutated_names(tree: ast.Module) -> set[str]:
+    """Names the module mutates in place anywhere (method mutators,
+    subscript stores, aug-assigns)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute) and node.func.attr in _MUTATORS:
+            name = dotted_name(node.func.value)
+            if name:
+                out.add(name.split(".")[0])
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Subscript):
+                    name = dotted_name(t.value)
+                    if name:
+                        out.add(name.split(".")[0])
+    return out
+
+
+@register
+class RetraceHazardRule(Rule):
+    id = "FT002"
+    name = "retrace-hazard"
+    severity = "error"
+    description = (
+        "flags mutable defaults on jitted functions, jitted closures "
+        "over mutated module state, and unhashable static arguments"
+    )
+
+    def check_module(self, ctx: ModuleCtx) -> list[Finding]:
+        out: list[Finding] = []
+        jitted = find_jitted(ctx.tree)
+        if not jitted:
+            return out
+        mutable = _module_mutable_bindings(ctx.tree)
+        mutated = _mutated_names(ctx.tree)
+
+        for fname, jf in jitted.items():
+            fn = jf.node
+            # 1. mutable defaults
+            args = list(fn.args.posonlyargs) + list(fn.args.args)
+            defaults = fn.args.defaults
+            for arg, default in zip(args[len(args) - len(defaults):], defaults):
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    out.append(self.finding(
+                        ctx, default.lineno, default.col_offset,
+                        f"jitted function '{fname}' has a mutable "
+                        f"default for '{arg.arg}' — unhashable as a "
+                        f"static leaf and stale once mutated",
+                    ))
+            for arg, default in zip(
+                fn.args.kwonlyargs, fn.args.kw_defaults
+            ):
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    out.append(self.finding(
+                        ctx, default.lineno, default.col_offset,
+                        f"jitted function '{fname}' has a mutable "
+                        f"default for '{arg.arg}' — unhashable as a "
+                        f"static leaf and stale once mutated",
+                    ))
+            # 2. closure over a mutated module-level list/dict
+            param_names = {a.arg for a in args} | {
+                a.arg for a in fn.args.kwonlyargs
+            }
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in mutable
+                    and node.id in mutated
+                    and node.id not in param_names
+                ):
+                    out.append(self.finding(
+                        ctx, node.lineno, node.col_offset,
+                        f"jitted function '{fname}' closes over "
+                        f"module-level '{node.id}' (a list/dict the "
+                        f"module mutates) — the trace bakes the value "
+                        f"at first call and never sees updates",
+                    ))
+
+        # 3. list/dict displays passed to static parameters
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = dotted_name(node.func)
+            jf = jitted.get(cname or "")
+            if jf is None or not (jf.static_argnums or jf.static_argnames):
+                continue
+            params = [a.arg for a in (
+                list(jf.node.args.posonlyargs) + list(jf.node.args.args)
+            )]
+            for i, arg in enumerate(node.args):
+                pname = params[i] if i < len(params) else None
+                if (
+                    i in jf.static_argnums
+                    or (pname and pname in jf.static_argnames)
+                ) and isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                    out.append(self.finding(
+                        ctx, arg.lineno, arg.col_offset,
+                        f"unhashable {type(arg).__name__.lower()} literal "
+                        f"passed to static parameter "
+                        f"'{pname or i}' of jitted '{cname}' — the "
+                        f"trace-cache lookup will raise TypeError",
+                    ))
+            for kw in node.keywords:
+                if kw.arg in jf.static_argnames and isinstance(
+                        kw.value, (ast.List, ast.Dict, ast.Set)):
+                    out.append(self.finding(
+                        ctx, kw.value.lineno, kw.value.col_offset,
+                        f"unhashable {type(kw.value).__name__.lower()} "
+                        f"literal passed to static parameter "
+                        f"'{kw.arg}' of jitted '{cname}' — the "
+                        f"trace-cache lookup will raise TypeError",
+                    ))
+        return out

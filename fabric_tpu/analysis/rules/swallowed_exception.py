@@ -1,0 +1,99 @@
+"""FT005 swallowed-exception: broad except that drops the error.
+
+A bare ``except:`` / ``except Exception:`` whose body neither raises,
+logs, references the caught exception, nor translates it into a
+result value is a silent failure: on the commit path it turns a
+deterministic bug into a block that "just didn't commit".  Handlers
+that return an explicit value (``return False`` / ``return None`` —
+a sentinel the caller dispatches on), assign a fallback, or log are
+fine — the rule only fires on pure drops (``pass`` / ``continue`` /
+bare ``return``).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import (
+    Finding,
+    ModuleCtx,
+    Rule,
+    call_name,
+    register,
+)
+
+_BROAD = {"Exception", "BaseException"}
+_LOGGY = ("log", "warn", "print", "exception", "debug", "error", "info")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [getattr(e, "id", getattr(e, "attr", "")) for e in t.elts]
+    else:
+        names = [getattr(t, "id", getattr(t, "attr", ""))]
+    return any(n in _BROAD for n in names)
+
+
+def _drops_error(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body is a pure drop."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return False
+        if isinstance(node, ast.Call):
+            name = (call_name(node) or "").lower()
+            attr = (
+                node.func.attr.lower()
+                if isinstance(node.func, ast.Attribute) else ""
+            )
+            if any(k in name or k in attr for k in _LOGGY):
+                return False
+        if handler.name and isinstance(node, ast.Name) and (
+                node.id == handler.name):
+            return False
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Return):
+            if stmt.value is None:
+                continue  # bare `return`: a drop
+            # ANY explicit value — including a written-out `return
+            # None` — is a deliberate sentinel the caller dispatches on
+            return False
+        if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False  # any other statement counts as handling
+    return True
+
+
+@register
+class SwallowedExceptionRule(Rule):
+    id = "FT005"
+    name = "swallowed-exception"
+    severity = "error"
+    description = (
+        "flags bare/broad except handlers whose body drops the error "
+        "without raising, logging, or producing a verdict"
+    )
+
+    def check_module(self, ctx: ModuleCtx) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if _is_broad(handler) and _drops_error(handler):
+                    what = (
+                        "bare except" if handler.type is None
+                        else "broad except"
+                    )
+                    out.append(self.finding(
+                        ctx, handler.lineno, handler.col_offset,
+                        f"{what} swallows the error — no raise, no "
+                        f"log, no verdict; failures become silent",
+                    ))
+        return out

@@ -1,0 +1,172 @@
+"""FT006 union-env-coercion: env strings reaching non-scalar unions.
+
+The exact ADVICE round-5 bug class: an env-override loop that walks
+``dataclasses.fields(cfg)``, filters on "scalar or union", and hands
+the raw env STRING to a coercer.  For ``Optional[int]`` that's fine;
+for ``Optional[TlsConfig]`` the coercer has no scalar branch and the
+string passes through untouched — ``cfg.tls`` becomes a ``str`` and
+crashes far away with ``AttributeError`` instead of a ``ConfigError``
+naming the key.
+
+Detection is structural: a function that (a) reads an environ
+mapping, (b) iterates ``dataclasses.fields(...)``, and (c) calls
+``setattr`` is an env-override loop.  If that function never inspects
+the union's argument types (no ``typing.get_args`` call anywhere in
+its body), every ``Optional[<non-scalar>]`` field of the module's
+dataclasses is a coercion hazard and gets flagged.  Adding the
+``get_args``-based scalar guard (or dropping union handling) clears
+the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import (
+    Finding,
+    ModuleCtx,
+    Rule,
+    call_name,
+    dotted_name,
+    register,
+    walk_functions,
+)
+
+_SCALARS = {"int", "float", "str", "bool"}
+
+
+def _reads_environ(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        name = dotted_name(node) if isinstance(
+            node, (ast.Name, ast.Attribute)) else None
+        if name in ("os.environ", "environ") or (
+            isinstance(node, ast.Call)
+            and call_name(node) in ("os.getenv",)
+        ):
+            return True
+    return False
+
+
+def _iterates_fields(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.comprehension)):
+            it = node.iter
+            if isinstance(it, ast.Call) and (
+                call_name(it) in ("dataclasses.fields", "fields")
+            ):
+                return True
+    return False
+
+
+def _calls(fn: ast.AST, names: tuple[str, ...]) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            cname = call_name(node) or ""
+            if cname.split(".")[-1] in names:
+                return True
+    return False
+
+
+def _union_nonscalar(annotation: ast.AST) -> str | None:
+    """'X | None' / 'Optional[X]' with non-scalar X → X's name."""
+    # PEP 604: X | None
+    if isinstance(annotation, ast.BinOp) and isinstance(
+            annotation.op, ast.BitOr):
+        parts = _flatten_bitor(annotation)
+        names = [dotted_name(p) or _const_name(p) for p in parts]
+        non_none = [n for n in names if n and n != "None"]
+        if len(non_none) == 1 and non_none[0].split(".")[-1] not in _SCALARS:
+            return non_none[0]
+        return None
+    # Optional[X] / Union[X, None]
+    if isinstance(annotation, ast.Subscript):
+        base = dotted_name(annotation.value) or ""
+        if base.split(".")[-1] not in ("Optional", "Union"):
+            return None
+        sl = annotation.slice
+        elts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+        names = [dotted_name(e) or _const_name(e) for e in elts]
+        non_none = [n for n in names if n and n != "None"]
+        if len(non_none) == 1 and non_none[0].split(".")[-1] not in _SCALARS:
+            return non_none[0]
+    return None
+
+
+def _flatten_bitor(node: ast.BinOp) -> list[ast.AST]:
+    out: list[ast.AST] = []
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, ast.BinOp) and isinstance(cur.op, ast.BitOr):
+            stack.extend([cur.left, cur.right])
+        else:
+            out.append(cur)
+    return out
+
+
+def _const_name(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant):
+        if node.value is None:
+            return "None"
+        if isinstance(node.value, str):
+            # string annotation: good enough for a name match
+            return node.value
+    return None
+
+
+def _is_dataclass(cls: ast.ClassDef) -> bool:
+    for dec in cls.decorator_list:
+        name = dotted_name(dec) or (
+            dotted_name(dec.func) if isinstance(dec, ast.Call) else None
+        )
+        if name and name.split(".")[-1] == "dataclass":
+            return True
+    return False
+
+
+@register
+class UnionEnvCoercionRule(Rule):
+    id = "FT006"
+    name = "union-env-coercion"
+    severity = "error"
+    description = (
+        "flags Optional[non-scalar] dataclass fields reachable from "
+        "an env-override loop that never inspects union args"
+    )
+
+    def check_module(self, ctx: ModuleCtx) -> list[Finding]:
+        # env-override loops that hand field types to a coercer
+        # without a get_args-based scalar guard
+        unguarded: list[str] = []
+        for fn in walk_functions(ctx.tree):
+            if not (
+                _reads_environ(fn)
+                and _iterates_fields(fn)
+                and _calls(fn, ("setattr",))
+            ):
+                continue
+            if not _calls(fn, ("get_args",)):
+                unguarded.append(fn.name)
+        if not unguarded:
+            return []
+
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef) or not _is_dataclass(node):
+                continue
+            for stmt in node.body:
+                if not isinstance(stmt, ast.AnnAssign) or not isinstance(
+                        stmt.target, ast.Name):
+                    continue
+                inner = _union_nonscalar(stmt.annotation)
+                if inner is None:
+                    continue
+                out.append(self.finding(
+                    ctx, stmt.lineno, stmt.col_offset,
+                    f"field '{node.name}.{stmt.target.id}' is "
+                    f"Optional[{inner}] and env loop "
+                    f"'{unguarded[0]}' coerces union fields without "
+                    f"checking the union's args are scalar — an env "
+                    f"string would be assigned raw",
+                ))
+        return out

@@ -20,12 +20,15 @@ capability flags, MSP sets) feed the batch compiler
 from __future__ import annotations
 
 import hashlib
+import logging
 from dataclasses import dataclass, field
 
 from fabric_tpu import protoutil
 from fabric_tpu.crypto import policy as pol
 from fabric_tpu.crypto.msp import MSP, MSPManager, policy_from_proto, policy_to_proto
 from fabric_tpu.protos import common_pb2, configtx_pb2, policies_pb2
+
+_log = logging.getLogger("fabric_tpu.channelconfig")
 
 # capability strings (common/capabilities/application.go)
 CAP_V2_0 = "V2_0"
@@ -149,7 +152,8 @@ class PolicyManager:
             seen.add(sd.identity)
             try:
                 ident = self.msp.deserialize_identity(sd.identity)
-            except Exception:
+            except Exception as e:
+                _log.debug("policy eval: undeserializable identity: %s", e)
                 continue
             idents.append(ident)
             valid.append(ident.is_valid and ident.verify(sd.data, sd.signature))

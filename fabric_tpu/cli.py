@@ -137,6 +137,8 @@ async def _run_peer(cfg):
         cfg.id, cfg.data_dir, mgr, signer, runtime,
         host=cfg.host, port=cfg.port,
         tls=_node_tls(cfg),
+        max_package_size=cfg.max_package_size,
+        install_require_admin=cfg.install_require_admin,
     )
     await node.start(operations_port=cfg.operations_port)
     print(f"peer {node.id} serving on :{node.port}", flush=True)
@@ -282,6 +284,21 @@ def _cmd_ccinstall(args):
 
     with open(args.package, "rb") as f:
         raw = f.read()
+    if args.sign_msp_dir:
+        # the admin-signed envelope install_require_admin peers demand
+        if not args.sign_msp_id:
+            print("ccinstall: --sign-msp-dir requires --sign-msp-id "
+                  "(an identity without its MSP id can never validate)",
+                  file=sys.stderr)
+            sys.exit(2)
+        from fabric_tpu.crypto.cryptogen import load_signing_identity
+
+        signer = load_signing_identity(args.sign_msp_dir, args.sign_msp_id)
+        raw = json.dumps({
+            "package": raw.hex(),
+            "identity": signer.serialized.hex(),
+            "signature": signer.sign(raw).hex(),
+        }).encode()
 
     async def go():
         cli = RpcClient(args.host, args.port, ssl_ctx=_cli_ssl(args))
@@ -408,6 +425,12 @@ def main(argv=None):
     c.add_argument("--host", default="127.0.0.1")
     c.add_argument("--port", type=int, required=True)
     c.add_argument("--package", required=True)
+    c.add_argument("--sign-msp-dir", default=None,
+                   help="admin MSP dir: sign the install request "
+                        "(required when the peer enforces "
+                        "install_require_admin)")
+    c.add_argument("--sign-msp-id", default=None,
+                   help="MSP id of the signing admin identity")
 
     c = sub.add_parser("ccqueryinstalled",
                        help="list packages installed on a peer")

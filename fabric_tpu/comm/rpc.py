@@ -140,8 +140,8 @@ class _Conn:
                 st.inbox.put_nowait(_END)
             try:
                 self.writer.close()
-            except Exception:
-                pass
+            except (OSError, RuntimeError):
+                pass  # transport already torn down
 
 
 class RpcServer:
@@ -195,8 +195,8 @@ class RpcServer:
                 except Exception as e:
                     try:
                         await st.error(f"{type(e).__name__}: {e}")
-                    except Exception:
-                        pass
+                    except (ConnectionError, OSError, RuntimeError):
+                        pass  # client went away before the error did
                 finally:
                     st.dispose()  # handler finished: stop routing
 
@@ -217,8 +217,8 @@ class RpcServer:
             for conn in list(self._conns):
                 try:
                     conn.writer.close()
-                except Exception:
-                    pass
+                except (OSError, RuntimeError):
+                    pass  # already closed
             try:
                 await asyncio.wait_for(self._server.wait_closed(), 2.0)
             except asyncio.TimeoutError:
@@ -269,8 +269,8 @@ class RpcClient:
         if self.conn is not None:
             try:
                 self.conn.writer.close()
-            except Exception:
-                pass
+            except (OSError, RuntimeError):
+                pass  # already closed
             self.conn = None
         if self._pump_task:
             self._pump_task.cancel()

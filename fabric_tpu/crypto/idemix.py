@@ -121,13 +121,25 @@ class IssuerPublicKey:
     authority's ECDSA public point — everything a verifier needs."""
 
     __slots__ = ("n", "S", "Z", "R_sk", "R_ou", "R_role", "R_epoch",
-                 "ra_pub")
+                 "ra_pub", "_key_digest")
 
     def __init__(self, n, S, Z, R_sk, R_ou, R_role, R_epoch, ra_pub):
         self.n, self.S, self.Z = n, S, Z
         self.R_sk, self.R_ou, self.R_role = R_sk, R_ou, R_role
         self.R_epoch = R_epoch
         self.ra_pub = tuple(ra_pub)
+        self._key_digest = None  # lazy sha256(to_json()) — see key_digest
+
+    def key_digest(self) -> bytes:
+        """sha256 over the full key JSON, computed once — the
+        EpochRecord verification cache compares this per presentation,
+        so it must stay an attribute read, not a re-serialization.
+        Safe to memoize: every field is set once in __init__."""
+        if self._key_digest is None:
+            self._key_digest = hashlib.sha256(
+                self.to_json().encode()
+            ).digest()
+        return self._key_digest
 
     def to_json(self) -> str:
         d = {
@@ -174,7 +186,11 @@ class EpochRecord:
 
     def __init__(self, epoch: int, r: int, s: int):
         self.epoch, self.r, self.s = epoch, r, s
-        self._ok_for = None  # issuer modulus the sig verified against
+        # digest of the FULL issuer public key JSON the signature
+        # verified against — keying on ipk.n alone would let a record
+        # re-verify against a different key sharing the modulus but
+        # carrying different generators/ra_pub
+        self._ok_for = None
 
     def to_json(self) -> str:
         return json.dumps(
@@ -197,7 +213,8 @@ class EpochRecord:
         # cache per issuer: the record is static between adoptions, and
         # a pure-Python P-256 verify on EVERY presentation would tax
         # the validator's host lane for nothing
-        if self._ok_for == ipk.n:
+        ipk_digest = ipk.key_digest()
+        if self._ok_for == ipk_digest:
             return True
         from fabric_tpu.crypto import ec_ref
 
@@ -208,7 +225,7 @@ class EpochRecord:
         except Exception:
             return False
         if ok:
-            self._ok_for = ipk.n
+            self._ok_for = ipk_digest
         return ok
 
 

@@ -88,8 +88,8 @@ class GossipService:
         for cli in self._clients.values():
             try:
                 await cli.close()
-            except Exception:
-                pass
+            except (OSError, RuntimeError):
+                pass  # peer already gone
 
     # -- membership --------------------------------------------------------
 
@@ -305,7 +305,8 @@ class GossipService:
                                 k: (bytes.fromhex(v) if v is not None else None)
                                 for k, v in res["data"].items()
                             }
-                    except Exception:
+                    except Exception as e:
+                        log.debug("pvt pull from peer failed: %s", e)
                         continue
             return None
 

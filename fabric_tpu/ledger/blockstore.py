@@ -16,6 +16,8 @@ import os
 import sqlite3
 import struct
 
+from google.protobuf.message import DecodeError
+
 from fabric_tpu import protoutil
 from fabric_tpu.protos import common_pb2
 
@@ -171,8 +173,8 @@ class BlockStore:
                     ch = protoutil.unmarshal(
                         common_pb2.ChannelHeader, payload.header.channel_header
                     )
-                except Exception:
-                    continue
+                except DecodeError:
+                    continue  # non-envelope payload: nothing to index
                 if ch.tx_id:
                     txids.append((ch.tx_id, i))
         self._idx.executemany(

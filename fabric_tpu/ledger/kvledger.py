@@ -24,6 +24,7 @@ reference (txmgr validates, kvledger orchestrates).
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 
 from fabric_tpu import protoutil
@@ -32,6 +33,8 @@ from fabric_tpu.ledger.history import HistoryDB
 from fabric_tpu.ledger.pvtdata import PvtDataStore
 from fabric_tpu.ledger.statedb import SqliteVersionedDB, UpdateBatch, VersionedDB
 from fabric_tpu.protos import common_pb2
+
+_log = logging.getLogger("fabric_tpu.ledger")
 
 
 class KVLedger:
@@ -134,7 +137,11 @@ class KVLedger:
         for blk_n, txnum, ns, coll, rwset in purged:
             try:
                 kv = decode_kv(rwset)
-            except Exception:
+            except Exception as e:
+                _log.warning(
+                    "pvt purge: undecodable rwset for %s/%s at block "
+                    "%d tx %d: %s", ns, coll, blk_n, txnum, e,
+                )
                 continue
             hns = f"{ns}${coll}"
             for key in kv:

@@ -28,6 +28,13 @@ class ConfigError(ValueError):
     """A configuration problem, phrased so the operator can fix it."""
 
 
+#: install-store admission cap (peer/node.py _on_install; the
+#: reference's MaxRecvMsgSize is 100MB — ccaas packages are a few KB
+#: of tar, 16MB is generous).  Defined here so PeerConfig and direct
+#: PeerNode constructions share ONE default.
+DEFAULT_MAX_PACKAGE_SIZE = 16 * 1024 * 1024
+
+
 # -- leaf sections ----------------------------------------------------------
 
 
@@ -90,6 +97,9 @@ class PeerConfig:
     group_commit: int = 8            # blockstore fsync window (blocks)
     transient_retention: int = 100   # transient-store purge horizon
     deliver_censorship_check_s: float = 2.0
+    # chaincode install surface (peer/node.py _on_install)
+    max_package_size: int = DEFAULT_MAX_PACKAGE_SIZE
+    install_require_admin: bool = False
 
 
 @dataclass
@@ -242,10 +252,29 @@ def _apply_env(cfg, environ=None):
     hints = _ANNOT[type(cfg).__name__]
     for f in dataclasses.fields(cfg):
         typ = hints[f.name]
-        if typ not in (int, float, str, bool) and not _is_union(
-                _t.get_origin(typ)):
-            continue
         key = ENV_PREFIX + f.name.upper()
+        if _is_union(_t.get_origin(typ)):
+            # only SCALAR unions (Optional[int] etc.) are env-settable:
+            # an env string can never construct Optional[TlsConfig] —
+            # letting it through would assign the raw string (the
+            # ADVICE round-5 bug) and crash far away with
+            # AttributeError instead of an error naming the key
+            args = [a for a in _t.get_args(typ) if a is not type(None)]
+            if len(args) != 1 or args[0] not in (int, float, str, bool):
+                if key in env:
+                    raise ConfigError(
+                        f"env override '{key}' cannot set non-scalar "
+                        f"field '{f.name}' — use the config file (or "
+                        f"{ENV_PREFIX}TLS_* for the tls section)"
+                    )
+                continue
+        elif typ not in (int, float, str, bool):
+            if key in env:
+                raise ConfigError(
+                    f"env override '{key}' cannot set non-scalar "
+                    f"field '{f.name}' — use the config file"
+                )
+            continue
         if key in env:
             setattr(cfg, f.name, _coerce(f"${key}", env[key], typ))
     tls_hints = _ANNOT["TlsConfig"]

@@ -460,5 +460,7 @@ def _verify_host_v1(items) -> list[bool]:
     pad = [(0, 0, 0, 0, 0)] * (bsz - n)
     cols = list(zip(*(items + pad)))
     e, r, s, qx, qy = (jnp.asarray(ints_to_limbs(c)) for c in cols)
-    out = np.asarray(verify_batch_jit(e, r, s, qx, qy))
+    # the v1 kernel's ONE intended readback: this helper IS the sync
+    # point callers block on
+    out = np.asarray(verify_batch_jit(e, r, s, qx, qy))  # fabtpu: noqa(FT003)
     return [bool(v) for v in out[:n]]

@@ -157,9 +157,12 @@ class Registry:
                     for k, h in sorted(m._values.items()):
                         for b, c in zip(m.buckets, h.counts):
                             le = "+Inf" if math.isinf(b) else repr(b)
+                            # hoisted: a backslash inside an f-string
+                            # expression is a SyntaxError before 3.12
+                            le_label = 'le="%s"' % le
                             out.append(
                                 f"{name}_bucket"
-                                f"{self._fmt_labels(k, f'le=\"{le}\"')} {c}"
+                                f"{self._fmt_labels(k, le_label)} {c}"
                             )
                         out.append(f"{name}_sum{self._fmt_labels(k)} {h.total}")
                         out.append(f"{name}_count{self._fmt_labels(k)} {h.n}")

@@ -103,8 +103,8 @@ class OperationsServer:
             )
             writer.write(payload)
             await writer.drain()
-        except Exception:
-            pass
+        except (ConnectionError, OSError, RuntimeError):
+            pass  # client disconnected mid-response
         finally:
             writer.close()
 

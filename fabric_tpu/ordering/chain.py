@@ -16,6 +16,17 @@ Reference shape: `Chain.run` propose/apply loop
   is simpler and the SDK contract allows it).
 * Deliver is a height-watched block stream off the block store, the
   seek semantics of common/deliver/deliver.go:158.
+
+Durability coupling: the orderer's BlockStore runs with
+``group_commit=1`` (fsync every block) — broadcast ACKs a batch once
+raft commits it, and the block files are what WAL compaction trusts:
+``_apply`` compacts the WAL back to ``wal_retention`` entries behind
+the tip, so any block the store could lose in a crash must be
+re-derivable from WAL replay or cluster pull.  A grouped fsync window
+larger than ``wal_retention`` (an operator-set FABTPU_WAL_RETENTION
+can be small) would let a single-node chain drop ACKed blocks with no
+recovery source.  Keep ``group_commit=1`` here unless compaction
+learns to lag the unsynced window.
 """
 
 from __future__ import annotations
@@ -95,7 +106,9 @@ class OrderingChain:
         self.block_puller = block_puller
         self.on_consenters = on_consenters
         self.wal_retention = wal_retention
-        self.blocks = BlockStore(f"{data_dir}/chains")
+        # group_commit=1: ACKed blocks must hit disk before WAL
+        # compaction can outrun them (see module docstring)
+        self.blocks = BlockStore(f"{data_dir}/chains", group_commit=1)
         if self.blocks.height == 0 and genesis_block is not None:
             self.blocks.add_block(genesis_block)
         # consenter selection — the consensus.Chain SPI seam

@@ -103,8 +103,8 @@ class OrdererNode:
                 if cli is not None:
                     try:
                         await cli.close()
-                    except Exception:
-                        pass
+                    except (OSError, RuntimeError):
+                        pass  # peer already gone
 
     # -- channel lifecycle ------------------------------------------------------
 
@@ -183,7 +183,8 @@ class OrdererNode:
                     yield raw
                 if got:
                     return
-            except Exception:
+            except Exception as e:
+                _log.debug("block pull from %s failed: %s", peer_id, e)
                 continue
 
     # -- services -----------------------------------------------------------------
@@ -223,8 +224,8 @@ class OrdererNode:
             if task.done() and not task.cancelled():
                 try:
                     await task.result().close()
-                except Exception:
-                    pass
+                except (OSError, RuntimeError):
+                    pass  # already closed
             else:
                 task.cancel()
         await self.server.stop()

@@ -17,13 +17,17 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 
 from fabric_tpu import protoutil
 from fabric_tpu.comm.rpc import RpcClient
 from fabric_tpu.discovery import DiscoveryService, layouts_for_policy
 from fabric_tpu.peer import txassembly as txa
+
 from fabric_tpu.peer.endorser import Endorser
 from fabric_tpu.protos import common_pb2, proposal_pb2, transaction_pb2
+
+_log = logging.getLogger("fabric_tpu.gateway")
 
 
 class GatewayError(Exception):
@@ -223,7 +227,11 @@ class Gateway:
                 try:
                     env = protoutil.unmarshal(common_pb2.Envelope, env_bytes)
                     _, _, cap, prp, cca = protoutil.extract_action(env)
-                except Exception:
+                except Exception as e:
+                    _log.debug(
+                        "event stream: tx %d of block %d not an "
+                        "endorser action: %s", i, blk.header.number, e,
+                    )
                     continue
                 if not cca.events:
                     continue

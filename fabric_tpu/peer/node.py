@@ -21,6 +21,9 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
+
+from google.protobuf.message import DecodeError
 
 from fabric_tpu import protoutil
 from fabric_tpu.comm.rpc import RpcServer
@@ -31,6 +34,8 @@ from fabric_tpu.peer.chaincode import ChaincodeRuntime
 from fabric_tpu.peer.endorser import Endorser
 from fabric_tpu.peer.validator import BlockValidator, PolicyProvider
 from fabric_tpu.protos import common_pb2, proposal_pb2
+
+_log = logging.getLogger("fabric_tpu.peer")
 
 
 class PeerChannel:
@@ -335,7 +340,7 @@ class PeerChannel:
                 cfg_env = protoutil.unmarshal(
                     configtx_pb2.ConfigEnvelope, payload.data
                 )
-            except Exception:
+            except DecodeError:
                 continue  # malformed yet VALID can only be genesis noise
             try:
                 new_bundle = proc.apply(cfg_env)
@@ -460,7 +465,8 @@ class PeerChannel:
                     continue
                 if not ident.verify(_signable(m), bytes.fromhex(sig)):
                     continue
-            except Exception:
+            except Exception as e:
+                _log.debug("attestation vote rejected: %s", e)
                 continue
             voters.add(raw_cert)
         if len(voters) < quorum:
@@ -540,8 +546,8 @@ class PeerChannel:
             finally:
                 try:
                     await cli.close()
-                except Exception:
-                    pass
+                except (OSError, RuntimeError):
+                    pass  # orderer already gone
 
         async def censored(current) -> bool:
             # f+1 corroboration: ONE lying orderer (inflated Info
@@ -643,15 +649,25 @@ class PeerChannel:
         self.ledger.close()
 
 
+# single shared default with PeerConfig (nodeconfig is import-light)
+from fabric_tpu.nodeconfig import DEFAULT_MAX_PACKAGE_SIZE  # noqa: E402
+
+
 class PeerNode:
     def __init__(self, node_id: str, data_dir: str, msp_manager, signer,
                  runtime: ChaincodeRuntime | None = None,
-                 host: str = "127.0.0.1", port: int = 0, tls=None):
+                 host: str = "127.0.0.1", port: int = 0, tls=None,
+                 max_package_size: int = DEFAULT_MAX_PACKAGE_SIZE,
+                 install_require_admin: bool = False):
         self.id = node_id
         self.dir = data_dir
         self.msp = msp_manager
         self.signer = signer
         self.runtime = runtime or ChaincodeRuntime()
+        # install-surface admission (see _on_install): a size cap
+        # always, and optionally an admin-signed request envelope
+        self.max_package_size = int(max_package_size)
+        self.install_require_admin = bool(install_require_admin)
         from fabric_tpu.peer.ccpackage import PackageStore
 
         self.packages = PackageStore(data_dir)
@@ -670,13 +686,89 @@ class PeerNode:
 
     async def _on_install(self, req: bytes) -> bytes:
         """InstallChaincode: persist a package to the install store
-        (internal/peer/lifecycle/chaincode/install.go; transport-level
-        admission is the node's mTLS client auth)."""
+        (internal/peer/lifecycle/chaincode/install.go).
+
+        Admission is layered: the node's mTLS client auth at the
+        transport, an unconditional size cap (a connected client must
+        not be able to fill the peer's data dir), and — with
+        ``install_require_admin`` — a signed request envelope
+        ``{"package": hex, "identity": hex, "signature": hex}`` whose
+        identity must deserialize to a VALID admin of a known org and
+        whose signature must cover the package bytes (the reference's
+        install admin-policy check, compressed to one principal)."""
+        # the admin envelope hex-encodes the package (2×) and adds
+        # identity + signature fields: bound the WIRE request
+        # generously before parsing, then cap the DECODED package
+        # bytes against the configured max either way
+        wire_bound = (
+            2 * self.max_package_size + 65536
+            if self.install_require_admin else self.max_package_size
+        )
+        if len(req) > wire_bound:
+            return json.dumps({
+                "status": 413,
+                "message": (
+                    f"install request too large: {len(req)} bytes "
+                    f"exceeds the bound of {wire_bound}"
+                ),
+            }).encode()
+        raw = req
+        if self.install_require_admin:
+            err, raw = self._check_install_auth(req)
+            if err is not None:
+                return err
+        if len(raw) > self.max_package_size:
+            return json.dumps({
+                "status": 413,
+                "message": (
+                    f"package too large: {len(raw)} bytes exceeds the "
+                    f"configured max of {self.max_package_size}"
+                ),
+            }).encode()
         try:
-            info = self.packages.install(req)
+            info = self.packages.install(raw)
         except ValueError as e:
             return json.dumps({"status": 400, "message": str(e)}).encode()
         return json.dumps({"status": 200, **info}).encode()
+
+    def _check_install_auth(self, req: bytes):
+        """→ (error_response | None, package_bytes)."""
+        from fabric_tpu.crypto.identity import ROLE_ADMIN
+
+        def deny(msg: str) -> bytes:
+            return json.dumps({"status": 403, "message": msg}).encode()
+
+        try:
+            envelope = json.loads(req)
+            pkg = bytes.fromhex(envelope["package"])
+            ident_ser = bytes.fromhex(envelope["identity"])
+            sig = bytes.fromhex(envelope["signature"])
+        except Exception:
+            return deny(
+                "install requires an admin-signed request envelope "
+                '{"package", "identity", "signature"} (hex fields)'
+            ), b""
+        try:
+            ident = self.msp.deserialize_identity(ident_ser)
+        except Exception as e:
+            return deny(f"unknown installer identity: {e}"), b""
+        if not ident.is_valid:
+            return deny("installer identity failed MSP validation"), b""
+        my_msp = getattr(self.signer, "msp_id", None)
+        if my_msp and ident.msp_id != my_msp:
+            # the reference's install policy is LOCAL-MSP admins: an
+            # admin of another channel org must not install here
+            return deny(
+                f"installer org '{ident.msp_id}' is not this peer's "
+                f"org '{my_msp}'"
+            ), b""
+        if getattr(ident, "role", None) != ROLE_ADMIN:
+            return deny(
+                f"installer '{ident.msp_id}' is not an admin"
+            ), b""
+        if not ident.verify(pkg, sig):
+            return deny("install signature does not cover package"), b""
+        return None, pkg
 
     async def _on_query_installed(self, req: bytes) -> bytes:
         return json.dumps(

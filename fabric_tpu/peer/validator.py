@@ -33,6 +33,7 @@ plugins get the same per-namespace dispatch
 from __future__ import annotations
 
 import hashlib
+import logging
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -47,6 +48,8 @@ from fabric_tpu.ops import p256
 from fabric_tpu.protos import common_pb2, configtx_pb2, transaction_pb2
 
 C = transaction_pb2.TxValidationCode
+
+_log = logging.getLogger("fabric_tpu.validator")
 
 
 class ValidationPlugin:
@@ -375,7 +378,8 @@ class BlockValidator:
             sers[u] = ser
             try:
                 ident = self.msp.deserialize_identity(ser)
-            except Exception:
+            except Exception as e:
+                _log.debug("undeserializable identity in block: %s", e)
                 continue
             idents[u] = ident
             known[u] = True
@@ -716,8 +720,10 @@ class BlockValidator:
                 try:
                     eident = self.msp.deserialize_identity(e.endorser)
                     eitem = _sig_item(eident, prp_bytes + e.endorser, e.signature)
-                except Exception:
-                    continue  # unparseable endorsement: contributes nothing
+                except Exception as exc:
+                    # unparseable endorsement: contributes nothing
+                    _log.debug("endorsement dropped: %s", exc)
+                    continue
                 seen_endorsers.add(e.endorser)
                 ptx.endo_item_idx.append(items.add_slow(eitem))
                 ptx.endorsements.append((e.endorser, eident))
@@ -1012,8 +1018,12 @@ class BlockValidator:
         # a columnar parse leaves endorsement lists / namespaces lazy:
         # the host dispatch path walks them, so fill them first
         self._materialize_for_host(txs, fb)
-        # phase 1a: one batched ECDSA verify for the whole block
-        sig_valid = np.asarray(fetch(), bool) if items else np.zeros(0, bool)
+        # phase 1a: one batched ECDSA verify for the whole block —
+        # the host path's ONE intended device sync
+        sig_valid = (
+            np.asarray(fetch(), bool)  # fabtpu: noqa(FT003)
+            if items else np.zeros(0, bool)
+        )
 
         for ptx in txs:
             if ptx.undetermined and ptx.creator_item_idx >= 0:

@@ -22,6 +22,8 @@ import hashlib
 import os
 import time
 
+from google.protobuf.message import DecodeError
+
 from fabric_tpu.protos import common_pb2, proposal_pb2, transaction_pb2
 
 
@@ -255,8 +257,8 @@ def block_signed_data(blk: common_pb2.Block) -> list:
     for ms in md.signatures:
         try:
             sh = unmarshal(common_pb2.SignatureHeader, ms.signature_header)
-        except Exception:
-            continue
+        except DecodeError:
+            continue  # malformed attestation: contributes no signature
         out.append((sh.creator, md.value + ms.signature_header + hh, ms.signature))
     return out
 

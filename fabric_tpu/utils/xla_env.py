@@ -10,6 +10,17 @@ from __future__ import annotations
 import os
 
 
+def _jaxlib_version() -> tuple:
+    try:
+        import jaxlib.version  # import-light: version module only
+
+        return tuple(
+            int(p) for p in jaxlib.version.__version__.split(".")[:2]
+        )
+    except Exception:
+        return (0, 0)
+
+
 def ensure_cpu_compile_workaround() -> None:
     """Disable the jax 0.9 CPU fusion emitters.
 
@@ -17,7 +28,15 @@ def ensure_cpu_compile_workaround() -> None:
     the crypto kernels (a 64-round SHA-256 compression never finishes
     compiling on a 1-core host); the legacy emitter compiles it in ~2s.
     Harmless for the TPU backend.
+
+    Version-gated: XLA ABORTS the whole process on an unknown flag at
+    backend init, and ``--xla_cpu_use_fusion_emitters`` does not exist
+    on the 0.4.x jaxlibs — setting it there turns every test run into
+    a collection-time SIGABRT.  Older jaxlibs still run the legacy
+    emitter by default, so skipping the flag loses nothing.
     """
+    if _jaxlib_version() < (0, 5):
+        return
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_cpu_use_fusion_emitters" not in flags:
         os.environ["XLA_FLAGS"] = (

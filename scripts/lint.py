@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""Repo lint entrypoint: runs the fabric_tpu static-analysis battery.
+
+Equivalent to ``python -m fabric_tpu.analysis fabric_tpu/`` — kept as
+a script so CI configs and operators have a stable path that survives
+package renames.  Extra arguments pass through (``--json``,
+``--rule FT004``, paths...).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fabric_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

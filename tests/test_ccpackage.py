@@ -153,3 +153,107 @@ def test_install_approve_commit_invoke_flow(tmp_path):
             await cc_server.stop()
 
     run(scenario())
+
+
+def test_install_admission(tmp_path):
+    """The install surface's admission layers: the size cap rejects
+    oversized packages before any parsing, and with
+    ``install_require_admin`` only an admin-signed request envelope
+    reaches the package store."""
+    from fabric_tpu.comm.rpc import RpcClient
+    from fabric_tpu.peer.node import PeerNode
+
+    async def scenario():
+        org = cryptogen.generate_org("Org1MSP", "org1.example.com",
+                                     peers=1, users=1)
+        org2 = cryptogen.generate_org("Org2MSP", "org2.example.com",
+                                      peers=1, users=0)
+        mgr = MSPManager({"Org1MSP": org.msp(), "Org2MSP": org2.msp()})
+        peer = PeerNode(
+            "p0", str(tmp_path / "p0"), mgr,
+            cryptogen.signing_identity(org, "peer0.org1.example.com"),
+            ChaincodeRuntime(),
+            max_package_size=16384,
+            install_require_admin=True,
+        )
+        await peer.start()
+        cli = RpcClient("127.0.0.1", peer.port)
+        await cli.connect()
+        try:
+            raw = ccpackage.package_ccaas("kv_1", "127.0.0.1:9")
+
+            def envelope(signer, pkg=None):
+                pkg = raw if pkg is None else pkg
+                return json.dumps({
+                    "package": pkg.hex(),
+                    "identity": signer.serialized.hex(),
+                    "signature": signer.sign(pkg).hex(),
+                }).encode()
+
+            admin = cryptogen.signing_identity(
+                org, "Admin@org1.example.com"
+            )
+
+            # a wire blob past the generous envelope bound: rejected
+            # before any parsing
+            res = json.loads(await cli.unary(
+                "InstallChaincode", b"\x00" * (2 * 16384 + 65536 + 1)
+            ))
+            assert res["status"] == 413
+            assert "install request too large" in res["message"]
+
+            # an ADMIN-SIGNED envelope whose decoded package exceeds
+            # the cap: auth passes, the size cap still rejects it
+            res = json.loads(await cli.unary(
+                "InstallChaincode",
+                envelope(admin, pkg=raw + b"\x00" * 32768),
+            ))
+            assert res["status"] == 413
+            assert "16384" in res["message"]
+
+            # raw package bytes without the signed envelope: denied
+            res = json.loads(await cli.unary("InstallChaincode", raw))
+            assert res["status"] == 403
+
+            # a valid org CLIENT is not an admin: denied
+            user = cryptogen.signing_identity(org, "User1@org1.example.com")
+            res = json.loads(await cli.unary(
+                "InstallChaincode", envelope(user)
+            ))
+            assert res["status"] == 403
+            assert "not an admin" in res["message"]
+
+            # an ADMIN of a DIFFERENT channel org: denied — install
+            # is the peer's LOCAL org admin surface
+            org2_admin = cryptogen.signing_identity(
+                org2, "Admin@org2.example.com"
+            )
+            res = json.loads(await cli.unary(
+                "InstallChaincode", envelope(org2_admin)
+            ))
+            assert res["status"] == 403
+            assert "not this peer's org" in res["message"]
+
+            # admin envelope with a signature over DIFFERENT bytes: denied
+            bad = json.loads(envelope(admin))
+            bad["signature"] = admin.sign(b"something else").hex()
+            res = json.loads(await cli.unary(
+                "InstallChaincode", json.dumps(bad).encode()
+            ))
+            assert res["status"] == 403
+
+            # the real thing: admin-signed → installed
+            res = json.loads(await cli.unary(
+                "InstallChaincode", envelope(admin)
+            ))
+            assert res["status"] == 200
+            assert res["package_id"] == ccpackage.package_id("kv_1", raw)
+            assert peer.packages.get(res["package_id"]) == raw
+
+            # nothing from the denied attempts leaked into the store
+            assert len(peer.packages.list()) == 1
+        finally:
+            await cli.close()
+            await peer.stop()
+
+    run(scenario())

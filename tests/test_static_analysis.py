@@ -1,0 +1,607 @@
+"""The static-analysis battery: per-rule fixtures + the tier-1 gate.
+
+Each rule gets a known-bad snippet (asserting the exact finding
+location), a known-clean snippet, and a ``# fabtpu: noqa(RULE)``
+suppression check.  ``test_repo_is_clean`` runs the full battery over
+``fabric_tpu/`` in-process and fails on any non-baselined finding —
+that test IS the enforcement: a PR that introduces a jit-purity bug
+or a lock-order inversion fails tier-1.
+"""
+
+import os
+import textwrap
+
+from fabric_tpu.analysis import analyze_paths, load_baseline
+from fabric_tpu.analysis.core import default_baseline_path
+from fabric_tpu.analysis.rules.host_sync import HostSyncRule
+from fabric_tpu.analysis.rules.jit_purity import JitPurityRule
+from fabric_tpu.analysis.rules.lock_discipline import LockDisciplineRule
+from fabric_tpu.analysis.rules.retrace_hazard import RetraceHazardRule
+from fabric_tpu.analysis.rules.swallowed_exception import (
+    SwallowedExceptionRule,
+)
+from fabric_tpu.analysis.rules.union_env import UnionEnvCoercionRule
+
+
+def run_rule(tmp_path, rule, files: dict[str, str]):
+    """files: relpath → source.  → findings sorted by (path, line)."""
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    res = analyze_paths(
+        [str(tmp_path)], root=str(tmp_path), rules=[rule], baseline=None
+    )
+    return res.findings
+
+
+# -- FT001 jit-purity -------------------------------------------------------
+
+BAD_JIT = """\
+import time
+
+import jax
+
+
+@jax.jit
+def kernel(x):
+    t0 = time.perf_counter()
+    return x + t0
+"""
+
+
+class TestJitPurity:
+    def test_flags_wall_clock(self, tmp_path):
+        got = run_rule(tmp_path, JitPurityRule(), {"mod.py": BAD_JIT})
+        assert [(f.rule, f.path, f.line) for f in got] == [
+            ("FT001", "mod.py", 8)
+        ]
+        assert "time.perf_counter" in got[0].message
+
+    def test_flags_call_form_and_mutation(self, tmp_path):
+        src = """\
+        import jax
+
+        _CACHE = {}
+
+
+        def impl(x):
+            _CACHE[x.shape] = x
+            return x * 2
+
+
+        fast = jax.jit(impl)
+        """
+        got = run_rule(tmp_path, JitPurityRule(), {"mod.py": src})
+        assert len(got) == 1
+        assert got[0].line == 7
+        assert "_CACHE" in got[0].message
+
+    def test_clean_kernel_passes(self, tmp_path):
+        src = """\
+        import jax
+        import jax.numpy as jnp
+
+
+        @jax.jit
+        def kernel(x, y):
+            local = {}
+            local["t"] = x + y
+            return local["t"] * 2
+        """
+        assert run_rule(tmp_path, JitPurityRule(), {"mod.py": src}) == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        src = BAD_JIT.replace(
+            "t0 = time.perf_counter()",
+            "t0 = time.perf_counter()  # fabtpu: noqa(FT001)",
+        )
+        assert run_rule(tmp_path, JitPurityRule(), {"mod.py": src}) == []
+
+    def test_noqa_by_name_suppresses(self, tmp_path):
+        src = BAD_JIT.replace(
+            "t0 = time.perf_counter()",
+            "t0 = time.perf_counter()  # fabtpu: noqa(jit-purity)",
+        )
+        assert run_rule(tmp_path, JitPurityRule(), {"mod.py": src}) == []
+
+
+# -- FT002 retrace-hazard ---------------------------------------------------
+
+
+class TestRetraceHazard:
+    def test_mutable_default(self, tmp_path):
+        src = """\
+        import jax
+
+
+        @jax.jit
+        def f(x, opts={}):
+            return x
+        """
+        got = run_rule(tmp_path, RetraceHazardRule(), {"mod.py": src})
+        assert [(f.line, f.col) for f in got] == [(5, 14)]
+        assert "opts" in got[0].message
+
+    def test_closure_over_mutated_module_list(self, tmp_path):
+        src = """\
+        import jax
+
+        SCALE = [1.0]
+
+
+        @jax.jit
+        def f(x):
+            return x * SCALE[0]
+
+
+        def bump():
+            SCALE[0] = 2.0
+        """
+        got = run_rule(tmp_path, RetraceHazardRule(), {"mod.py": src})
+        assert len(got) == 1 and got[0].line == 8
+        assert "SCALE" in got[0].message
+
+    def test_unhashable_static_arg(self, tmp_path):
+        src = """\
+        import jax
+        from functools import partial
+
+
+        @partial(jax.jit, static_argnames=("shape",))
+        def f(x, shape):
+            return x.reshape(shape)
+
+
+        def caller(x):
+            return f(x, shape=[4, 4])
+        """
+        got = run_rule(tmp_path, RetraceHazardRule(), {"mod.py": src})
+        assert len(got) == 1 and got[0].line == 11
+        assert "shape" in got[0].message
+
+    def test_clean(self, tmp_path):
+        src = """\
+        import jax
+
+        SCALE = (1.0, 2.0)
+
+
+        @jax.jit
+        def f(x, n=4):
+            return x * SCALE[0] + n
+        """
+        assert run_rule(tmp_path, RetraceHazardRule(), {"mod.py": src}) == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        src = """\
+        import jax
+
+
+        @jax.jit
+        def f(x, opts={}):  # fabtpu: noqa(FT002)
+            return x
+        """
+        assert run_rule(tmp_path, RetraceHazardRule(), {"mod.py": src}) == []
+
+
+# -- FT003 host-sync-in-hot-path -------------------------------------------
+
+
+class TestHostSync:
+    def test_flags_sync_reachable_from_validator(self, tmp_path):
+        files = {
+            "peer/validator.py": """\
+            from ops import helper
+
+
+            def validate(block):
+                return helper(block)
+            """,
+            "ops.py": """\
+            import jax
+
+
+            def helper(x):
+                y = jax.device_get(x)
+                x.block_until_ready()
+                return y
+            """,
+            "cold.py": """\
+            import jax
+
+
+            def unreachable(x):
+                return jax.device_get(x)
+            """,
+        }
+        got = run_rule(tmp_path, HostSyncRule(), files)
+        assert [(f.path, f.line) for f in got] == [
+            ("ops.py", 5), ("ops.py", 6),
+        ]
+        assert all(f.rule == "FT003" for f in got)
+
+    def test_item_and_asarray_of_call(self, tmp_path):
+        files = {
+            "peer/coordinator.py": """\
+            import numpy as np
+
+
+            def gather(run):
+                total = run().item()
+                arr = np.asarray(run())
+                host = np.asarray(sorted([3, 1]))
+                return total, arr, host
+            """,
+        }
+        got = run_rule(tmp_path, HostSyncRule(), files)
+        # sorted() is host memory by construction — never flagged
+        assert [(f.line,) for f in got] == [(5,), (6,)]
+
+    def test_noqa_marks_intended_sync(self, tmp_path):
+        files = {
+            "peer/validator.py": """\
+            def validate(fetch):
+                return fetch().item()  # fabtpu: noqa(FT003)
+            """,
+        }
+        assert run_rule(tmp_path, HostSyncRule(), files) == []
+
+
+# -- FT004 lock-discipline --------------------------------------------------
+
+
+class TestLockDiscipline:
+    def test_order_cycle_across_modules(self, tmp_path):
+        files = {
+            "a.py": """\
+            async def commit(self):
+                async with self.commit_lock.writer():
+                    async with self.state_lock.writer():
+                        pass
+            """,
+            "b.py": """\
+            async def snapshot(self):
+                async with self.state_lock.reader():
+                    async with self.commit_lock.reader():
+                        pass
+            """,
+        }
+        got = run_rule(tmp_path, LockDisciplineRule(), files)
+        # BOTH sides of the inversion are reported — each site points
+        # at the other, like the race detector's paired stacks
+        assert [(f.path, f.line) for f in got] == [
+            ("a.py", 3), ("b.py", 3),
+        ]
+        for f in got:
+            assert "cycle" in f.message
+            assert {"commit_lock", "state_lock"} <= set(
+                f.message.replace("'", " ").split()
+            )
+
+    def test_blocking_call_under_lock(self, tmp_path):
+        files = {
+            "a.py": """\
+            import os
+            import time
+
+
+            def flush(self, fd, fut):
+                with self._lock:
+                    os.fsync(fd)
+                    time.sleep(0.1)
+                    fut.result()
+            """,
+        }
+        got = run_rule(tmp_path, LockDisciplineRule(), files)
+        assert [(f.line,) for f in got] == [(7,), (8,), (9,)]
+        assert all("_lock" in f.message for f in got)
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        files = {
+            "a.py": """\
+            async def commit(self):
+                async with self.commit_lock.writer():
+                    async with self.state_lock.writer():
+                        pass
+
+
+            async def endorse(self):
+                async with self.commit_lock.reader():
+                    async with self.state_lock.reader():
+                        pass
+            """,
+        }
+        assert run_rule(tmp_path, LockDisciplineRule(), files) == []
+
+    def test_self_deadlock(self, tmp_path):
+        files = {
+            "a.py": """\
+            def nested(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+            """,
+        }
+        got = run_rule(tmp_path, LockDisciplineRule(), files)
+        assert len(got) == 1 and "re-acquired" in got[0].message
+
+    def test_noqa_suppresses(self, tmp_path):
+        files = {
+            "a.py": """\
+            import os
+
+
+            def flush(self, fd):
+                with self._lock:
+                    os.fsync(fd)  # fabtpu: noqa(FT004)
+            """,
+        }
+        assert run_rule(tmp_path, LockDisciplineRule(), files) == []
+
+
+# -- FT005 swallowed-exception ---------------------------------------------
+
+
+class TestSwallowedException:
+    def test_flags_pure_drops(self, tmp_path):
+        src = """\
+        def f(items):
+            out = []
+            for it in items:
+                try:
+                    out.append(parse(it))
+                except Exception:
+                    continue
+            try:
+                cleanup()
+            except:
+                pass
+            return out
+        """
+        got = run_rule(
+            tmp_path, SwallowedExceptionRule(), {"mod.py": src}
+        )
+        assert [(f.line,) for f in got] == [(6,), (10,)]
+
+    def test_verdicts_and_logging_pass(self, tmp_path):
+        src = """\
+        import logging
+
+        log = logging.getLogger(__name__)
+
+
+        def f(x):
+            try:
+                return parse(x)
+            except Exception:
+                return None
+
+
+        def g(x):
+            try:
+                return parse(x)
+            except Exception as e:
+                log.warning("parse failed: %s", e)
+                return False
+
+
+        def h(x):
+            try:
+                return parse(x)
+            except ValueError:
+                pass
+        """
+        assert run_rule(
+            tmp_path, SwallowedExceptionRule(), {"mod.py": src}
+        ) == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        src = """\
+        def f():
+            try:
+                cleanup()
+            except Exception:  # fabtpu: noqa(FT005)
+                pass
+        """
+        assert run_rule(
+            tmp_path, SwallowedExceptionRule(), {"mod.py": src}
+        ) == []
+
+
+# -- FT006 union-env-coercion ----------------------------------------------
+
+# the exact pre-fix shape of nodeconfig._apply_env (ADVICE round 5)
+PRE_FIX_ENV = """\
+import dataclasses
+import os
+import typing
+from dataclasses import dataclass
+
+
+@dataclass
+class TlsConfig:
+    cert: str = ""
+
+
+@dataclass
+class PeerConfig:
+    port: int = 0
+    operations_port: int | None = None
+    tls: TlsConfig | None = None
+
+
+def _coerce(val, typ):
+    return val
+
+
+def _apply_env(cfg, environ=None):
+    env = os.environ if environ is None else environ
+    for f in dataclasses.fields(cfg):
+        typ = f.type
+        key = "FABTPU_" + f.name.upper()
+        if key in env:
+            setattr(cfg, f.name, _coerce(env[key], typ))
+"""
+
+
+class TestUnionEnvCoercion:
+    def test_flags_pre_fix_shape(self, tmp_path):
+        got = run_rule(
+            tmp_path, UnionEnvCoercionRule(), {"mod.py": PRE_FIX_ENV}
+        )
+        # Optional[int] is coercible; Optional[TlsConfig] is the bug
+        assert [(f.line,) for f in got] == [(16,)]
+        assert "PeerConfig.tls" in got[0].message
+        assert "_apply_env" in got[0].message
+
+    def test_get_args_guard_clears(self, tmp_path):
+        src = PRE_FIX_ENV.replace(
+            "        if key in env:",
+            "        args = typing.get_args(typ)\n"
+            "        if key in env:",
+        )
+        assert run_rule(
+            tmp_path, UnionEnvCoercionRule(), {"mod.py": src}
+        ) == []
+
+    def test_no_env_loop_is_clean(self, tmp_path):
+        src = """\
+        from dataclasses import dataclass
+
+
+        @dataclass
+        class Holder:
+            payload: dict | None = None
+        """
+        assert run_rule(
+            tmp_path, UnionEnvCoercionRule(), {"mod.py": src}
+        ) == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        src = PRE_FIX_ENV.replace(
+            "    tls: TlsConfig | None = None",
+            "    tls: TlsConfig | None = None  # fabtpu: noqa(FT006)",
+        )
+        assert run_rule(
+            tmp_path, UnionEnvCoercionRule(), {"mod.py": src}
+        ) == []
+
+
+# -- engine plumbing --------------------------------------------------------
+
+
+class TestEngine:
+    def test_parse_error_is_a_finding(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def f(:\n")
+        got = analyze_paths(
+            [str(tmp_path / "broken.py")], root=str(tmp_path),
+            rules=[], baseline=None,
+        )
+        assert [f.rule for f in got.findings] == ["FT000"]
+
+    def test_baseline_absorbs_exactly_count(self, tmp_path):
+        import json as _json
+
+        src = "try:\n    f()\nexcept Exception:\n    pass\n"
+        (tmp_path / "one.py").write_text(src)
+        (tmp_path / "two.py").write_text(src)
+        rule = SwallowedExceptionRule()
+        live = analyze_paths(
+            [str(tmp_path)], root=str(tmp_path), rules=[rule],
+            baseline=None,
+        )
+        assert len(live.findings) == 2
+        bl = tmp_path / "baseline.json"
+        bl.write_text(_json.dumps({"findings": [
+            {"rule": f.rule, "path": f.path, "message": f.message}
+            for f in live.findings
+        ]}))
+        gated = analyze_paths(
+            [str(tmp_path)], root=str(tmp_path), rules=[rule],
+            baseline=load_baseline(str(bl)),
+        )
+        assert gated.findings == [] and len(gated.baselined) == 2
+
+    def test_stale_baseline_reported(self, tmp_path):
+        import json as _json
+
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        bl = tmp_path / "baseline.json"
+        bl.write_text(_json.dumps({"findings": [
+            {"rule": "FT005", "path": "gone.py", "message": "old"}
+        ]}))
+        res = analyze_paths(
+            [str(tmp_path)], root=str(tmp_path),
+            rules=[SwallowedExceptionRule()],
+            baseline=load_baseline(str(bl)),
+        )
+        assert res.stale_baseline == [("FT005", "gone.py", "old")]
+
+    def test_cli_exit_codes(self, tmp_path):
+        from fabric_tpu.analysis.__main__ import main
+
+        (tmp_path / "bad.py").write_text(
+            "try:\n    f()\nexcept Exception:\n    pass\n"
+        )
+        assert main([str(tmp_path / "bad.py"), "--no-baseline"]) == 1
+        (tmp_path / "good.py").write_text("x = 1\n")
+        assert main([str(tmp_path / "good.py"), "--no-baseline"]) == 0
+        assert main(["--list-rules"]) == 0
+
+
+# -- the tier-1 gate --------------------------------------------------------
+
+
+def test_repo_is_clean():
+    """The whole battery over fabric_tpu/ must report ZERO findings
+    beyond the checked-in baseline.  If this fails, run
+
+        python -m fabric_tpu.analysis
+
+    fix what it prints (or noqa a deliberate exception with a comment
+    saying why), and only baseline as a last resort."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = analyze_paths(
+        [os.path.join(pkg, "fabric_tpu")], root=pkg,
+        baseline=load_baseline(default_baseline_path()),
+    )
+    assert not res.findings, (
+        "static-analysis findings:\n"
+        + "\n".join(f.render() for f in res.findings)
+    )
+    assert not res.stale_baseline, (
+        f"stale baseline entries (findings fixed — prune them): "
+        f"{res.stale_baseline}"
+    )
+
+
+def test_host_sync_roots_resolve():
+    """FT003 seeds its call-graph BFS from peer/validator.py +
+    peer/coordinator.py.  If those modules are renamed the rule would
+    silently check nothing — this pins that the roots still resolve
+    (update HostSyncRule.root_modules alongside any rename)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rule = HostSyncRule()
+    analyze_paths(
+        [os.path.join(pkg, "fabric_tpu")], root=pkg, rules=[rule],
+        baseline=None,
+    )
+    assert rule.last_root_count > 0, (
+        "host-sync rule found no root functions — were the root "
+        "modules renamed? fix HostSyncRule.root_modules"
+    )
+
+
+def test_rule_battery_registered():
+    from fabric_tpu.analysis import all_rules
+
+    ids = {r.id: r.name for r in all_rules()}
+    assert ids == {
+        "FT001": "jit-purity",
+        "FT002": "retrace-hazard",
+        "FT003": "host-sync-in-hot-path",
+        "FT004": "lock-discipline",
+        "FT005": "swallowed-exception",
+        "FT006": "union-env-coercion",
+    }
