@@ -205,7 +205,17 @@ def _build_commit_network(n_tx: int, n_blocks: int = 1,
         return db
 
     def fresh_validator(state):
-        return BlockValidator(mgr, prov, state)
+        import os
+
+        # microbatched device verify (ops/p256v3.py): set e.g. 1024
+        # for ~3 chunks per 1000-tx block so chunk k's device compute
+        # overlaps chunk k+1's host staging.  Default 0 (monolithic):
+        # on a CPU-only host the "device" shares the cores with the
+        # staging, so chunking only adds dispatch overhead (measured
+        # +23% on the 2-core container — see CHANGES.md PR 2); enable
+        # on real-TPU rounds where the overlap is real.
+        chunk = int(os.environ.get("FABTPU_BENCH_VERIFY_CHUNK", "0"))
+        return BlockValidator(mgr, prov, state, verify_chunk=chunk)
 
     return blocks, fresh_state, fresh_validator, mgr, prov, CC, n_invalid_per_block
 
@@ -299,9 +309,9 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5,
     overlaps block n's device verify + commit."""
     import shutil
     import tempfile
-    from concurrent.futures import ThreadPoolExecutor
 
     from fabric_tpu.ledger.kvledger import KVLedger
+    from fabric_tpu.peer.pipeline import CommitPipeline
     from fabric_tpu.protos import common_pb2
 
     (blocks, fresh_state, fresh_validator, mgr, prov, _,
@@ -327,65 +337,33 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5,
         lg = KVLedger(tmp, state_db=state, enable_history=True)
         n_valid = 0
 
-        def txids_of(pend):
-            return [(p.txid, p.idx) for p in pend.txs if p.txid]
-
-        def commit_timed(*args):
+        def commit_fn(res):
             t0 = time.perf_counter()
-            lg.commit_block(*args)
+            lg.commit_block(res.block, res.tx_filter, res.batch,
+                            res.history, None, res.txids,
+                            res.pend.hd_bytes)
             if timings is not None:
                 timings["ledger_commit"] = (
                     timings.get("ledger_commit", 0.0)
                     + time.perf_counter() - t0
                 )
 
-        # depth-2 pipeline, the TPU shape of the reference's deliver
-        # prefetch + committer overlap: while block n sits on device
-        # (verify+policy+MVCC) and block n-1's ledger commit fsyncs on
-        # the committer thread, the prefetch thread parses block n+1.
-        # The predecessor's UpdateBatch rides along as an overlay so
+        # the production depth-2 CommitPipeline (peer/pipeline.py —
+        # the same subsystem the peer node's deliver loop commits
+        # through): while block n sits on device (verify+policy+MVCC)
+        # and block n-1's ledger commit fsyncs on the committer
+        # thread, the prefetch thread parses block n+1; the
+        # predecessor's UpdateBatch rides as a launch overlay so
         # launch(n) never waits for commit(n-1)'s fsync.
-        with ThreadPoolExecutor(1) as prefetch, ThreadPoolExecutor(1) as committer:
-            t0 = time.perf_counter()
-            fut = prefetch.submit(v.preprocess, stream[0])
-            prev = None
-            overlay = extra = None
-            commit_fut = None
-            for i, b in enumerate(stream):
-                pre = fut.result()
-                if i + 1 < len(stream):
-                    fut = prefetch.submit(v.preprocess, stream[i + 1])
-                if prev is not None:
-                    flt, batch, hist = v.validate_finish(prev)
-                    if commit_fut is not None:
-                        commit_fut.result()  # serialize ledger commits
-                    barrier = any(
-                        k[0] == "_lifecycle" for k in batch.updates
-                    ) or any(p.is_config for p in prev.txs)
-                    if barrier:
-                        # lifecycle/config blocks rotate validation
-                        # inputs: commit fully before launching
-                        commit_timed(prev.block, flt, batch, hist,
-                                     None, txids_of(prev),
-                                     prev.hd_bytes)
-                        commit_fut = None
-                        overlay, extra = None, None
-                    else:
-                        commit_fut = committer.submit(
-                            commit_timed, prev.block, flt, batch, hist,
-                            None, txids_of(prev), prev.hd_bytes,
-                        )
-                        overlay, extra = batch, prev.txids
-                    n_valid += sum(1 for c in flt if c == 0)
-                prev = v.validate_launch(
-                    b, pre=pre, overlay=overlay, extra_txids=extra
-                )
-            flt, batch, hist = v.validate_finish(prev)
-            if commit_fut is not None:
-                commit_fut.result()
-            commit_timed(prev.block, flt, batch, hist, None,
-                         txids_of(prev), prev.hd_bytes)
-            n_valid += sum(1 for c in flt if c == 0)
+        t0 = time.perf_counter()
+        with CommitPipeline(v, commit_fn, depth=2) as pipe:
+            for b in stream:
+                res = pipe.submit(b)
+                if res is not None:
+                    n_valid += res.n_valid
+            res = pipe.flush()
+            if res is not None:
+                n_valid += res.n_valid
             dt = time.perf_counter() - t0
         lg.close()
         shutil.rmtree(tmp, ignore_errors=True)
@@ -494,6 +472,20 @@ def main():
         pass
 
     name = sys.argv[1] if len(sys.argv) > 1 else "block_commit"
+    if name in ("block_commit", "block_commit_mixed", "p256_verify"):
+        # these benches need the `cryptography` package for the
+        # OpenSSL CPU baseline and the cert-based test network — on
+        # containers without it, report a skip instead of crashing at
+        # import so the bench driver sees a well-formed JSON line
+        try:
+            import cryptography  # noqa: F401
+        except ImportError as e:
+            print(json.dumps({
+                "skipped": True,
+                "reason": f"cryptography unavailable: {e}",
+                "metric": name,
+            }))
+            return
     result = _BENCHES[name]()
     if name == "block_commit":
         # self-contained round artifact: the headline clean number

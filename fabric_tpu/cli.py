@@ -139,6 +139,8 @@ async def _run_peer(cfg):
         tls=_node_tls(cfg),
         max_package_size=cfg.max_package_size,
         install_require_admin=cfg.install_require_admin,
+        pipeline_depth=cfg.pipeline_depth,
+        verify_chunk=cfg.verify_chunk,
     )
     await node.start(operations_port=cfg.operations_port)
     print(f"peer {node.id} serving on :{node.port}", flush=True)

@@ -336,8 +336,17 @@ class GossipService:
     def start_anti_entropy(self, channel: str, interval: float = 1.0):
         """Per-channel catch-up loop (state.go:584 antiEntropy): probe
         members; when behind, pull the missing range from the peer
-        that has it."""
+        that has it.
+
+        Anti-entropy commits through ``commit_block`` concurrently
+        with the deliver driver, so the channel is pinned to SERIAL
+        commit mode: a depth-2 deliver pipeline validates outside the
+        commit lock, and a concurrent anti-entropy commit would race
+        its state reads (and collide at the ledger with in-flight
+        heights).  Serializing both paths through the writer lock is
+        the safe composition."""
         chan = self.node.channels[channel]
+        chan.pipeline_depth = 1
 
         async def loop():
             while True:

@@ -97,6 +97,15 @@ class PeerConfig:
     group_commit: int = 8            # blockstore fsync window (blocks)
     transient_retention: int = 100   # transient-store purge horizon
     deliver_censorship_check_s: float = 2.0
+    # commit pipeline (peer/pipeline.py CommitPipeline): depth 2 =
+    # deliver prefetch + committer-thread overlap with the predecessor
+    # batch as a launch overlay; 1 = strict serial launch→finish→commit
+    # per block (the correctness oracle)
+    pipeline_depth: int = 2
+    # signature-verify microbatch: signatures per device chunk with
+    # double-buffered dispatch (ops/p256v3.py); 0 = one monolithic
+    # launch per block
+    verify_chunk: int = 0
     # chaincode install surface (peer/node.py _on_install)
     max_package_size: int = DEFAULT_MAX_PACKAGE_SIZE
     install_require_admin: bool = False

@@ -440,14 +440,15 @@ def verify_host(items) -> list[bool]:
     return _verify_host_v1(items)
 
 
-def verify_launch(items):
+def verify_launch(items, chunk: int | None = None):
     """Async launch + fetch() (see p256v3.verify_launch); the v1/v2
     comparison kernels evaluate eagerly (no device handle — the fused
-    device pipeline requires the v3 kernel)."""
+    device pipeline requires the v3 kernel, and ``chunk`` microbatching
+    only applies there)."""
     if _KERNEL not in ("v1", "v2"):
         from fabric_tpu.ops import p256v3
 
-        return p256v3.verify_launch(items)
+        return p256v3.verify_launch(items, chunk=chunk)
     if hasattr(items, "tuples"):
         items = items.tuples()
     result = verify_host(items)

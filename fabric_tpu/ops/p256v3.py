@@ -30,6 +30,8 @@ fabric_tpu.crypto.ec_ref (tests/test_p256v3.py).
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -675,20 +677,95 @@ class VerifyHandle:
         return self.fetch()
 
 
-def verify_launch(items) -> VerifyHandle:
+def _chunk_metrics():
+    from fabric_tpu.ops_metrics import global_registry
+
+    reg = global_registry()
+    return (
+        reg.histogram(
+            "verify_chunk_stage_seconds",
+            "per-chunk host staging / dispatch time (s)",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, float("inf")),
+        ),
+        reg.histogram(
+            "verify_chunks_per_batch",
+            "microbatch chunks per verify batch",
+            buckets=(1, 2, 4, 8, 16, 32, float("inf")),
+        ),
+    )
+
+
+def _launch_chunked(n_real: int, chunk: int, stage_fn) -> VerifyHandle:
+    """Microbatched double-buffered dispatch: ``stage_fn(lo, hi, pad)``
+    stages [lo:hi) on the host (admission checks, batch inversion,
+    window recoding, residue dgemm) padded to ``pad`` lanes and
+    dispatches it, returning the chunk's device output.
+
+    Every chunk except the last is EXACTLY ``chunk`` lanes and the last
+    pads the total out to ``_bucket(n_real)`` — so item i lives at
+    device index i of the concatenated output (no remapping for
+    stage-2 gathers / creator / endorsement item indices) AND the
+    concatenated length stays in the same bucket family as a
+    monolithic launch, so chunking multiplies neither the tail's
+    verify-kernel shapes nor the fused stage-2 program shapes keyed on
+    it.  Because jax dispatch is asynchronous, staging chunk k+1 on
+    the host overlaps chunk k's device compute instead of accumulating
+    one monolithic ``device_wait`` stall; H2D transfers interleave
+    with compute the same way (classic double-buffered accelerator
+    staging).
+    """
+    stage_hist, chunks_hist = _chunk_metrics()
+    outs = []
+    off = 0
+    n_chunks = 0
+    total = _bucket(n_real)
+    while off < n_real:
+        k = min(chunk, n_real - off)
+        # intermediate chunks stay exact so global indices hold; the
+        # tail absorbs all padding (total - off ≥ k since
+        # _bucket(n_real) ≥ n_real)
+        pad = chunk if off + k < n_real else total - off
+        t0 = time.perf_counter()
+        out = stage_fn(off, off + k, pad)
+        stage_hist.observe(time.perf_counter() - t0, stage="stage_dispatch")
+        outs.append(out)
+        off += k
+        n_chunks += 1
+    chunks_hist.observe(n_chunks)
+    dev = jnp.concatenate(outs) if len(outs) > 1 else outs[0]
+    if hasattr(dev, "copy_to_host_async"):
+        dev.copy_to_host_async()
+    return VerifyHandle(dev, n_real)
+
+
+def verify_launch(items, chunk: int | None = None) -> VerifyHandle:
     """Asynchronously dispatch a verify batch; returns a VerifyHandle
     (callable as a zero-arg fetch for list[bool]).  The jax dispatch is
     non-blocking, so the device crunches while the caller's host thread
     moves on — the pipeline primitive the block validator builds on.
 
     Accepts either legacy (digest, r, s, qx, qy) int tuples or a
-    SigCollector (the commit path's zero-bigint column form)."""
+    SigCollector (the commit path's zero-bigint column form).
+
+    ``chunk``: microbatch size — batches larger than this split into
+    chunks dispatched back to back (double-buffered: chunk k+1's host
+    staging overlaps chunk k's device compute).  None/0 = one
+    monolithic launch.  The accept set is identical either way
+    (tests/test_p256v3.py pins chunked ≡ monolithic)."""
+    chunk = max(int(chunk), MIN_BUCKET) if chunk else 0
     if isinstance(items, (ColumnarSigBatch, SigCollector)):
         if not items.n:
             return VerifyHandle(jnp.zeros((0,), bool), 0)
         n_real = items.n
         cols = (items.assemble() if isinstance(items, ColumnarSigBatch)
                 else _assemble_cols(items))
+        if chunk and n_real > chunk:
+            def stage(lo, hi, pad):
+                args = prepare_cols(*(c[lo:hi] for c in cols), pad_to=pad)
+                return verify_batch_packed_jit(pack_cols(*args))
+
+            return _launch_chunked(n_real, chunk, stage)
         args = prepare_cols(*cols, pad_to=_bucket(n_real))
         out = verify_batch_packed_jit(pack_cols(*args))
         if hasattr(out, "copy_to_host_async"):
@@ -698,6 +775,11 @@ def verify_launch(items) -> VerifyHandle:
     if not items:
         return VerifyHandle(jnp.zeros((0,), bool), 0)
     n_real = len(items)
+    if chunk and n_real > chunk:
+        def stage(lo, hi, pad):
+            return verify_batch_jit(*prepare(items[lo:hi], pad_to=pad))
+
+        return _launch_chunked(n_real, chunk, stage)
     args = prepare(items, pad_to=_bucket(n_real))
     out = verify_batch_jit(*args)  # async under jax's deferred execution
     if hasattr(out, "copy_to_host_async"):

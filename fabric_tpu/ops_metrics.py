@@ -120,13 +120,18 @@ class Registry:
     def gauge(self, name: str, help_: str = "") -> Gauge:
         return self._get(name, help_, Gauge)
 
-    def histogram(self, name: str, help_: str = "") -> Histogram:
-        return self._get(name, help_, Histogram)
+    def histogram(self, name: str, help_: str = "",
+                  buckets=None) -> Histogram:
+        """``buckets`` applies on FIRST registration only (a metric's
+        bucket layout is fixed for its lifetime); later callers get
+        the existing instrument regardless."""
+        kwargs = {} if buckets is None else {"buckets": buckets}
+        return self._get(name, help_, Histogram, **kwargs)
 
-    def _get(self, name, help_, cls):
+    def _get(self, name, help_, cls, **kwargs):
         m = self._metrics.get(name)
         if m is None:
-            m = self._metrics[name] = cls(name, help_, self)
+            m = self._metrics[name] = cls(name, help_, self, **kwargs)
         if not isinstance(m, cls):
             raise TypeError(f"metric {name} already registered as {type(m).__name__}")
         return m

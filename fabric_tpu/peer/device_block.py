@@ -25,6 +25,7 @@ validation/validator.go:81-118, per-tx fan-out v20/validator.go:193.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -162,6 +163,21 @@ class DeviceBlockPipeline:
 
     def __init__(self):
         self._cache = _PROGRAM_CACHE
+        from fabric_tpu.ops_metrics import global_registry
+
+        reg = global_registry()
+        # stage-2 telemetry: dispatch cost (host side of the fused
+        # launch) and the structural-program cache size — a growing
+        # gauge on a stable workload means retraces are leaking in
+        self._dispatch_hist = reg.histogram(
+            "device_stage2_dispatch_seconds",
+            "host-side fused stage-2 dispatch time (s)",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, float("inf")),
+        )
+        self._cache_gauge = reg.gauge(
+            "device_stage2_programs", "compiled stage-2 program cache size"
+        )
 
     def run(self, handle, launch_vec, groups, static_packed, static_dims,
             pre_ok_pad_len):
@@ -180,12 +196,15 @@ class DeviceBlockPipeline:
             fn = self._cache[key] = build_stage2(
                 t_bucket, n_sig, gsigs, static_dims
             )
+            self._cache_gauge.set(len(self._cache))
+        t0 = time.perf_counter()
         args = [handle.device_out, jnp.asarray(launch_vec)]
         args += [gp for _, gp, _, _ in groups]
         args += [static_packed]
         packed = fn(*args)
         if hasattr(packed, "copy_to_host_async"):
             packed.copy_to_host_async()
+        self._dispatch_hist.observe(time.perf_counter() - t0)
 
         def fetch():
             flat = np.asarray(packed).astype(bool)

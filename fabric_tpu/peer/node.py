@@ -56,8 +56,13 @@ class PeerChannel:
     def __init__(self, channel_id: str, data_dir: str, msp_manager=None,
                  policy_provider: PolicyProvider | None = None, state_db=None,
                  config_processor=None, genesis_block=None,
-                 snapshot_dir: str | None = None):
+                 snapshot_dir: str | None = None, pipeline_depth: int = 2,
+                 verify_chunk: int = 0):
         self.id = channel_id
+        # commit-path knobs (nodeconfig pipeline_depth / verify_chunk):
+        # depth 2 = CommitPipeline overlap on the deliver loop, 1 =
+        # strict serial commit_block per block
+        self.pipeline_depth = int(pipeline_depth)
         snap_meta = None
         if snapshot_dir is not None:
             from fabric_tpu.ledger.snapshot import create_from_snapshot
@@ -140,6 +145,7 @@ class PeerChannel:
         self.validator = BlockValidator(
             msp_manager, policy_provider, self.ledger.state,
             block_store=self.ledger.blocks, config_processor=config_processor,
+            verify_chunk=verify_chunk,
         )
         from fabric_tpu.peer.coordinator import PvtDataCoordinator
         from fabric_tpu.peer.transient import TransientStore
@@ -197,7 +203,11 @@ class PeerChannel:
         )
 
     async def commit_block(self, block) -> bytes:
-        """Validate + commit one block (the StoreBlock path).
+        """Validate + commit one block, strictly serially (the
+        StoreBlock path).  Direct callers and the ``pipeline_depth=1``
+        deliver loop use this; depth-2 streams go through
+        ``_run_deliver_pipelined``/CommitPipeline instead, which
+        overlaps block n's validation with block n-1's ledger commit.
 
         The validate call dispatches device kernels (and may compile on
         first use) — it runs in a worker thread so the node's RPC
@@ -205,9 +215,6 @@ class PeerChannel:
         v20/validator.go:193)."""
         import time as _time
 
-        from fabric_tpu.ops_metrics import global_registry
-
-        reg = global_registry()
         loop = asyncio.get_event_loop()
 
         def _verify_and_validate(b):
@@ -215,64 +222,101 @@ class PeerChannel:
             # off the event loop with the rest of validation
             self.verify_block_signature(b)
             pend = self.validator.validate_launch(b)
-            return self.validator.validate_finish(pend), pend.hd_bytes
+            return pend, self.validator.validate_finish(pend)
 
         async with self.commit_lock.writer():
             t0 = _time.perf_counter()
-            (flt, batch, history), hd_bytes = await loop.run_in_executor(
+            pend, (flt, batch, history) = await loop.run_in_executor(
                 None, _verify_and_validate, block
             )
             t1 = _time.perf_counter()
-            # pvt phase (StoreBlock, coordinator.go:190-220): cleartext
-            # from transient/pull, hash-verified, into pvt namespaces
-            from fabric_tpu.peer.transient import encode_kv
-
-            pvt = await self.coordinator.gather(
-                block.header.number, self.validator.last_parsed, flt
-            )
-            for hns, key, value, ver in pvt.updates:
-                if value is None:
-                    batch.delete(hns, key, ver)
-                else:
-                    batch.put(hns, key, value, ver)
-            def _expiry(ns, coll):
-                # BTL from the collection config: expiringBlk =
-                # committingBlk + btl + 1 (pvtdatapolicy.BTLPolicy) —
-                # the data stays queryable for btl FULL blocks past its
-                # commit, then purge_expired erases store + pvt state
-                btl = int((self.collection_config(ns, coll) or {})
-                          .get("btl", 0) or 0)
-                return block.header.number + btl + 1 if btl > 0 else 0
-
-            pvt_store = {
-                (txnum, ns, coll): (encode_kv(kv), _expiry(ns, coll))
-                for txnum, colls in pvt.store_data.items()
-                for (ns, coll), kv in colls.items()
-            }
-            self.ledger.commit_block(block, flt, batch, history,
-                                     pvt_data=pvt_store, hd_bytes=hd_bytes)
-            if pvt.missing:
-                self.ledger.pvtdata.commit_block(
-                    block.header.number, {},
-                    [(txnum, ns, coll, True)
-                     for (txnum, _txid, ns, coll) in pvt.missing],
-                )
-            self.transient.purge_below(
-                max(0, block.header.number - self.transient_retention)
+            await self._commit_inner(
+                block, pend.txs, flt, batch, history, pend.hd_bytes
             )
             t2 = _time.perf_counter()
-            self._post_commit(block, flt, batch)
+        self._commit_metrics(flt, t1 - t0, t2 - t1, t2 - t0)
+        self._signal_height()
+        return flt
+
+    async def _commit_inner(self, block, txs, flt, batch, history,
+                            hd_bytes) -> None:
+        """Validated triple → committed ledger state: pvt-data phase,
+        ledger commit + fsync, post-commit bookkeeping.  The caller
+        holds the commit writer lock; ``txs`` are the block's parsed
+        records (under pipelining ``validator.last_parsed`` already
+        points at the NEXT launched block, so they ride in
+        explicitly)."""
+        # pvt phase (StoreBlock, coordinator.go:190-220): cleartext
+        # from transient/pull, hash-verified, into pvt namespaces
+        from fabric_tpu.peer.transient import encode_kv
+
+        pvt = await self.coordinator.gather(block.header.number, txs, flt)
+        for hns, key, value, ver in pvt.updates:
+            if value is None:
+                batch.delete(hns, key, ver)
+            else:
+                batch.put(hns, key, value, ver)
+
+        def _expiry(ns, coll):
+            # BTL from the collection config: expiringBlk =
+            # committingBlk + btl + 1 (pvtdatapolicy.BTLPolicy) —
+            # the data stays queryable for btl FULL blocks past its
+            # commit, then purge_expired erases store + pvt state
+            btl = int((self.collection_config(ns, coll) or {})
+                      .get("btl", 0) or 0)
+            return block.header.number + btl + 1 if btl > 0 else 0
+
+        pvt_store = {
+            (txnum, ns, coll): (encode_kv(kv), _expiry(ns, coll))
+            for txnum, colls in pvt.store_data.items()
+            for (ns, coll), kv in colls.items()
+        }
+
+        # the storage commit runs ON the event-loop thread, as the
+        # serial path always did: the transient/pvtdata sqlite stores
+        # share single connections with loop-thread gossip handlers
+        # (persist/reconcile), so moving this to a worker would
+        # interleave transactions on one connection.  The pipeline's
+        # overlap is unaffected — the NEXT block validates on the
+        # feeder thread while this runs.
+        self.ledger.commit_block(
+            block, flt, batch, history, pvt_data=pvt_store,
+            txids=[(p.txid, p.idx) for p in txs if p.txid],
+            hd_bytes=hd_bytes,
+        )
+        if pvt.missing:
+            self.ledger.pvtdata.commit_block(
+                block.header.number, {},
+                [(txnum, ns, coll, True)
+                 for (txnum, _txid, ns, coll) in pvt.missing],
+            )
+        self.transient.purge_below(
+            max(0, block.header.number - self.transient_retention)
+        )
+        # clients key retries off commit acknowledgment: force any
+        # open group-commit fsync window closed BEFORE signalling
+        # height / commit status, so an acknowledged block can never
+        # be lost to a crash on a quiet channel (the add-block-time
+        # lag check only runs while traffic flows)
+        self.ledger.blocks.sync()
+        self._post_commit(block, flt, batch, txs)
+
+    def _commit_metrics(self, flt: bytes, validate_s: float,
+                        commit_s: float, total_s: float) -> None:
         # the reference's commit-path breakdown (kv_ledger.go:712-727)
+        from fabric_tpu.ops_metrics import global_registry
+
+        reg = global_registry()
         reg.histogram(
             "ledger_block_processing_time",
             "full StoreBlock wall clock per block (s)",
-        ).observe(t2 - t0, channel=self.id)
+        ).observe(total_s, channel=self.id)
         reg.histogram(
             "validation_duration", "validate phase per block (s)"
-        ).observe(t1 - t0, channel=self.id)
+        ).observe(validate_s, channel=self.id)
         reg.histogram(
             "ledger_statedb_commit_time", "storage commit per block (s)"
-        ).observe(t2 - t1, channel=self.id)
+        ).observe(commit_s, channel=self.id)
         reg.gauge(
             "ledger_blockchain_height", "committed block height"
         ).set(self.height, channel=self.id)
@@ -283,25 +327,45 @@ class PeerChannel:
         reg.counter(
             "ledger_transaction_count", "committed txs by validity"
         ).add(len(flt) - n_valid, channel=self.id, status="invalid")
-        # clients key retries off commit acknowledgment: force any open
-        # group-commit fsync window closed BEFORE signalling height /
-        # commit status, so an acknowledged block can never be lost to
-        # a crash on a quiet channel (the add-block-time lag check
-        # only runs while traffic flows)
-        self.ledger.blocks.sync()
+
+    def _signal_height(self) -> None:
         self._height_changed.set()
         self._height_changed = asyncio.Event()
-        return flt
 
-    def _post_commit(self, block, flt: bytes, batch) -> None:
+    async def _commit_from_pipeline(self, res) -> None:
+        """Commit one CommittedBlock on behalf of the pipeline's
+        committer thread (the pvt coordinator and the commit lock are
+        loop-affine, so the thread bridges here via
+        run_coroutine_threadsafe)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        async with self.commit_lock.writer():
+            await self._commit_inner(
+                res.block, res.pend.txs, res.tx_filter, res.batch,
+                res.history, res.pend.hd_bytes,
+            )
+        commit_s = _time.perf_counter() - t0
+        # launch + finish ≈ the serial path's validate span, so a
+        # depth-1 → depth-2 flip compares like for like (the prefetch
+        # parse overlaps the predecessor and is deliberately excluded)
+        validate_s = (res.stage_s.get("launch", 0.0)
+                      + res.stage_s.get("finish", 0.0))
+        self._commit_metrics(res.tx_filter, validate_s, commit_s,
+                             validate_s + commit_s)
+        self._signal_height()
+
+    def _post_commit(self, block, flt: bytes, batch, txs=None) -> None:
         """Post-commit bookkeeping: lifecycle-cache invalidation when
         the block wrote ``_lifecycle`` (lifecycle.Cache StateListener
         analog) and channel-config bundle rotation for committed CONFIG
         txs (BundleSource update, core/peer/peer.go).
 
-        Uses the validator's already-parsed tx records — normal blocks
-        cost zero extra parsing.  A failure to APPLY a committed config
-        is a serious divergence and must be loud, not swallowed."""
+        Uses the block's already-parsed tx records (``txs``; falls back
+        to the validator's last parse for legacy callers) — normal
+        blocks cost zero extra parsing.  A failure to APPLY a committed
+        config is a serious divergence and must be loud, not
+        swallowed."""
         pol_provider = self.validator.policies
         if hasattr(pol_provider, "on_block_committed"):
             pol_provider.on_block_committed(batch)
@@ -329,7 +393,9 @@ class PeerChannel:
             return
         from fabric_tpu.protos import configtx_pb2, transaction_pb2
 
-        for ptx in getattr(self.validator, "last_parsed", ()):
+        if txs is None:
+            txs = getattr(self.validator, "last_parsed", ())
+        for ptx in txs:
             if not ptx.is_config or flt[ptx.idx] != transaction_pb2.TxValidationCode.VALID:
                 continue
             try:
@@ -495,12 +561,20 @@ class PeerChannel:
     async def run_deliver(self, orderer_addr: tuple[str, int]):
         """Pull blocks from the orderer starting at our height and
         commit them in order; reconnects forever (deliver client
-        failover is caller-side: pass a different address)."""
+        failover is caller-side: pass a different address).
+
+        With ``pipeline_depth`` ≥ 2 (the default) blocks stream through
+        the CommitPipeline so block n's validation, block n-1's ledger
+        commit, and block n+1's parse + device launch overlap; depth 1
+        commits strictly serially through ``commit_block``."""
         import contextlib
 
         dc = DeliverClient(*orderer_addr,
                            ssl_ctx=getattr(self, "client_ssl", None))
         async with contextlib.aclosing(dc.blocks(self.id, start=self.height)) as gen:
+            if self.pipeline_depth > 1:
+                await self._run_deliver_pipelined(gen)
+                return
             async for blk in gen:
                 # stream liveness for the censorship monitor: a block
                 # ARRIVED (even if its validation is slow) — only a
@@ -511,6 +585,149 @@ class PeerChannel:
                 if blk.header.number < self.height:
                     continue  # replayed
                 await self.commit_block(blk)
+
+    # seconds of stream silence before the in-flight tail is flushed:
+    # with depth 2 the newest block stays launched-but-uncommitted
+    # until the NEXT submit, and a quiet channel must not leave it
+    # dangling (clients block on height for their commit ack) —
+    # pipelining engages only while blocks arrive back to back
+    PIPELINE_IDLE_FLUSH_S = 0.05
+
+    async def _run_deliver_pipelined(self, gen):
+        """Depth-2 deliver commit driver over peer.pipeline: the
+        production analog of the reference's deliver prefetch +
+        committer overlap (gossip/state/state.go:540) — the commit
+        path stops paying full launch→finish→commit serialization per
+        block."""
+        from fabric_tpu.peer.pipeline import CommitPipeline
+
+        loop = asyncio.get_event_loop()
+
+        def commit_fn(res):
+            # committer thread → event loop: the pvt coordinator and
+            # commit lock are loop-affine (the loop is free — the
+            # deliver task awaits pipeline calls in the executor).
+            # Poll with a bounded wait instead of blocking forever: if
+            # the loop is torn down before the coroutine runs, the
+            # future never resolves and an unbounded .result() would
+            # wedge the committer thread — and with it executor
+            # shutdown and interpreter exit.
+            import concurrent.futures as _cf
+
+            fut = asyncio.run_coroutine_threadsafe(
+                self._commit_from_pipeline(res), loop
+            )
+            while True:
+                try:
+                    return fut.result(timeout=5.0)
+                except _cf.TimeoutError:
+                    if fut.done():
+                        # py3.11+: concurrent.futures.TimeoutError is
+                        # builtin TimeoutError — this one came from
+                        # the COMMIT itself (e.g. an fsync ETIMEDOUT),
+                        # not from our poll; surface it
+                        raise
+                    if loop.is_closed():
+                        fut.cancel()
+                        raise RuntimeError(
+                            f"{self.id}: event loop closed while "
+                            f"committing block {res.block.header.number}"
+                        ) from None
+
+        # orderer block signatures + BFT attestation verify at LAUNCH
+        # (caller thread), not at prefetch: a predecessor CONFIG block
+        # rotates the orderer set at commit, and the barrier only
+        # guarantees that rotation has landed by launch time — a
+        # forged block must never launch, and a legitimate block must
+        # never be judged by the pre-rotation bundle
+        pipe = CommitPipeline(
+            self.validator, commit_fn, depth=self.pipeline_depth,
+            pre_launch_fn=self.verify_block_signature, channel=self.id,
+        )
+        # submit() blocks for device syncs and for the committer
+        # thread — feeding from the shared default executor could
+        # exhaust it when many channels block in submit at once,
+        # starving everything else that needs a worker (endorsements,
+        # other channels' commits).  A dedicated feeder thread per
+        # channel keeps the pools independent.
+        from concurrent.futures import ThreadPoolExecutor
+
+        feeder = ThreadPoolExecutor(1, thread_name_prefix="fabtpu-feed")
+        # blocks arrive through a reader task + queue so this driver
+        # can flush the pipeline's in-flight tail when the stream goes
+        # idle (see PIPELINE_IDLE_FLUSH_S) — asyncio.wait_for directly
+        # on the generator would cancel its internal stream read
+        reader_exc: list = []
+        q: asyncio.Queue = asyncio.Queue(maxsize=4)
+
+        async def reader():
+            try:
+                async for blk in gen:
+                    await q.put(blk)
+            except BaseException as e:
+                reader_exc.append(e)
+            finally:
+                await q.put(None)
+
+        rtask = asyncio.ensure_future(reader())
+        # height lags the in-flight window, so replay detection tracks
+        # the next EXPECTED number, not the committed height
+        expect = self.height
+        try:
+            while True:
+                try:
+                    if pipe.inflight:
+                        blk = await asyncio.wait_for(
+                            q.get(), timeout=self.PIPELINE_IDLE_FLUSH_S
+                        )
+                    else:
+                        blk = await q.get()
+                except asyncio.TimeoutError:
+                    # stream went quiet with a block in flight:
+                    # commit the tail now — its clients are waiting
+                    await loop.run_in_executor(feeder, pipe.flush)
+                    continue
+                if blk is None:
+                    break  # stream ended (reader_exc carries errors)
+                self._deliver_progress = (
+                    getattr(self, "_deliver_progress", 0) + 1
+                )
+                # a concurrent anti-entropy pull may commit past our
+                # window — resync to the live height so a redelivered
+                # block is skipped (as the serial path does) instead
+                # of validated and rejected at the ledger
+                expect = max(expect, self.height)
+                if blk.header.number < expect:
+                    continue  # replayed
+                expect = blk.header.number + 1
+                if self.pipeline_depth <= 1:
+                    # pinned to serial mid-stream (anti-entropy came
+                    # up, see gossip.start_anti_entropy): drain the
+                    # pipeline, then commit through the locked path
+                    await loop.run_in_executor(feeder, pipe.flush)
+                    await self.commit_block(blk)
+                    continue
+                await loop.run_in_executor(feeder, pipe.submit, blk)
+            if reader_exc:
+                raise reader_exc[0]
+        except BaseException:
+            # drop the in-flight tail: height never advanced for it,
+            # so the reconnect re-delivers from the right place
+            await loop.run_in_executor(
+                feeder, lambda: pipe.close(flush=False)
+            )
+            raise
+        else:
+            # stream closed cleanly: flush the verified tail
+            await loop.run_in_executor(feeder, pipe.close)
+        finally:
+            # await the cancelled reader before run_deliver's
+            # aclosing() touches the generator: aclose() on a
+            # still-running async generator raises and would MASK the
+            # real stream/commit error
+            rtask.cancel()
+            await asyncio.gather(rtask, return_exceptions=True)
+            feeder.shutdown(wait=False)
 
     def start_deliver(self, orderer_addrs: list[tuple[str, int]],
                       censorship_check_s: float = 2.0):
@@ -658,12 +875,17 @@ class PeerNode:
                  runtime: ChaincodeRuntime | None = None,
                  host: str = "127.0.0.1", port: int = 0, tls=None,
                  max_package_size: int = DEFAULT_MAX_PACKAGE_SIZE,
-                 install_require_admin: bool = False):
+                 install_require_admin: bool = False,
+                 pipeline_depth: int = 2, verify_chunk: int = 0):
         self.id = node_id
         self.dir = data_dir
         self.msp = msp_manager
         self.signer = signer
         self.runtime = runtime or ChaincodeRuntime()
+        # commit-path knobs every joined channel inherits (nodeconfig
+        # pipeline_depth / verify_chunk)
+        self.pipeline_depth = int(pipeline_depth)
+        self.verify_chunk = int(verify_chunk)
         # install-surface admission (see _on_install): a size cap
         # always, and optionally an admin-signed request envelope
         self.max_package_size = int(max_package_size)
@@ -831,6 +1053,8 @@ class PeerNode:
             None if anchored else self.msp,
             policy_provider, state_db, config_processor,
             genesis_block=genesis_block, snapshot_dir=snapshot_dir,
+            pipeline_depth=self.pipeline_depth,
+            verify_chunk=self.verify_chunk,
         )
         ch.client_ssl = self.tls.client_ctx() if self.tls else None
         ch.runtime = self.runtime  # resolved-binding invalidation hook

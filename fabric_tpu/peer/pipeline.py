@@ -1,0 +1,360 @@
+"""The production commit pipeline: depth-2 block overlap as a
+reusable subsystem shared by the peer node's deliver loop and bench.py.
+
+Shape (the TPU analog of the reference peer's deliver prefetch +
+committer overlap, gossip/state/state.go:540 + the validator pool,
+v20/validator.go:193):
+
+    prefetch thread   preprocess(block n+1)      host parse + async
+                                                 device verify launch
+    caller thread     validate_finish(block n-1) device sync → filter
+                      validate_launch(block n)   overlay = n-1's batch
+    committer thread  commit(block n-1)          ledger fsync
+
+While block n sits on device and block n-1's ledger commit fsyncs on
+the committer thread, the prefetch thread parses block n+1.  The
+predecessor's UpdateBatch rides along as an *overlay* on block n's
+launch (committed-version fill, range re-execution, dup-txid checks),
+so launch(n) never waits for commit(n-1)'s fsync — the overlay
+equivalence is pinned by tests/test_pipeline.py.
+
+Lifecycle/config barrier: blocks that rotate validation inputs —
+CONFIG txs (MSP/policy object rotation) and blocks writing the
+``_lifecycle`` namespace (state-backed chaincode definitions feed the
+preprocess-time policy plans) — must commit FULLY before the next
+block launches, with the overlay dropped.  ``CommitPipeline`` owns
+that rule so no caller can get it wrong (validate_launch also refuses
+a lifecycle-writing overlay as a backstop).
+
+``depth=1`` degrades to the strict serial launch→finish→commit order —
+the correctness oracle, kept behind the ``pipeline_depth`` node config
+knob.
+
+Overlap telemetry rides the process metrics registry
+(fabric_tpu.ops_metrics) so the bench breakdown and production
+telemetry agree:
+
+* ``commit_pipeline_stage_seconds{stage=...}`` — prefetch_wait /
+  finish / commit_wait / launch per block,
+* ``commit_pipeline_overlap_ratio`` — 1 − blocked/total per block
+  (1.0 = the pipeline never stalled on prefetch or the committer),
+* ``commit_pipeline_inflight`` — blocks in flight (launched or
+  committing),
+* ``commit_pipeline_blocks_total{mode=...}`` — pipelined / barrier /
+  serial block counts.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+
+@dataclass
+class CommittedBlock:
+    """One block through the pipeline: the validated triple plus the
+    PendingBlock handle (``pend.txs`` carries the parsed records for
+    post-commit consumers; ``pend.hd_bytes`` the pre-serialized
+    header+data for the ledger)."""
+
+    block: object
+    pend: object
+    tx_filter: bytes
+    batch: object
+    history: list
+    barrier: bool = False
+    # filled by the pipeline for telemetry (seconds)
+    stage_s: dict = field(default_factory=dict)
+
+    @property
+    def txids(self) -> list:
+        """[(txid, idx)] for the ledger's txid index."""
+        return [(p.txid, p.idx) for p in self.pend.txs if p.txid]
+
+    @property
+    def n_valid(self) -> int:
+        return sum(1 for c in self.tx_filter if c == 0)
+
+
+def _is_barrier(pend, batch) -> bool:
+    """True for blocks that rotate validation inputs: commit fully,
+    drop the overlay, before the successor may launch."""
+    return any(k[0] == "_lifecycle" for k in batch.updates) or any(
+        p.is_config for p in pend.txs
+    )
+
+
+class CommitPipeline:
+    """Streaming depth-2 commit pipeline over a BlockValidator.
+
+    ``submit(block)`` feeds the next block in height order and returns
+    the COMPLETED predecessor (its commit handed to the committer
+    thread — or fully flushed for barriers/serial mode), or None while
+    the pipe fills.  ``flush()`` drains the in-flight tail.  Use as a
+    context manager, or call ``close()``; both flush unless told not
+    to.
+
+    ``commit_fn(res: CommittedBlock)`` runs on the committer thread
+    (inline for barriers and in serial mode) and must perform the
+    ledger commit; commits are serialized in block order and a commit
+    failure surfaces at the next ``submit``/``flush``.
+
+    ``prefetch_fn(block)`` (default ``validator.preprocess``) runs on
+    the prefetch thread.  ``pre_launch_fn(block)`` runs on the CALLER
+    thread immediately before the block's launch — the node hangs
+    orderer block-signature verification here, NOT on the prefetch
+    thread, because the barrier guarantees a predecessor CONFIG block
+    has fully committed (bundle rotated) by launch time, while
+    prefetch overlaps that commit and would verify against the
+    pre-rotation orderer set.
+    """
+
+    def __init__(self, validator, commit_fn, depth: int = 2,
+                 prefetch_fn=None, pre_launch_fn=None, registry=None,
+                 channel: str = ""):
+        self.validator = validator
+        self.commit_fn = commit_fn
+        # the overlay mechanism covers exactly ONE in-flight
+        # predecessor, so useful depths are 1 (serial) and 2
+        self.depth = 1 if depth <= 1 else 2
+        self.prefetch_fn = prefetch_fn or validator.preprocess
+        self.pre_launch_fn = pre_launch_fn
+        self.channel = channel
+        if registry is None:
+            from fabric_tpu.ops_metrics import global_registry
+
+            registry = global_registry()
+        self._stage_hist = registry.histogram(
+            "commit_pipeline_stage_seconds",
+            "per-block commit pipeline stage time (s)",
+        )
+        self._overlap_hist = registry.histogram(
+            "commit_pipeline_overlap_ratio",
+            "1 - blocked/total per pipelined block",
+            buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99, 1.0,
+                     float("inf")),
+        )
+        self._inflight_gauge = registry.gauge(
+            "commit_pipeline_inflight", "blocks launched or committing"
+        )
+        self._blocks_ctr = registry.counter(
+            "commit_pipeline_blocks_total", "blocks through the pipeline"
+        )
+        self._prefetch = ThreadPoolExecutor(
+            1, thread_name_prefix="fabtpu-prefetch"
+        )
+        self._committer = ThreadPoolExecutor(
+            1, thread_name_prefix="fabtpu-committer"
+        )
+        self._pre: tuple | None = None       # (block, prefetch Future)
+        self._launched = None                # PendingBlock in flight
+        self._commit_fut: Future | None = None
+        self._overlay = None
+        self._extra = None
+        # set when a barrier flushed AFTER the next block was already
+        # staged on the prefetch thread — that prefetch ran against
+        # pre-barrier state and must be redone (see _launch_next)
+        self._stale_prefetch = False
+        # the in-flight block's own launch duration, attached to its
+        # CommittedBlock at finish so per-block metrics keep covering
+        # launch+finish under pipelining (prefetch parse overlaps the
+        # predecessor and is deliberately excluded)
+        self._launch_s = 0.0
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        # on an exception unwind, don't let a flush failure mask it
+        self.close(flush=exc_type is None)
+        return False
+
+    def close(self, flush: bool = True):
+        """Shut the worker threads down; with ``flush`` (default) the
+        in-flight tail commits first."""
+        if self._closed:
+            return None
+        res = None
+        try:
+            if flush:
+                res = self.flush()
+        finally:
+            self._closed = True
+            self._prefetch.shutdown(wait=True)
+            self._committer.shutdown(wait=True)
+            self._inflight_gauge.set(0, channel=self.channel)
+        return res
+
+    @property
+    def inflight(self) -> int:
+        """Blocks accepted but not yet returned as committed — feeds
+        the ``commit_pipeline_inflight`` gauge.  (Replay protection in
+        the deliver loop tracks the next expected block number
+        directly; it does not consume this.)"""
+        return (self._pre is not None) + (self._launched is not None)
+
+    # -- the pipeline ------------------------------------------------------
+
+    def submit(self, block):
+        """Feed the next block (height order).  Depth-2: returns the
+        predecessor's CommittedBlock (commit in flight on the
+        committer thread unless it was a barrier) or None while the
+        pipe fills.  Serial (depth=1): validates AND commits ``block``
+        inline, returning its CommittedBlock."""
+        if self._closed:
+            raise RuntimeError("pipeline is closed")
+        if self.depth == 1:
+            return self._submit_serial(block)
+        t_sub = time.perf_counter()
+        # stage the new block on the prefetch thread FIRST: its host
+        # parse + device verify launch overlap the predecessor's
+        # device sync below
+        assert self._pre is None, "submit() before the previous returned"
+        self._pre = (block, self._prefetch.submit(self.prefetch_fn, block))
+        self._inflight_gauge.set(self.inflight, channel=self.channel)
+
+        out = None
+        if self._launched is not None:
+            out = self._finish_and_commit(self._launched)
+        self._launch_next(out.stage_s if out is not None else {}, t_sub)
+        return out
+
+    def flush(self):
+        """Drain: finish + commit the last launched block and wait for
+        every committer-thread commit.  Returns the final
+        CommittedBlock (or None if nothing was in flight)."""
+        out = None
+        if self._launched is not None:
+            out = self._finish_and_commit(self._launched, tail=True)
+            self._launched = None
+        if self._pre is not None:
+            # a prefetched block with no successor: run it serially
+            block, fut = self._pre
+            self._pre = None
+            pre = fut.result()
+            if self._stale_prefetch:
+                # prefetched before its barrier predecessor committed
+                self._stale_prefetch = False
+                pre = self.prefetch_fn(block)
+            if self.pre_launch_fn is not None:
+                self.pre_launch_fn(block)
+            t0 = time.perf_counter()
+            pend = self.validator.validate_launch(
+                block, pre=pre, overlay=self._overlay,
+                extra_txids=self._extra,
+            )
+            self._launch_s = time.perf_counter() - t0
+            out = self._finish_and_commit(pend, tail=True)
+        if self._commit_fut is not None:
+            self._commit_fut.result()
+            self._commit_fut = None
+        self._overlay = self._extra = None
+        # nothing is prefetched past this point: a barrier flushed as
+        # the tail must not make the NEXT submit discard and redo its
+        # (post-barrier) prefetch
+        self._stale_prefetch = False
+        self._inflight_gauge.set(0, channel=self.channel)
+        return out
+
+    def _submit_serial(self, block) -> CommittedBlock:
+        t0 = time.perf_counter()
+        if self.pre_launch_fn is not None:
+            self.pre_launch_fn(block)
+        pend = self.validator.validate_launch(
+            block, pre=self.prefetch_fn(block)
+        )
+        flt, batch, history = self.validator.validate_finish(pend)
+        t1 = time.perf_counter()
+        res = CommittedBlock(
+            block=block, pend=pend, tx_filter=flt, batch=batch,
+            history=history, barrier=_is_barrier(pend, batch),
+            stage_s={"finish": t1 - t0},
+        )
+        self.commit_fn(res)
+        res.stage_s["commit_wait"] = time.perf_counter() - t1
+        self._blocks_ctr.add(1, channel=self.channel, mode="serial")
+        return res
+
+    def _finish_and_commit(self, pend, tail: bool = False):
+        """Sync the device for ``pend``, serialize behind the previous
+        ledger commit, then either commit inline (barrier) or hand the
+        commit to the committer thread and expose the batch as the
+        successor's overlay."""
+        t0 = time.perf_counter()
+        flt, batch, history = self.validator.validate_finish(pend)
+        t1 = time.perf_counter()
+        if self._commit_fut is not None:
+            self._commit_fut.result()  # ledger commits stay in order
+            self._commit_fut = None
+        t2 = time.perf_counter()
+        barrier = _is_barrier(pend, batch)
+        res = CommittedBlock(
+            block=pend.block, pend=pend, tx_filter=flt, batch=batch,
+            history=history, barrier=barrier,
+            stage_s={"launch": self._launch_s, "finish": t1 - t0,
+                     "commit_wait": t2 - t1},
+        )
+        self._launch_s = 0.0
+        self._stage_hist.observe(t1 - t0, channel=self.channel,
+                                 stage="finish")
+        self._stage_hist.observe(t2 - t1, channel=self.channel,
+                                 stage="commit_wait")
+        if barrier or tail:
+            # barrier: rotated validation inputs must be fully
+            # committed (and the overlay dropped) before any launch;
+            # tail: nothing left to overlap with
+            self.commit_fn(res)
+            self._overlay = self._extra = None
+            if barrier:
+                self._stale_prefetch = True
+        else:
+            self._commit_fut = self._committer.submit(self.commit_fn, res)
+            self._overlay, self._extra = batch, pend.txids
+        self._blocks_ctr.add(
+            1, channel=self.channel,
+            mode="barrier" if barrier else "pipelined",
+        )
+        self._launched = None
+        return res
+
+    def _launch_next(self, prev_stage_s: dict, t_sub: float) -> None:
+        block, fut = self._pre
+        self._pre = None
+        t0 = time.perf_counter()
+        pre = fut.result()  # host parse ran while the device synced
+        if self._stale_prefetch:
+            # this block was staged on the prefetch thread BEFORE its
+            # barrier predecessor committed, so its parse/policy plans
+            # saw pre-barrier state — and validate_launch's staleness
+            # backstop is an identity check that state-backed policy
+            # providers (lifecycle caches rotate IN PLACE) never trip.
+            # Redo the parse against post-barrier state; barriers are
+            # rare, the serial redo is the correctness price.
+            self._stale_prefetch = False
+            pre = self.prefetch_fn(block)
+        t1 = time.perf_counter()
+        if self.pre_launch_fn is not None:
+            # caller thread, AFTER any predecessor barrier flushed —
+            # the node verifies orderer block signatures here against
+            # the post-rotation bundle
+            self.pre_launch_fn(block)
+        self._launched = self.validator.validate_launch(
+            block, pre=pre, overlay=self._overlay, extra_txids=self._extra
+        )
+        t2 = time.perf_counter()
+        self._launch_s = t2 - t1
+        self._inflight_gauge.set(self.inflight, channel=self.channel)
+        self._stage_hist.observe(t1 - t0, channel=self.channel,
+                                 stage="prefetch_wait")
+        self._stage_hist.observe(t2 - t1, channel=self.channel,
+                                 stage="launch")
+        total = t2 - t_sub
+        if prev_stage_s and total > 0:
+            blocked = (t1 - t0) + prev_stage_s.get("commit_wait", 0.0)
+            self._overlap_hist.observe(
+                max(0.0, 1.0 - blocked / total), channel=self.channel
+            )

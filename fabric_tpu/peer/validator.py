@@ -34,7 +34,9 @@ from __future__ import annotations
 
 import hashlib
 import logging
+import time
 from dataclasses import dataclass, field
+from functools import cached_property
 
 import numpy as np
 
@@ -163,8 +165,10 @@ class PendingBlock:
     fb: object = None       # _FastBlock of a columnar parse, or None
     hd_bytes: bytes = None  # pre-serialized header+data (ledger commit)
 
-    @property
+    @cached_property
     def txids(self) -> set:
+        # hot-path consumers (dup checks, pipeline overlay handoff)
+        # hit this repeatedly — the txid set is immutable after parse
         return {ptx.txid for ptx in self.txs if ptx.txid}
 
 
@@ -235,6 +239,7 @@ class BlockValidator:
         block_store=None,
         plugins: dict[str, ValidationPlugin] | None = None,
         config_processor=None,
+        verify_chunk: int = 0,
     ):
         self.msp = msp_manager
         self.policies = policy_provider
@@ -243,17 +248,30 @@ class BlockValidator:
         self.plugins = {"default": DefaultValidation(), **(plugins or {})}
         self.config_processor = config_processor
         self._device_pipeline = None
+        # signature-batch microbatching: split each block's verify
+        # batch into chunks of this many signatures with
+        # double-buffered async dispatch (ops.p256v3), so chunk k's
+        # device compute overlaps chunk k+1's host staging.  0 = one
+        # monolithic launch (nodeconfig ``verify_chunk``).
+        self.verify_chunk = int(verify_chunk)
         # optional phase accumulator (seconds per phase, summed across
         # blocks) — the bench publishes it as the per-phase breakdown
         # artifact; None = no instrumentation overhead
         self.timings: dict | None = None
+        # the same stages feed production telemetry unconditionally,
+        # so a live peer's /metrics and BENCH_breakdown.json agree
+        from fabric_tpu.ops_metrics import global_registry
+
+        self._stage_hist = global_registry().histogram(
+            "validator_stage_seconds",
+            "per-block validator stage time (s), bench-breakdown stages",
+        )
 
     def _t(self, key: str, t0: float) -> float:
-        import time
-
         t1 = time.perf_counter()
         if self.timings is not None:
             self.timings[key] = self.timings.get(key, 0.0) + (t1 - t0)
+        self._stage_hist.observe(t1 - t0, stage=key)
         return t1
 
     def warmup(self, n_sigs: int = 16) -> None:
@@ -876,12 +894,10 @@ class BlockValidator:
         phase of the current one — the TPU-shaped analog of the
         reference's deliver prefetch + validator pool overlap
         (gossip/state/state.go:540, v20/validator.go:193)."""
-        import time
-
         t0 = time.perf_counter()
         txs, items, rwp, fb = self._parse(block)
         t0 = self._t("host_parse", t0)
-        fetch = p256.verify_launch(items)
+        fetch = p256.verify_launch(items, chunk=self.verify_chunk or None)
         t0 = self._t("sig_prepare_launch", t0)
         dpre = self._device_preprocess(txs, rwp, fb)
         t0 = self._t("device_pre", t0)
@@ -1510,8 +1526,6 @@ class BlockValidator:
         """Host-side device-path launch: range re-execution, structural
         arrays, committed-version fill (+ overlay), stage-2 dispatch.
         Returns the packed-output fetch."""
-        import time
-
         from fabric_tpu.peer.device_block import DeviceBlockPipeline
 
         t0 = time.perf_counter()
@@ -1601,8 +1615,6 @@ class BlockValidator:
         """Consume the stage-2 packed output: final codes, filter,
         update batch.  Returns None to fall back to the host path
         (consumption-unsafe policy rows)."""
-        import time
-
         block, txs = pending.block, pending.txs
         dpre = pending.dpre
         t0 = time.perf_counter()

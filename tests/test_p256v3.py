@@ -138,6 +138,33 @@ def test_verify_matches_oracle_randomized(keys, rng):
     assert any(want) and not all(want)
 
 
+def test_chunked_launch_matches_monolithic(keys, rng):
+    """Microbatched dispatch (verify_launch chunk=...) must reproduce
+    the monolithic accept set bit for bit, with item i at device index
+    i of the concatenated output — both for exact-multiple and ragged
+    tails, and for chunk ≥ batch (degrades to one launch)."""
+    items = []
+    for i in range(41):  # ragged vs chunk=16: 16 + 16 + 9-lane tail
+        k = keys[i % 3]
+        e = ec_ref.digest_int(rng.bytes(16))
+        r, s = k.sign_digest(e)
+        if i % 5 == 1:
+            s = ec_ref.N - s  # high-S reject lane
+        elif i % 5 == 3:
+            e = (e + 1) % (1 << 256)  # wrong digest
+        items.append((e, r, s, *k.public))
+    mono = v3.verify_launch(items)()
+    assert any(mono) and not all(mono)
+    for chunk in (16, 32, 64):
+        got = v3.verify_launch(items, chunk=chunk)()
+        assert got == mono, f"chunk={chunk}"
+    # exact multiple of the chunk (no padded tail)
+    assert v3.verify_launch(items[:32], chunk=16)() == mono[:32]
+    # chunk below MIN_BUCKET clamps instead of exploding into
+    # per-signature launches
+    assert v3.verify_launch(items, chunk=1)() == mono
+
+
 def test_batch_inv_and_windows(rng):
     ss = [int.from_bytes(rng.bytes(32), "big") % ec_ref.N or 1 for _ in range(33)]
     inv = v3._batch_inv_mod_n(ss)

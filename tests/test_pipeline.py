@@ -1,7 +1,10 @@
 """Pipelined commit path: validate_launch/validate_finish with the
 predecessor-overlay, in-flight dup-txid checks, and the committer-thread
-overlap — the depth-2 pipeline bench.py drives, pinned against the
-serial validate() verdicts."""
+overlap — the depth-2 pipeline the production CommitPipeline
+(peer/pipeline.py) drives for both the node's deliver loop and
+bench.py, pinned against the serial validate() verdicts.  The
+crypto-free pipeline-engine semantics live in
+tests/test_commit_pipeline.py."""
 
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -15,6 +18,7 @@ from fabric_tpu.crypto.msp import MSPManager
 from fabric_tpu.ledger.rwset import TxRWSet
 from fabric_tpu.ledger.statedb import MemVersionedDB, UpdateBatch
 from fabric_tpu.peer import txassembly as txa
+from fabric_tpu.peer.pipeline import CommitPipeline
 from fabric_tpu.peer.validator import BlockValidator, NamespaceInfo, PolicyProvider
 from fabric_tpu.protos import common_pb2, transaction_pb2
 
@@ -213,3 +217,134 @@ def test_pipelined_stream_matches_serial(net):
 
     assert [list(f) for f in filters] == [list(f) for f in serial_filters]
     assert dict(state_p._data) == dict(state_s._data)
+
+
+def _tx_ns(net, ns_writes: dict):
+    """A tx writing into explicit namespaces (e.g. _lifecycle)."""
+    _, _, prop = txa.create_signed_proposal(net["client"], CHANNEL, CC, [b"i"])
+    tx = TxRWSet()
+    for ns_name, writes in ns_writes.items():
+        ns = tx.ns_rwset(ns_name)
+        for k, v in writes:
+            ns.writes[k] = v
+    rw = tx.to_proto().SerializeToString()
+    resps = [txa.create_proposal_response(prop, rw, e, CC) for e in net["peers"]]
+    return txa.assemble_transaction(prop, resps, net["client"])
+
+
+def _drive_pipeline(net, blocks, prov=None, depth=2, commit_sleep=0.01):
+    """Run ``blocks`` through the production CommitPipeline with a
+    delayed committer (widening the race window the overlay must
+    cover).  → (filters, final state dict, launch log, commit log)."""
+    state = _state(net)
+    v = BlockValidator(net["mgr"], prov or net["prov"], state)
+    committed: list = []
+    launches: list = []
+
+    orig_launch = v.validate_launch
+
+    def launch(b, pre=None, overlay=None, extra_txids=None):
+        launches.append((
+            b.header.number, overlay is not None, list(committed),
+        ))
+        return orig_launch(b, pre=pre, overlay=overlay,
+                           extra_txids=extra_txids)
+
+    v.validate_launch = launch
+
+    def commit_fn(res):
+        time.sleep(commit_sleep)  # hold the commit in flight
+        state.apply_updates(res.batch, (res.block.header.number, 0))
+        committed.append(res.block.header.number)
+
+    filters = []
+    with CommitPipeline(v, commit_fn, depth=depth) as pipe:
+        for b in blocks:
+            r = pipe.submit(b)
+            if r is not None:
+                filters.append((r.block.header.number, list(r.tx_filter)))
+        r = pipe.flush()
+        if r is not None:
+            filters.append((r.block.header.number, list(r.tx_filter)))
+    filters.sort()
+    return filters, dict(state._data), launches, committed
+
+
+def test_commit_pipeline_matches_serial(net):
+    """The production CommitPipeline over a dependent stream (block
+    n+1 reading a key block n wrote, range queries riding along) must
+    produce the serial validate()+commit filters and state."""
+    def build_blocks():
+        blocks, prev = [], b"genesis"
+        for n in range(2, 8):
+            envs = [
+                _tx(net, reads=[(f"k{n-1}", (n - 1, 0))] if n > 2 else (),
+                    writes=[(f"k{n}", b"v")]),
+                _tx(net, writes=[(f"m{n}", b"v")],
+                    ranges=[(f"k{n-1}", f"k{n-1}~", [])] if n % 2 == 0 else ()),
+            ]
+            blk = _block(n, prev, envs, pad_net=net)
+            prev = pu.block_header_hash(blk.header)
+            blocks.append(blk)
+        return blocks
+
+    blocks = build_blocks()
+
+    # serial reference
+    state_s = _state(net)
+    v_s = BlockValidator(net["mgr"], net["prov"], state_s)
+    serial = []
+    for n, b in enumerate(blocks, start=2):
+        flt, batch, _ = v_s.validate(b)
+        state_s.apply_updates(batch, (n, 0))
+        serial.append((n, list(flt)))
+
+    filters, state_p, launches, _ = _drive_pipeline(net, blocks)
+    assert filters == serial
+    assert state_p == dict(state_s._data)
+    # depth-2 actually overlapped: every non-first launch carried the
+    # predecessor's batch as overlay
+    assert [ov for _, ov, _ in launches] == [False] + [True] * 5
+
+    # serial mode through the same engine: identical verdicts, no
+    # overlays anywhere
+    filters1, state1, launches1, _ = _drive_pipeline(net, blocks, depth=1)
+    assert filters1 == serial and state1 == state_p
+    assert all(not ov for _, ov, _ in launches1)
+
+
+def test_commit_pipeline_lifecycle_barrier(net):
+    """A block writing ``_lifecycle`` must commit FULLY before its
+    successor launches, and the successor launches with the overlay
+    dropped — then pipelining resumes."""
+    prov = PolicyProvider({
+        CC: net["prov"].infos[CC],
+        "_lifecycle": net["prov"].infos[CC],
+    })
+    blocks, prev = [], b"genesis"
+    envs_by_n = {
+        2: [_tx(net, writes=[("a2", b"v")])],
+        3: [_tx_ns(net, {
+            "_lifecycle": [("namespaces/fields/cc1/Definition", b"d")],
+            CC: [("a3", b"v")],
+        })],
+        4: [_tx(net, reads=[("a3", (3, 0))], writes=[("a4", b"v")])],
+        5: [_tx(net, writes=[("a5", b"v")])],
+    }
+    for n in range(2, 6):
+        blk = _block(n, prev, envs_by_n[n], pad_net=net)
+        prev = pu.block_header_hash(blk.header)
+        blocks.append(blk)
+
+    filters, state_p, launches, committed = _drive_pipeline(
+        net, blocks, prov=prov
+    )
+    assert all(c == 0 for _, flt in filters for c in flt)
+    info = {n: (ov, done) for n, ov, done in launches}
+    # successor of the barrier: overlay dropped AND block 3 fully
+    # committed before launch
+    assert info[4][0] is False
+    assert 3 in info[4][1]
+    # pipelining resumed after the barrier
+    assert info[5][0] is True
+    assert committed == [2, 3, 4, 5]

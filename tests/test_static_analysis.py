@@ -247,6 +247,227 @@ class TestHostSync:
         }
         assert run_rule(tmp_path, HostSyncRule(), files) == []
 
+    def test_import_aware_module_attr_resolution(self, tmp_path):
+        """``p256.verify_host()`` links only to the imported module's
+        def — the same-named def in an unimported module stays cold."""
+        files = {
+            "peer/validator.py": """\
+            from ops import p256
+
+
+            def validate(block):
+                return p256.verify_host(block)
+            """,
+            "ops/p256.py": """\
+            import jax
+
+
+            def verify_host(x):
+                return jax.device_get(x)
+            """,
+            "ops/p256_other.py": """\
+            import jax
+
+
+            def verify_host(x):
+                return jax.device_get(x)  # cold: never imported
+            """,
+        }
+        got = run_rule(tmp_path, HostSyncRule(), files)
+        assert [(f.path, f.line) for f in got] == [("ops/p256.py", 5)]
+
+    def test_import_aware_from_import_and_rename(self, tmp_path):
+        """``from mod import foo as bar`` resolves ``bar()`` to mod's
+        ``foo`` only; a same-named def elsewhere stays cold.  Imports
+        inside function bodies count (the hot path imports lazily)."""
+        files = {
+            "peer/validator.py": """\
+            def validate(block):
+                from kernels import sync_fetch as fetch_fn
+
+                return fetch_fn(block)
+            """,
+            "kernels.py": """\
+            import jax
+
+
+            def sync_fetch(x):
+                return jax.device_get(x)
+            """,
+            "cold.py": """\
+            import jax
+
+
+            def fetch_fn(x):
+                return jax.device_get(x)  # bare name matches; module not imported
+            """,
+        }
+        got = run_rule(tmp_path, HostSyncRule(), files)
+        assert [(f.path, f.line) for f in got] == [("kernels.py", 5)]
+
+    def test_external_import_produces_no_edges(self, tmp_path):
+        """A name imported from a clearly-external package (no analyzed
+        module shares its root) cannot reach analyzed defs — the
+        over-approximation that linked every same-named def is gone."""
+        files = {
+            "peer/validator.py": """\
+            from concurrent.futures import wait
+
+
+            def validate(futs):
+                return wait(futs)
+            """,
+            "threadutil.py": """\
+            import jax
+
+
+            def wait(x):
+                return jax.device_get(x)  # same bare name, never imported
+            """,
+        }
+        assert run_rule(tmp_path, HostSyncRule(), files) == []
+
+    def test_unresolved_project_import_falls_back(self, tmp_path):
+        """A project-looking import that does not resolve (e.g. a
+        native/generated module outside the analyzed set) must fall
+        back to bare-name linking — never under-approximate."""
+        files = {
+            "peer/validator.py": """\
+            from peer.native_ext import helper
+
+
+            def validate(block):
+                return helper(block)
+            """,
+            "somewhere.py": """\
+            import jax
+
+
+            def helper(x):
+                return jax.device_get(x)
+            """,
+        }
+        got = run_rule(tmp_path, HostSyncRule(), files)
+        assert [(f.path, f.line) for f in got] == [("somewhere.py", 5)]
+
+    def test_reexported_name_falls_back_to_bare(self, tmp_path):
+        """``from pkg import helper`` where pkg/__init__.py re-exports
+        ``helper`` from an implementation module: the package has no
+        def of that name, so resolution must degrade to bare-name and
+        still reach the real callee — re-exports must not blind the
+        graph."""
+        files = {
+            "peer/validator.py": """\
+            from pkg import helper
+
+
+            def validate(block):
+                return helper(block)
+            """,
+            "pkg/__init__.py": """\
+            from pkg.impl import helper
+            """,
+            "pkg/impl.py": """\
+            import jax
+
+
+            def helper(x):
+                return jax.device_get(x)
+            """,
+        }
+        got = run_rule(tmp_path, HostSyncRule(), files)
+        assert [(f.path, f.line) for f in got] == [("pkg/impl.py", 5)]
+
+    def test_submodule_attr_precision_survives_package_init(self, tmp_path):
+        """``from pkg import sub`` where pkg HAS an __init__.py: the
+        attr call ``sub.f()`` must still resolve only to the
+        submodule's def — the object-in-package hedge must not degrade
+        the resolution to bare-name (the ROADMAP case verbatim)."""
+        files = {
+            "peer/validator.py": """\
+            from pkg import sub
+
+
+            def validate(block):
+                return sub.f(block)
+            """,
+            "pkg/__init__.py": "",
+            "pkg/sub.py": """\
+            import jax
+
+
+            def f(x):
+                return jax.device_get(x)
+            """,
+            "pkg/other.py": """\
+            import jax
+
+
+            def f(x):
+                return jax.device_get(x)  # cold: never imported
+            """,
+        }
+        got = run_rule(tmp_path, HostSyncRule(), files)
+        assert [(f.path, f.line) for f in got] == [("pkg/sub.py", 5)]
+
+    def test_package_root_absolute_import_falls_back(self, tmp_path):
+        """Analyzing the PACKAGE directory itself (dotted forms like
+        "ops.p256"): an absolute ``from fabric_tpu.gen import helper``
+        whose module is outside the analyzed set must still fall back
+        to bare-name linking — the root's own directory name counts as
+        a project root, so the import is not misread as external."""
+        import textwrap
+
+        pkg = tmp_path / "fabric_tpu"
+        files = {
+            "peer/validator.py": """\
+            from fabric_tpu.gen import helper
+
+
+            def validate(block):
+                return helper(block)
+            """,
+            "somewhere.py": """\
+            import jax
+
+
+            def helper(x):
+                return jax.device_get(x)
+            """,
+        }
+        for rel, src in files.items():
+            path = pkg / rel
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(textwrap.dedent(src))
+        res = analyze_paths(
+            [str(pkg)], root=str(pkg), rules=[HostSyncRule()],
+            baseline=None,
+        )
+        assert [(f.path, f.line) for f in res.findings] == [
+            ("somewhere.py", 5),
+        ]
+
+    def test_local_def_shadows_external_import(self, tmp_path):
+        """A module-local def with the same name as an external import
+        stays linked (the shadowing guard)."""
+        files = {
+            "peer/validator.py": """\
+            from time import monotonic
+
+
+            def monotonic(x):  # local shadow wins at runtime
+                return x.block_until_ready()
+
+
+            def validate(block):
+                return monotonic(block)
+            """,
+        }
+        got = run_rule(tmp_path, HostSyncRule(), files)
+        assert [(f.path, f.line) for f in got] == [
+            ("peer/validator.py", 5),
+        ]
+
 
 # -- FT004 lock-discipline --------------------------------------------------
 
