@@ -205,8 +205,6 @@ def _build_commit_network(n_tx: int, n_blocks: int = 1,
         return db
 
     def fresh_validator(state):
-        import os
-
         # microbatched device verify (ops/p256v3.py): set e.g. 1024
         # for ~3 chunks per 1000-tx block so chunk k's device compute
         # overlaps chunk k+1's host staging.  Default 0 (monolithic):
@@ -214,10 +212,28 @@ def _build_commit_network(n_tx: int, n_blocks: int = 1,
         # staging, so chunking only adds dispatch overhead (measured
         # +23% on the 2-core container — see CHANGES.md PR 2); enable
         # on real-TPU rounds where the overlap is real.
-        chunk = int(os.environ.get("FABTPU_BENCH_VERIFY_CHUNK", "0"))
-        return BlockValidator(mgr, prov, state, verify_chunk=chunk)
+        k = _bench_knobs()
+        return BlockValidator(
+            mgr, prov, state, verify_chunk=k["verify_chunk"],
+            mesh_devices=k["mesh_devices"],
+        )
 
     return blocks, fresh_state, fresh_validator, mgr, prov, CC, n_invalid_per_block
+
+
+def _bench_knobs() -> dict:
+    """Commit-path knobs under bench, from env — all default OFF so the
+    CPU-only container measures the unsharded monolithic path (like
+    verify_chunk, mesh sharding and launch coalescing only win on a
+    real accelerator; a 1-device mesh resolves to None and a
+    coalesce < 2 never groups)."""
+    import os
+
+    return {
+        "verify_chunk": int(os.environ.get("FABTPU_BENCH_VERIFY_CHUNK", "0")),
+        "mesh_devices": int(os.environ.get("FABTPU_BENCH_MESH", "0")),
+        "coalesce_blocks": int(os.environ.get("FABTPU_BENCH_COALESCE", "0")),
+    }
 
 
 def _serial_baseline_validate(blk, mgr, prov, state):
@@ -442,8 +458,109 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5,
     }
 
 
+def _bench_block_commit_sustained(n_tx: int = 1000, n_blocks: int = 50):
+    """Sustained commit-path run (VERDICT Missing #1): ≥ 50 blocks
+    streamed through the depth-2 CommitPipeline, reporting p50/p99
+    BLOCK-COMMIT LATENCY (submit → ledger commit complete, per block)
+    alongside tx/s.  The long stream keeps the blockstore's
+    group-commit fsync windows (default: every 8 blocks) INSIDE the
+    measurement — a 5-block sprint amortizes durability away.
+
+    Knobs ride env (reported in the JSON): FABTPU_BENCH_VERIFY_CHUNK,
+    FABTPU_BENCH_MESH (mesh_devices), FABTPU_BENCH_COALESCE
+    (CommitPipeline.submit_many group size)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from fabric_tpu.ledger.kvledger import KVLedger
+    from fabric_tpu.peer.pipeline import CommitPipeline
+    from fabric_tpu.protos import common_pb2
+
+    knobs = _bench_knobs()
+    (blocks, fresh_state, fresh_validator, mgr, prov, _,
+     n_invalid) = _build_commit_network(n_tx, n_blocks)
+    expected_valid = (n_tx - n_invalid) * n_blocks
+
+    state = fresh_state()
+    v = fresh_validator(state)
+    stream = []
+    for blk in blocks:
+        b = common_pb2.Block()
+        b.CopyFrom(blk)
+        stream.append(b)
+    tmp = tempfile.mkdtemp(prefix="benchsustained")
+    lg = KVLedger(tmp, state_db=state, enable_history=True)
+    n_valid = 0
+    submit_t: dict[int, float] = {}
+    commit_t: dict[int, float] = {}
+
+    def commit_fn(res):
+        lg.commit_block(res.block, res.tx_filter, res.batch,
+                        res.history, None, res.txids, res.pend.hd_bytes)
+        commit_t[res.block.header.number] = time.perf_counter()
+
+    coalesce = knobs["coalesce_blocks"]
+    t0 = time.perf_counter()
+    with CommitPipeline(v, commit_fn, depth=2,
+                        coalesce_blocks=coalesce) as pipe:
+        if coalesce >= 2:
+            for lo in range(0, len(stream), coalesce):
+                group = stream[lo:lo + coalesce]
+                now = time.perf_counter()
+                for b in group:
+                    submit_t[b.header.number] = now
+                for res in pipe.submit_many(group):
+                    n_valid += res.n_valid
+        else:
+            for b in stream:
+                submit_t[b.header.number] = time.perf_counter()
+                res = pipe.submit(b)
+                if res is not None:
+                    n_valid += res.n_valid
+        res = pipe.flush()
+        if res is not None:
+            n_valid += res.n_valid
+        dt = time.perf_counter() - t0
+    group_commit = lg.blocks.group_commit
+    lg.close()
+    shutil.rmtree(tmp, ignore_errors=True)
+    assert n_valid == expected_valid, (n_valid, expected_valid)
+
+    # per-block commit latency; the first 3 blocks eat the compiles
+    # and cache warms — excluded from the percentiles, stated as such
+    lats = sorted(
+        commit_t[n] - submit_t[n]
+        for n in commit_t if n in submit_t and n >= 3
+    )
+    arr = np.asarray(lats)
+    total = n_tx * n_blocks
+    rate = total / dt
+    return {
+        "metric": f"sustained_tx_per_sec_block{n_tx}x{n_blocks}",
+        "value": round(rate, 1),
+        "unit": "tx/s",
+        "vs_baseline": 1.0,  # self-contained: no serial re-run at 50 blocks
+        "extras": {
+            "latency_ms": {
+                "p50": round(float(np.percentile(arr, 50)) * 1000, 2),
+                "p99": round(float(np.percentile(arr, 99)) * 1000, 2),
+                "max": round(float(arr.max()) * 1000, 2),
+                "n_measured": int(len(arr)),
+                "warmup_blocks_excluded": 3,
+            },
+            "knobs": knobs,
+            "group_commit": group_commit,
+        },
+    }
+
+
 _BENCHES = {
     "block_commit": _bench_block_commit,
+    # VERDICT Missing #1: sustained ≥50-block stream with p50/p99
+    # block-commit latency (group-commit fsync windows included)
+    "block_commit_sustained": _bench_block_commit_sustained,
     # adversarial-traffic variant: ~10% invalid lanes (bad creator
     # sigs + stale reads) — the throughput number must survive
     # failure-bearing blocks, not just happy-path streams
@@ -472,7 +589,8 @@ def main():
         pass
 
     name = sys.argv[1] if len(sys.argv) > 1 else "block_commit"
-    if name in ("block_commit", "block_commit_mixed", "p256_verify"):
+    if name in ("block_commit", "block_commit_mixed",
+                "block_commit_sustained", "p256_verify"):
         # these benches need the `cryptography` package for the
         # OpenSSL CPU baseline and the cert-based test network — on
         # containers without it, report a skip instead of crashing at
@@ -492,7 +610,7 @@ def main():
         # carries the per-phase breakdown AND the adversarial-traffic
         # (10% invalid) variant in the same JSON line
         breakdown = result.pop("per_block_ms", None)
-        extras = {"per_block_ms": breakdown}
+        extras = {"per_block_ms": breakdown, "knobs": _bench_knobs()}
         try:
             mixed = _bench_block_commit(invalid_frac=0.1)
             extras["mixed_10pct_invalid"] = {

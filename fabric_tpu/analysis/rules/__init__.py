@@ -9,11 +9,13 @@ them); slugs are the human-facing names:
     FT004 lock-discipline        lock-order cycles + blocking under lock
     FT005 swallowed-exception    broad except that drops the error
     FT006 union-env-coercion     env strings coercing non-scalar unions
+    FT007 kernel-dtype-mismatch  int64 host arrays into int32 kernel lanes
 """
 
 from fabric_tpu.analysis.rules import (  # noqa: F401
     host_sync,
     jit_purity,
+    kernel_dtype,
     lock_discipline,
     retrace_hazard,
     swallowed_exception,

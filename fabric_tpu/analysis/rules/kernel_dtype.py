@@ -1,0 +1,280 @@
+"""FT007 kernel-dtype-mismatch: 64-bit host arrays crossing into
+32-bit kernel lanes.
+
+The device kernels under ``ops/`` take int32 lane arrays (key ids,
+window digits, packed launch vectors): XLA truncates or type-errors
+far from the call site when a caller hands them a default-dtype numpy
+array (``np.arange`` / ``np.bincount`` / ``np.full(..., np.int64)``
+are int64 on every 64-bit platform).  The ROADMAP names this the next
+rule worth having: "ops/ callers passing int64 into int32 lanes".
+
+Mechanics (project rule, two passes):
+
+1. **Lane declarations** — functions in ``ops/`` modules declare their
+   lane dtypes with the repo's existing convention: a trailing comment
+   on the parameter's own line (``read_keys,  # [T, R] int32``) or a
+   docstring line starting with the parameter name that names a dtype
+   (``w1, w2: [B, 64] int32 ...``).  Parameters declaring ``int32`` /
+   ``i32`` / ``uint32`` become checked lanes.
+2. **Call sites** — every analyzed module is scanned for calls that
+   RESOLVE to a declared kernel through its imports (the FT003
+   discipline, scaled down): a bare name bound by a ``from``-import of
+   an ops module, or an ``alias.func`` attribute call whose alias was
+   imported from/under ``ops`` — a local helper that merely shares a
+   kernel's name never matches.  Arguments whose dtype is STATICALLY
+   known 64-bit — ``np.zeros/ones/empty/full/array/asarray`` with an
+   explicit ``int64``/``float64`` dtype, ``.astype(np.int64)``, or
+   dtype-less ``np.arange`` (platform int64) — directly or through a
+   single local assignment, are flagged when they land in a 32-bit
+   lane.
+
+Unknown dtypes are never flagged (the rule under-approximates), so the
+battery stays quiet on slices, gathers, and anything the AST cannot
+type.  Scanning is per-SCOPE (nested defs are walked as their own
+functions, not re-visited from the enclosing one), so a call inside a
+staging closure yields exactly one finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+
+from fabric_tpu.analysis.core import (
+    Finding,
+    ModuleCtx,
+    Rule,
+    call_name,
+    register,
+    walk_functions,
+)
+
+_LANE32_RE = re.compile(r"\b(?:u?int32|[iu]32)\b")
+_DTYPE64 = {"int64", "float64", "longlong", "double"}
+_DTYPE_OK = {
+    "int32", "i32", "uint32", "u32", "int16", "int8", "uint8", "uint16",
+    "bool", "bool_", "float32", "bfloat16",
+}
+_CTOR_WITH_DTYPE = {
+    # basename → positional index of the dtype argument
+    "zeros": 1, "ones": 1, "empty": 1, "full": 2, "array": 1,
+    "asarray": 1, "arange": 3, "fromiter": 1,
+}
+
+
+def _dtype_name(node: ast.AST) -> str | None:
+    """``np.int64`` / ``jnp.int64`` / ``'int64'`` → 'int64'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _expr_dtype(node: ast.AST) -> str | None:
+    """Statically-known numpy dtype of an expression, or None."""
+    if isinstance(node, ast.Subscript):
+        # slicing/gathers preserve dtype: np.arange(n)[:, None]
+        return _expr_dtype(node.value)
+    if isinstance(node, ast.Call):
+        name = call_name(node) or ""
+        base = name.split(".")[-1]
+        if base == "astype" or (
+            isinstance(node.func, ast.Attribute) and node.func.attr == "astype"
+        ):
+            if node.args:
+                return _dtype_name(node.args[0])
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    return _dtype_name(kw.value)
+            return None
+        if base in _CTOR_WITH_DTYPE:
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    return _dtype_name(kw.value)
+            pos = _CTOR_WITH_DTYPE[base]
+            if len(node.args) > pos:
+                got = _dtype_name(node.args[pos])
+                if got is not None:
+                    return got
+            if base in ("arange",):
+                # dtype-less arange over ints is platform int64 — the
+                # exact hazard this rule exists for
+                return "int64"
+            return None
+    return None
+
+
+class _LaneDecl:
+    __slots__ = ("params", "order")
+
+    def __init__(self):
+        self.params: dict[str, str] = {}  # name → declared dtype text
+        self.order: list[str] = []
+
+
+def _walk_own(fn: ast.AST):
+    """Walk a function's OWN body: yields nodes without descending into
+    nested function/class scopes (those are visited as their own
+    functions by walk_functions — descending here would double-count
+    their calls and mix scopes' dtype environments)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_ops_module(dotted: str | None) -> bool:
+    """'fabric_tpu.ops.mvcc' / 'ops.p256v3' / '..ops' → True."""
+    return dotted is not None and "ops" in dotted.split(".")
+
+
+def _ops_bindings(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """(module_aliases, bare_names) bound from ops modules anywhere in
+    the module (imports are commonly function-local in this tree)."""
+    aliases: set[str] = set()
+    bare: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if _is_ops_module(a.name):
+                    aliases.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                # from fabric_tpu.ops import mvcc as mvcc_ops →
+                # alias; from fabric_tpu.ops.mvcc import f → bare name
+                if _is_ops_module(f"{mod}.{a.name}"):
+                    aliases.add(a.asname or a.name)
+                if _is_ops_module(mod):
+                    bare.add(a.asname or a.name)
+    return aliases, bare
+
+
+def _comment_map(source: str) -> dict[int, str]:
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _collect_kernels(ctx: ModuleCtx) -> dict[str, _LaneDecl]:
+    """Lane declarations for one ops/ module's top-level functions."""
+    comments = _comment_map(ctx.source)
+    out: dict[str, _LaneDecl] = {}
+    for node in ctx.tree.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        doc = ast.get_docstring(node) or ""
+        doc_lines = [ln.strip() for ln in doc.splitlines()]
+        decl = _LaneDecl()
+        args = node.args.posonlyargs + node.args.args
+        for a in args:
+            decl.order.append(a.arg)
+            txt = comments.get(a.lineno, "")
+            if _LANE32_RE.search(txt):
+                decl.params[a.arg] = "int32"
+                continue
+            for ln in doc_lines:
+                if ln.startswith(a.arg) and _LANE32_RE.search(ln):
+                    decl.params[a.arg] = "int32"
+                    break
+        if decl.params:
+            out[node.name] = decl
+    return out
+
+
+@register
+class KernelDtypeMismatchRule(Rule):
+    id = "FT007"
+    name = "kernel-dtype-mismatch"
+    severity = "error"
+    description = (
+        "flags statically-known int64/float64 arrays passed into "
+        "int32-declared lanes of ops/ kernel functions"
+    )
+
+    def check_project(self, modules: list[ModuleCtx]) -> list[Finding]:
+        kernels: dict[str, _LaneDecl] = {}
+        for ctx in modules:
+            parts = ctx.relpath.split("/")
+            if "ops" in parts[:-1]:
+                kernels.update(_collect_kernels(ctx))
+        if not kernels:
+            return []
+
+        out: list[Finding] = []
+        for ctx in modules:
+            aliases, bare = _ops_bindings(ctx.tree)
+            if not (aliases or bare):
+                continue  # module never imports from ops
+            for fn in walk_functions(ctx.tree):
+                env: dict[str, str] = {}  # local var → known dtype
+                for node in _walk_own(fn):
+                    if isinstance(node, ast.Assign) and len(
+                            node.targets) == 1 and isinstance(
+                            node.targets[0], ast.Name):
+                        dt = _expr_dtype(node.value)
+                        name = node.targets[0].id
+                        if dt is not None:
+                            env[name] = dt
+                        else:
+                            env.pop(name, None)  # reassigned: unknown
+                for node in _walk_own(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    cname = call_name(node) or ""
+                    parts = cname.split(".")
+                    base = parts[-1]
+                    decl = kernels.get(base)
+                    if decl is None:
+                        continue
+                    # import-aware gate: a bare call must be an
+                    # ops from-import; a dotted call's root must be
+                    # an ops-module alias (a same-named local helper
+                    # never matches — the FT003 lesson)
+                    if len(parts) == 1:
+                        if base not in bare:
+                            continue
+                    elif parts[0] not in aliases:
+                        continue
+                    bound: list[tuple[str, ast.AST]] = []
+                    for i, arg in enumerate(node.args):
+                        if isinstance(arg, ast.Starred):
+                            break  # positions unknowable past a star
+                        if i < len(decl.order):
+                            bound.append((decl.order[i], arg))
+                    for kw in node.keywords:
+                        if kw.arg is not None:
+                            bound.append((kw.arg, kw.value))
+                    for pname, arg in bound:
+                        if pname not in decl.params:
+                            continue
+                        dt = _expr_dtype(arg)
+                        if dt is None and isinstance(arg, ast.Name):
+                            dt = env.get(arg.id)
+                        if dt in _DTYPE64:
+                            out.append(self.finding(
+                                ctx, arg.lineno, arg.col_offset,
+                                f"argument '{pname}' of kernel "
+                                f"'{base}' is declared int32 but the "
+                                f"caller passes a known {dt} array — "
+                                f"cast with .astype(np.int32) at the "
+                                f"boundary (np.arange/bincount default "
+                                f"to int64 on 64-bit hosts)",
+                            ))
+        return out

@@ -141,6 +141,8 @@ async def _run_peer(cfg):
         install_require_admin=cfg.install_require_admin,
         pipeline_depth=cfg.pipeline_depth,
         verify_chunk=cfg.verify_chunk,
+        mesh_devices=cfg.mesh_devices,
+        coalesce_blocks=cfg.coalesce_blocks,
     )
     await node.start(operations_port=cfg.operations_port)
     print(f"peer {node.id} serving on :{node.port}", flush=True)

@@ -106,6 +106,17 @@ class PeerConfig:
     # double-buffered dispatch (ops/p256v3.py); 0 = one monolithic
     # launch per block
     verify_chunk: int = 0
+    # device-mesh sharding of the production dispatch (parallel/mesh):
+    # verify batches and the fused stage-2 lanes shard axis 0 over the
+    # first N local devices; -1 = all local devices (the multi-chip
+    # default: sharding engages whenever n_devices > 1), 0 = off.
+    # A 1-device resolution is a no-op, so CPU-only hosts pay nothing.
+    mesh_devices: int = 0
+    # multi-block launch coalescing (CommitPipeline.submit_many): when
+    # the deliver backlog holds ≥ 2 blocks, concatenate up to N blocks'
+    # signature batches into one padded verify dispatch.  0/1 = off.
+    # Like verify_chunk, wins need a real accelerator.
+    coalesce_blocks: int = 0
     # chaincode install surface (peer/node.py _on_install)
     max_package_size: int = DEFAULT_MAX_PACKAGE_SIZE
     install_require_admin: bool = False

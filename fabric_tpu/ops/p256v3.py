@@ -129,8 +129,12 @@ def pt_add_mixed(p1, x2, y2, b_rv, ctx):
 
 
 def pt_double(p, b_rv, ctx):
-    """RCB16 algorithm 6 (a = −3) restaged: 6 + 2 + 2 + 3 muls in 4
-    stacked dispatches."""
+    """RCB16 algorithm 6 (a = −3) restaged: 6 + 2 + 5 muls in 3
+    stacked dispatches.  The (x3a·y3c, x3a·t3) pair depends only on
+    bt2 and the (·z3b, ·t1) triple only on bz, so the former stages 3
+    and 4 are mutually independent and fuse into ONE stacked dispatch —
+    with 4 doublings per ladder step this cuts 4 sequential Montgomery
+    rounds (and their 2 matmuls each) from every step's critical path."""
     X, Y, Z = p
     sub = lambda a, b: rns.rv_sub(a, b, ctx)
     t0, t1, t2, xy, xz, yz = rns.mont_mul_many(
@@ -143,14 +147,13 @@ def pt_double(p, b_rv, ctx):
     y3b = y3a + y3a + y3a
     x3a = sub(t1, y3b)
     y3c = t1 + y3b
-    y3m, x3m = rns.mont_mul_many([(x3a, y3c), (x3a, t3)], ctx)
     t2b = t2 + t2 + t2
     z3a = sub(sub(bz, t2b), t0)
     z3b = z3a + z3a + z3a
     t0c = sub(t0 + t0 + t0, t2b)
     yz2 = yz + yz
-    a1, a2, a3 = rns.mont_mul_many(
-        [(t0c, z3b), (yz2, z3b), (yz2, t1)], ctx
+    y3m, x3m, a1, a2, a3 = rns.mont_mul_many(
+        [(x3a, y3c), (x3a, t3), (t0c, z3b), (yz2, z3b), (yz2, t1)], ctx
     )
     Z3 = a3 + a3
     return (sub(x3m, a2), y3m + a1, Z3 + Z3)
@@ -696,6 +699,26 @@ def _chunk_metrics():
     )
 
 
+def _coalesce_metric():
+    from fabric_tpu.ops_metrics import global_registry
+
+    return global_registry().histogram(
+        "coalesced_blocks_per_launch",
+        "signature batches (blocks) concatenated per verify dispatch",
+        buckets=(1, 2, 3, 4, 6, 8, float("inf")),
+    )
+
+
+def _shard(mesh, arr):
+    """Axis-0 shard one dispatch input over the data mesh (no-op when
+    mesh is None or the shape is ragged vs the mesh)."""
+    if mesh is None:
+        return arr
+    from fabric_tpu.parallel.mesh import shard_batch
+
+    return shard_batch(mesh, arr)
+
+
 def _launch_chunked(n_real: int, chunk: int, stage_fn) -> VerifyHandle:
     """Microbatched double-buffered dispatch: ``stage_fn(lo, hi, pad)``
     stages [lo:hi) on the host (admission checks, batch inversion,
@@ -739,7 +762,7 @@ def _launch_chunked(n_real: int, chunk: int, stage_fn) -> VerifyHandle:
     return VerifyHandle(dev, n_real)
 
 
-def verify_launch(items, chunk: int | None = None) -> VerifyHandle:
+def verify_launch(items, chunk: int | None = None, mesh=None) -> VerifyHandle:
     """Asynchronously dispatch a verify batch; returns a VerifyHandle
     (callable as a zero-arg fetch for list[bool]).  The jax dispatch is
     non-blocking, so the device crunches while the caller's host thread
@@ -752,7 +775,13 @@ def verify_launch(items, chunk: int | None = None) -> VerifyHandle:
     chunks dispatched back to back (double-buffered: chunk k+1's host
     staging overlaps chunk k's device compute).  None/0 = one
     monolithic launch.  The accept set is identical either way
-    (tests/test_p256v3.py pins chunked ≡ monolithic)."""
+    (tests/test_p256v3.py pins chunked ≡ monolithic).
+
+    ``mesh``: a parallel.mesh data mesh — the packed batch is device_put
+    with axis 0 sharded over it, so XLA partitions the whole ladder
+    across the chips (the verify is per-lane independent: bit-equal to
+    single-device, pinned by tests/test_multidevice.py).  None =
+    default single-device placement."""
     chunk = max(int(chunk), MIN_BUCKET) if chunk else 0
     if isinstance(items, (ColumnarSigBatch, SigCollector)):
         if not items.n:
@@ -763,11 +792,13 @@ def verify_launch(items, chunk: int | None = None) -> VerifyHandle:
         if chunk and n_real > chunk:
             def stage(lo, hi, pad):
                 args = prepare_cols(*(c[lo:hi] for c in cols), pad_to=pad)
-                return verify_batch_packed_jit(pack_cols(*args))
+                return verify_batch_packed_jit(
+                    _shard(mesh, pack_cols(*args))
+                )
 
             return _launch_chunked(n_real, chunk, stage)
         args = prepare_cols(*cols, pad_to=_bucket(n_real))
-        out = verify_batch_packed_jit(pack_cols(*args))
+        out = verify_batch_packed_jit(_shard(mesh, pack_cols(*args)))
         if hasattr(out, "copy_to_host_async"):
             out.copy_to_host_async()
         return VerifyHandle(out, n_real)
@@ -777,10 +808,14 @@ def verify_launch(items, chunk: int | None = None) -> VerifyHandle:
     n_real = len(items)
     if chunk and n_real > chunk:
         def stage(lo, hi, pad):
-            return verify_batch_jit(*prepare(items[lo:hi], pad_to=pad))
+            return verify_batch_jit(
+                *(_shard(mesh, a) for a in prepare(items[lo:hi], pad_to=pad))
+            )
 
         return _launch_chunked(n_real, chunk, stage)
     args = prepare(items, pad_to=_bucket(n_real))
+    if mesh is not None:
+        args = tuple(_shard(mesh, a) for a in args)
     out = verify_batch_jit(*args)  # async under jax's deferred execution
     if hasattr(out, "copy_to_host_async"):
         # start the D2H as soon as compute finishes: device→host
@@ -788,6 +823,106 @@ def verify_launch(items, chunk: int | None = None) -> VerifyHandle:
         # overlap the caller's host work, not serialize behind it
         out.copy_to_host_async()
     return VerifyHandle(out, n_real)
+
+
+def _to_cols(items):
+    """Any verify_launch input form → (n_real, six prepare_cols
+    column arrays)."""
+    if isinstance(items, ColumnarSigBatch):
+        return items.n, items.assemble()
+    if isinstance(items, SigCollector):
+        return items.n, _assemble_cols(items)
+    c = SigCollector()
+    for it in items:
+        c.add_slow(it)
+    return c.n, _assemble_cols(c)
+
+
+def verify_launch_many(batches, chunk: int | None = None,
+                       mesh=None) -> list[VerifyHandle]:
+    """Coalesced dispatch of SEVERAL blocks' signature batches as ONE
+    device launch, amortizing the 64-step ladder's dispatch latency
+    across the blocks the pipeline has in flight.
+
+    Layout: block b's items occupy device indices
+    [off_b, off_b + _bucket(n_b)) of the concatenated batch — each
+    block keeps the exact lane layout a solo ``verify_launch`` would
+    give it (item i at local index i, padded to its own bucket), so the
+    returned per-block VerifyHandles expose ``device_out`` slices that
+    stage-2 and the committer consume unchanged, with unchanged
+    program-cache shapes.  The total is padded out to
+    ``_bucket(Σ buckets)`` so the coalesced dispatch stays inside the
+    same bucket family as monolithic launches.
+
+    Composes with ``chunk`` (the concatenated batch microbatches like
+    any other) and ``mesh`` (axis-0 sharding).  Accept-set-equivalence
+    vs per-block launches is pinned by tests/test_p256v3.py."""
+    batches = [
+        b if isinstance(b, (ColumnarSigBatch, SigCollector)) else list(b)
+        for b in batches
+    ]
+    sizes, colsets = [], []
+    for b in batches:
+        n, cols = (0, None) if _batch_len(b) == 0 else _to_cols(b)
+        sizes.append(n)
+        colsets.append(cols)
+    live = [(n, cols) for n, cols in zip(sizes, colsets) if n]
+    if not live:
+        return [VerifyHandle(jnp.zeros((0,), bool), 0) for _ in batches]
+    if len(live) == 1:
+        # nothing to coalesce: solo launch for the one non-empty block
+        _coalesce_metric().observe(1)
+        out = []
+        for b, n in zip(batches, sizes):
+            out.append(
+                verify_launch(b, chunk=chunk, mesh=mesh) if n
+                else VerifyHandle(jnp.zeros((0,), bool), 0)
+            )
+        return out
+
+    # concatenate per-block columns, each padded to its own bucket
+    offs, total = [], 0
+    for n in sizes:
+        offs.append(total)
+        total += _bucket(n) if n else 0
+    grand = _bucket(total)
+    cat = []
+    for ci in range(6):
+        ref = live[0][1][ci]
+        col = np.zeros((grand,) + ref.shape[1:], ref.dtype)
+        for off, n, cols in zip(offs, sizes, colsets):
+            if n:
+                col[off:off + n] = cols[ci]
+        cat.append(col)
+    _coalesce_metric().observe(len(live))
+
+    chunk = max(int(chunk), MIN_BUCKET) if chunk else 0
+    if chunk and grand > chunk:
+        def stage(lo, hi, pad):
+            args = prepare_cols(*(c[lo:hi] for c in cat), pad_to=pad)
+            return verify_batch_packed_jit(_shard(mesh, pack_cols(*args)))
+
+        # all `grand` lanes are "real" to the chunker (padding lanes
+        # are pre-rejected rows); its tail invariant pads to
+        # _bucket(grand) == grand
+        big = _launch_chunked(grand, chunk, stage)
+        dev = big.device_out
+    else:
+        args = prepare_cols(*cat, pad_to=grand)
+        dev = verify_batch_packed_jit(_shard(mesh, pack_cols(*args)))
+        if hasattr(dev, "copy_to_host_async"):
+            dev.copy_to_host_async()
+    return [
+        VerifyHandle(dev[off:off + _bucket(n)], n) if n
+        else VerifyHandle(jnp.zeros((0,), bool), 0)
+        for off, n in zip(offs, sizes)
+    ]
+
+
+def _batch_len(items) -> int:
+    if isinstance(items, (ColumnarSigBatch, SigCollector)):
+        return items.n
+    return len(items)
 
 
 def verify_host(items) -> list[bool]:

@@ -42,3 +42,39 @@ def shard_args(mesh: Mesh, *arrays):
     return tuple(
         jax.device_put(a, batch_sharding(mesh, a.ndim)) for a in arrays
     )
+
+
+def resolve_mesh(mesh_devices: int) -> Mesh | None:
+    """Production knob → mesh (the nodeconfig ``mesh_devices`` knob).
+
+    0  = sharding off (single-device dispatch — the safe default on
+         CPU-only hosts, where a virtual mesh only adds partition
+         overhead);
+    -1 = auto: all local devices, None when only one exists;
+    n  = first n local devices (clamped to what exists; None if that
+         leaves fewer than 2 — a 1-device mesh is just overhead).
+    """
+    if mesh_devices == 0:
+        return None
+    devices = jax.devices()
+    n = len(devices) if mesh_devices < 0 else min(mesh_devices, len(devices))
+    if n < 2:
+        return None
+    return Mesh(np.asarray(devices[:n]), axis_names=("data",))
+
+
+def shard_batch(mesh: Mesh | None, arr):
+    """Device-put ONE array with axis 0 sharded over the mesh.
+
+    Falls back to the unsharded array when the mesh is None or axis 0
+    does not divide evenly (ragged microbatch tails, sub-minimum
+    buckets) — the caller's dispatch then runs single-device for that
+    array, which is always correct, just not parallel.  All production
+    batch shapes are bucketed to powers of two ≥ 16 or multiples of
+    512, so 2/4/8-chip meshes divide them exactly."""
+    if mesh is None:
+        return arr
+    n = arr.shape[0] if arr.ndim else 0
+    if n == 0 or n % mesh.size != 0:
+        return arr
+    return jax.device_put(arr, batch_sharding(mesh, arr.ndim))

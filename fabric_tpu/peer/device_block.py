@@ -178,13 +178,27 @@ class DeviceBlockPipeline:
         self._cache_gauge = reg.gauge(
             "device_stage2_programs", "compiled stage-2 program cache size"
         )
+        self._shards_hist = reg.histogram(
+            "device_mesh_shards",
+            "mesh shards per fused stage-1+stage-2 launch (1 = unsharded)",
+            buckets=(1, 2, 4, 8, 16, float("inf")),
+        )
 
     def run(self, handle, launch_vec, groups, static_packed, static_dims,
-            pre_ok_pad_len):
+            pre_ok_pad_len, mesh=None):
         """handle: p256v3.VerifyHandle; launch_vec np [T,3] i32;
         groups: list of (plan, packed_dev [Eb, S·P+S+1], Eb, S);
         static_packed: device [T, R+W+2Q] i32; static_dims: (R, W, Q).
-        Returns a zero-arg fetch → dict of numpy arrays."""
+        Returns a zero-arg fetch → dict of numpy arrays.
+
+        ``mesh``: parallel.mesh data mesh — the per-tx (launch_vec,
+        static_packed) and per-endorsement (group) lanes shard axis 0
+        over it; XLA gathers the policy scatter-min and the MVCC
+        fixpoint's validity vector with collectives.  The signature
+        vector (``handle.device_out``) keeps whatever sharding the
+        verify dispatch gave it.  Bit-equal to unsharded: every device
+        value is integer/boolean (the f32 fixpoint matvec sums 0/1
+        counts < 2^24, exact in any reduction order)."""
         t_bucket = pre_ok_pad_len
         n_sig = int(handle.device_out.shape[0])
         gsigs = tuple(
@@ -198,9 +212,13 @@ class DeviceBlockPipeline:
             )
             self._cache_gauge.set(len(self._cache))
         t0 = time.perf_counter()
-        args = [handle.device_out, jnp.asarray(launch_vec)]
-        args += [gp for _, gp, _, _ in groups]
-        args += [static_packed]
+        from fabric_tpu.parallel.mesh import shard_batch
+
+        self._shards_hist.observe(mesh.size if mesh is not None else 1)
+        args = [handle.device_out,
+                shard_batch(mesh, jnp.asarray(launch_vec))]
+        args += [shard_batch(mesh, gp) for _, gp, _, _ in groups]
+        args += [shard_batch(mesh, static_packed)]
         packed = fn(*args)
         if hasattr(packed, "copy_to_host_async"):
             packed.copy_to_host_async()

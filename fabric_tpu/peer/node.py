@@ -57,12 +57,16 @@ class PeerChannel:
                  policy_provider: PolicyProvider | None = None, state_db=None,
                  config_processor=None, genesis_block=None,
                  snapshot_dir: str | None = None, pipeline_depth: int = 2,
-                 verify_chunk: int = 0):
+                 verify_chunk: int = 0, mesh_devices: int = 0,
+                 coalesce_blocks: int = 0):
         self.id = channel_id
-        # commit-path knobs (nodeconfig pipeline_depth / verify_chunk):
-        # depth 2 = CommitPipeline overlap on the deliver loop, 1 =
-        # strict serial commit_block per block
+        # commit-path knobs (nodeconfig pipeline_depth / verify_chunk /
+        # coalesce_blocks): depth 2 = CommitPipeline overlap on the
+        # deliver loop, 1 = strict serial commit_block per block;
+        # coalesce_blocks ≥ 2 = multi-block verify-dispatch coalescing
+        # over the deliver backlog (CommitPipeline.submit_many)
         self.pipeline_depth = int(pipeline_depth)
+        self.coalesce_blocks = int(coalesce_blocks)
         snap_meta = None
         if snapshot_dir is not None:
             from fabric_tpu.ledger.snapshot import create_from_snapshot
@@ -145,7 +149,7 @@ class PeerChannel:
         self.validator = BlockValidator(
             msp_manager, policy_provider, self.ledger.state,
             block_store=self.ledger.blocks, config_processor=config_processor,
-            verify_chunk=verify_chunk,
+            verify_chunk=verify_chunk, mesh_devices=mesh_devices,
         )
         from fabric_tpu.peer.coordinator import PvtDataCoordinator
         from fabric_tpu.peer.transient import TransientStore
@@ -643,6 +647,7 @@ class PeerChannel:
         pipe = CommitPipeline(
             self.validator, commit_fn, depth=self.pipeline_depth,
             pre_launch_fn=self.verify_block_signature, channel=self.id,
+            coalesce_blocks=self.coalesce_blocks,
         )
         # submit() blocks for device syncs and for the committer
         # thread — feeding from the shared default executor could
@@ -707,7 +712,34 @@ class PeerChannel:
                     await loop.run_in_executor(feeder, pipe.flush)
                     await self.commit_block(blk)
                     continue
-                await loop.run_in_executor(feeder, pipe.submit, blk)
+                # launch coalescing: opportunistically drain the
+                # backlog (no await — only blocks ALREADY queued) so
+                # their signature batches ride one device dispatch
+                group, stream_end = [blk], False
+                while (self.coalesce_blocks >= 2
+                       and len(group) < self.coalesce_blocks):
+                    try:
+                        nxt = q.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    if nxt is None:
+                        stream_end = True
+                        break
+                    self._deliver_progress = (
+                        getattr(self, "_deliver_progress", 0) + 1
+                    )
+                    if nxt.header.number < max(expect, self.height):
+                        continue  # replayed
+                    expect = nxt.header.number + 1
+                    group.append(nxt)
+                if len(group) == 1:
+                    await loop.run_in_executor(feeder, pipe.submit, blk)
+                else:
+                    await loop.run_in_executor(
+                        feeder, pipe.submit_many, group
+                    )
+                if stream_end:
+                    break
             if reader_exc:
                 raise reader_exc[0]
         except BaseException:
@@ -876,16 +908,19 @@ class PeerNode:
                  host: str = "127.0.0.1", port: int = 0, tls=None,
                  max_package_size: int = DEFAULT_MAX_PACKAGE_SIZE,
                  install_require_admin: bool = False,
-                 pipeline_depth: int = 2, verify_chunk: int = 0):
+                 pipeline_depth: int = 2, verify_chunk: int = 0,
+                 mesh_devices: int = 0, coalesce_blocks: int = 0):
         self.id = node_id
         self.dir = data_dir
         self.msp = msp_manager
         self.signer = signer
         self.runtime = runtime or ChaincodeRuntime()
         # commit-path knobs every joined channel inherits (nodeconfig
-        # pipeline_depth / verify_chunk)
+        # pipeline_depth / verify_chunk / mesh_devices / coalesce_blocks)
         self.pipeline_depth = int(pipeline_depth)
         self.verify_chunk = int(verify_chunk)
+        self.mesh_devices = int(mesh_devices)
+        self.coalesce_blocks = int(coalesce_blocks)
         # install-surface admission (see _on_install): a size cap
         # always, and optionally an admin-signed request envelope
         self.max_package_size = int(max_package_size)
@@ -1055,6 +1090,8 @@ class PeerNode:
             genesis_block=genesis_block, snapshot_dir=snapshot_dir,
             pipeline_depth=self.pipeline_depth,
             verify_chunk=self.verify_chunk,
+            mesh_devices=self.mesh_devices,
+            coalesce_blocks=self.coalesce_blocks,
         )
         ch.client_ssl = self.tls.client_ctx() if self.tls else None
         ch.runtime = self.runtime  # resolved-binding invalidation hook

@@ -77,6 +77,20 @@ class CommittedBlock:
         return sum(1 for c in self.tx_filter if c == 0)
 
 
+class _SliceFuture:
+    """One block's slice of a coalesced prefetch future — quacks like
+    the per-block Future ``_launch_next`` expects."""
+
+    __slots__ = ("fut", "i")
+
+    def __init__(self, fut, i: int):
+        self.fut = fut
+        self.i = i
+
+    def result(self):
+        return self.fut.result()[self.i]
+
+
 def _is_barrier(pend, batch) -> bool:
     """True for blocks that rotate validation inputs: commit fully,
     drop the overlay, before the successor may launch."""
@@ -108,11 +122,22 @@ class CommitPipeline:
     has fully committed (bundle rotated) by launch time, while
     prefetch overlaps that commit and would verify against the
     pre-rotation orderer set.
+
+    ``coalesce_blocks`` ≥ 2 turns on multi-block launch coalescing:
+    ``submit_many`` stages up to that many waiting blocks' signature
+    batches as ONE concatenated verify dispatch
+    (validator.preprocess_many → ops.p256v3.verify_launch_many),
+    amortizing the ladder's dispatch latency over the backlog; each
+    block then flows through the normal depth-2 launch/finish/commit
+    machinery on its own slice of the device output, so overlays,
+    barriers and dup-txid windows behave exactly as with per-block
+    prefetch.  Needs a real accelerator to win (like ``verify_chunk``);
+    off (0) by default.
     """
 
     def __init__(self, validator, commit_fn, depth: int = 2,
                  prefetch_fn=None, pre_launch_fn=None, registry=None,
-                 channel: str = ""):
+                 channel: str = "", coalesce_blocks: int = 0):
         self.validator = validator
         self.commit_fn = commit_fn
         # the overlay mechanism covers exactly ONE in-flight
@@ -120,6 +145,14 @@ class CommitPipeline:
         self.depth = 1 if depth <= 1 else 2
         self.prefetch_fn = prefetch_fn or validator.preprocess
         self.pre_launch_fn = pre_launch_fn
+        self.coalesce_blocks = int(coalesce_blocks)
+        # coalescing rides the validator's preprocess_many; a CUSTOM
+        # prefetch_fn has no coalesced form, so submit_many degrades
+        # to per-block submits there
+        self._prefetch_many_fn = (
+            getattr(validator, "preprocess_many", None)
+            if prefetch_fn is None else None
+        )
         self.channel = channel
         if registry is None:
             from fabric_tpu.ops_metrics import global_registry
@@ -221,6 +254,69 @@ class CommitPipeline:
         if self._launched is not None:
             out = self._finish_and_commit(self._launched)
         self._launch_next(out.stage_s if out is not None else {}, t_sub)
+        return out
+
+    def submit_many(self, blocks) -> list:
+        """Feed several height-ordered blocks, coalescing their verify
+        dispatches in groups of ``coalesce_blocks`` (see the class
+        docstring).  Returns the CommittedBlocks COMPLETED by these
+        submissions — the in-flight tail stays in the pipe until the
+        next submit or ``flush``.  Degrades to per-block ``submit``
+        when coalescing is off, the pipe is serial, or the validator
+        has no ``preprocess_many``."""
+        blocks = list(blocks)
+        k = self.coalesce_blocks
+        if (self.depth == 1 or k < 2 or len(blocks) < 2
+                or self._prefetch_many_fn is None):
+            return [
+                r for r in (self.submit(b) for b in blocks) if r is not None
+            ]
+        if self._closed:
+            raise RuntimeError("pipeline is closed")
+        out = []
+        i = 0
+        while i < len(blocks):
+            group = blocks[i:i + k]
+            i += len(group)
+            if len(group) == 1:
+                r = self.submit(group[0])
+                if r is not None:
+                    out.append(r)
+                continue
+            # ONE prefetch-thread call stages every block in the group
+            # and launches their signature batches as one coalesced
+            # device dispatch; each block then takes the normal path
+            # on its own slice of the device output
+            fut = self._prefetch.submit(self._prefetch_many_fn, group)
+            # barrier taint: the WHOLE group was staged just now, so a
+            # barrier committing anywhere during this loop (an in-group
+            # config/lifecycle block, or the previous group's tail
+            # finishing at j=0) makes every REMAINING slice stale —
+            # _finish_and_commit's flag only covers the immediate
+            # successor, so latch it and force the per-block redo for
+            # the rest of the group (barriers are rare; the serial
+            # redo is the correctness price, same as per-block mode)
+            stale_group = False
+            for j, block in enumerate(group):
+                t_sub = time.perf_counter()
+                assert self._pre is None, (
+                    "submit_many() before the previous returned"
+                )
+                self._pre = (block, _SliceFuture(fut, j))
+                self._inflight_gauge.set(self.inflight,
+                                         channel=self.channel)
+                res = None
+                if self._launched is not None:
+                    res = self._finish_and_commit(self._launched)
+                if self._stale_prefetch:
+                    stale_group = True
+                elif stale_group:
+                    self._stale_prefetch = True
+                self._launch_next(
+                    res.stage_s if res is not None else {}, t_sub
+                )
+                if res is not None:
+                    out.append(res)
         return out
 
     def flush(self):

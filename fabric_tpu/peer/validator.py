@@ -240,6 +240,7 @@ class BlockValidator:
         plugins: dict[str, ValidationPlugin] | None = None,
         config_processor=None,
         verify_chunk: int = 0,
+        mesh_devices: int = 0,
     ):
         self.msp = msp_manager
         self.policies = policy_provider
@@ -254,6 +255,20 @@ class BlockValidator:
         # device compute overlaps chunk k+1's host staging.  0 = one
         # monolithic launch (nodeconfig ``verify_chunk``).
         self.verify_chunk = int(verify_chunk)
+        # device-mesh sharding of the production dispatch (nodeconfig
+        # ``mesh_devices``): batch lanes of the verify kernel AND the
+        # fused stage-2 program shard axis 0 over a parallel.mesh data
+        # mesh; 0 = off (single device), -1 = all local devices, n =
+        # first n.  Bit-equal to single-device
+        # (tests/test_multidevice.py); a 1-device resolution degrades
+        # to None so CPU-only hosts pay nothing.
+        self.mesh_devices = int(mesh_devices)
+        if self.mesh_devices:
+            from fabric_tpu.parallel.mesh import resolve_mesh
+
+            self.mesh = resolve_mesh(self.mesh_devices)
+        else:
+            self.mesh = None
         # optional phase accumulator (seconds per phase, summed across
         # blocks) — the bench publishes it as the per-phase breakdown
         # artifact; None = no instrumentation overhead
@@ -897,7 +912,9 @@ class BlockValidator:
         t0 = time.perf_counter()
         txs, items, rwp, fb = self._parse(block)
         t0 = self._t("host_parse", t0)
-        fetch = p256.verify_launch(items, chunk=self.verify_chunk or None)
+        fetch = p256.verify_launch(
+            items, chunk=self.verify_chunk or None, mesh=self.mesh
+        )
         t0 = self._t("sig_prepare_launch", t0)
         dpre = self._device_preprocess(txs, rwp, fb)
         t0 = self._t("device_pre", t0)
@@ -909,6 +926,41 @@ class BlockValidator:
         # config tx in the PREVIOUS block may rotate membership between
         # preprocess and validate — validate() detects and re-parses
         return txs, items, fetch, self.msp, dpre, fb, hd_bytes
+
+    def preprocess_many(self, blocks: list) -> list:
+        """Coalesced ``preprocess`` over several in-flight blocks: each
+        block parses as usual, then ALL their signature batches go up
+        in ONE concatenated verify dispatch (p256.verify_launch_many),
+        amortizing the ladder's dispatch latency across the blocks the
+        pipeline has in flight.  Each returned tuple is a drop-in
+        ``pre`` for ``validate_launch`` — the per-block VerifyHandle is
+        a device-side slice with the exact lane layout a solo launch
+        would produce, so stage-2 and the committer are unchanged."""
+        blocks = list(blocks)
+        if len(blocks) <= 1:
+            return [self.preprocess(b) for b in blocks]
+        parsed = []
+        for block in blocks:
+            t0 = time.perf_counter()
+            parsed.append(self._parse(block))
+            self._t("host_parse", t0)
+        t0 = time.perf_counter()
+        fetches = p256.verify_launch_many(
+            [p[1] for p in parsed], chunk=self.verify_chunk or None,
+            mesh=self.mesh,
+        )
+        self._t("sig_prepare_launch", t0)
+        out = []
+        for block, (txs, items, rwp, fb), fetch in zip(
+            blocks, parsed, fetches
+        ):
+            t0 = time.perf_counter()
+            dpre = self._device_preprocess(txs, rwp, fb)
+            t0 = self._t("device_pre", t0)
+            hd_bytes = protoutil.block_header_data_bytes(block)
+            self._t("hd_frame", t0)
+            out.append((txs, items, fetch, self.msp, dpre, fb, hd_bytes))
+        return out
 
     def validate(self, block: common_pb2.Block, pre=None):
         return self.validate_finish(self.validate_launch(block, pre=pre))
@@ -1280,6 +1332,17 @@ class BlockValidator:
 
     # -- fused single-sync device path ------------------------------------
 
+    def _put_group(self, gp):
+        """Upload one policy-group pack (prefetch thread), axis-0
+        sharded over the validator's mesh when one is configured."""
+        import jax.numpy as jnp
+
+        if self.mesh is None:
+            return jnp.asarray(gp)
+        from fabric_tpu.parallel.mesh import shard_batch
+
+        return shard_batch(self.mesh, jnp.asarray(gp))
+
     def _device_preprocess(self, txs, rwp=None, fb=None):
         """State-INDEPENDENT device-path inputs: policy match matrices
         (vectorized gather over per-identity cached principal rows) and
@@ -1360,13 +1423,11 @@ class BlockValidator:
             match = np.stack(pool_rows)[idx_mat]  # [E, S, P] gather
             # pack + upload NOW (prefetch thread): launch-time H2D over
             # the tunnel is latency-bound and sits on the critical path
-            import jax.numpy as jnp
-
             gp = np.empty((E, S * P + S + 1), np.int32)
             gp[:, :S * P] = match.reshape(E, -1)
             gp[:, S * P:S * P + S] = endo_idx
             gp[:, -1] = tx_of
-            groups.append((plan, jnp.asarray(gp), E, S))
+            groups.append((plan, self._put_group(gp), E, S))
             group_entries.append(ents)
 
         # static MVCC arrays (committed-version fill deferred to
@@ -1468,8 +1529,6 @@ class BlockValidator:
         ):
             return None  # custom plugin in play → host dispatch path
 
-        import jax.numpy as jnp
-
         key_ns: dict[int, list] = {}
         key_info: dict[int, object] = {}
         for j, inf in enumerate(infos):
@@ -1506,7 +1565,7 @@ class BlockValidator:
                 gp[:E, S * P:S * P + S] = fb.endo_idx_mat[gtx]
                 gp[:E, -1] = gtx
             # ONE packed upload per group (prefetch thread)
-            groups.append((plan, jnp.asarray(gp), Eb, S))
+            groups.append((plan, self._put_group(gp), Eb, S))
             group_entries.append(range(E))
 
         ukeys = rwp.ukey_strs()
@@ -1579,7 +1638,7 @@ class BlockValidator:
             self._device_pipeline = DeviceBlockPipeline()
         fetch2 = self._device_pipeline.run(
             handle, launch_vec, dpre.groups, static.packed_static(),
-            static.dims, t_bucket,
+            static.dims, t_bucket, mesh=self.mesh,
         )
         self._t("stage2_dispatch", t0)
         return fetch2, range_phantom

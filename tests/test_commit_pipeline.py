@@ -365,6 +365,88 @@ def test_barrier_flushed_as_tail_does_not_poison_next_prefetch():
         v.preprocess_order
 
 
+class CoalescingToyValidator(ToyValidator):
+    """ToyValidator + the preprocess_many seam submit_many coalesces
+    through — models the coalesced timing exactly: the WHOLE group is
+    staged before any of it launches."""
+
+    def preprocess_many(self, blocks):
+        return [self.preprocess(b) for b in blocks]
+
+
+def test_coalesced_group_barrier_redoes_every_later_prefetch():
+    """A barrier INSIDE a coalesced group taints every remaining slice
+    of that group's prefetch (they were all staged before the barrier
+    committed), not just the immediate successor — each must be redone
+    against post-barrier state, and verdicts/state must equal the
+    serial oracle."""
+    blocks = _stream(4, 4)
+    # block 1 writes a lifecycle key → barrier mid-group
+    lc = json.loads(bytes(blocks[1].data.data[2]))
+    lc["writes"]["_lifecycle/cc1"] = "defn"
+    blocks[1].data.data[2] = json.dumps(lc).encode()
+
+    def run_coalesced():
+        state = MemVersionedDB()
+        v = CoalescingToyValidator(state)
+        filters = []
+
+        def commit_fn(res):
+            state.apply_updates(res.batch, (res.block.header.number, 0))
+
+        with CommitPipeline(v, commit_fn, depth=2,
+                            coalesce_blocks=4) as pipe:
+            for r in pipe.submit_many(blocks):
+                filters.append((r.block.header.number, list(r.tx_filter)))
+            r = pipe.flush()
+            if r is not None:
+                filters.append((r.block.header.number, list(r.tx_filter)))
+        filters.sort()
+        return filters, dict(state._data), v
+
+    f_co, s_co, v = run_coalesced()
+    f_serial, s_serial, _ = _run(blocks, depth=1)
+    assert f_co == f_serial
+    assert s_co == s_serial
+    # blocks 2 AND 3 were prefetched in the group stage (pre-barrier:
+    # lifecycle key not yet visible) and BOTH must have been redone
+    # post-barrier — the redo sees the committed lifecycle write
+    for n in (2, 3):
+        seen = [lc_seen for num, lc_seen in v.preprocess_order if num == n]
+        assert len(seen) == 2, (n, v.preprocess_order)
+        assert seen[0] is False and seen[-1] is True, (
+            n, v.preprocess_order
+        )
+
+
+def test_submit_many_without_coalescing_degrades_to_submit():
+    """coalesce off / custom prefetch_fn / serial depth → submit_many
+    is per-block submit with identical results."""
+    blocks = _stream(3, 4)
+
+    def run(**kw):
+        state = MemVersionedDB()
+        v = CoalescingToyValidator(state)
+        filters = []
+
+        def commit_fn(res):
+            state.apply_updates(res.batch, (res.block.header.number, 0))
+
+        with CommitPipeline(v, commit_fn, **kw) as pipe:
+            for r in pipe.submit_many(blocks):
+                filters.append((r.block.header.number, list(r.tx_filter)))
+            r = pipe.flush()
+            if r is not None:
+                filters.append((r.block.header.number, list(r.tx_filter)))
+        filters.sort()
+        return filters, dict(state._data)
+
+    base = run(depth=1)
+    assert run(depth=2, coalesce_blocks=0) == base
+    assert run(depth=2, coalesce_blocks=2) == base
+    assert run(depth=2, coalesce_blocks=8) == base  # group > stream
+
+
 def test_commit_failure_surfaces_and_tail_not_silently_lost():
     """A committer-thread failure must raise at the next submit/flush,
     not vanish."""

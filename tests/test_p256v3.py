@@ -165,6 +165,45 @@ def test_chunked_launch_matches_monolithic(keys, rng):
     assert v3.verify_launch(items, chunk=1)() == mono
 
 
+def test_coalesced_launch_matches_per_block(keys, rng):
+    """Multi-block launch coalescing (verify_launch_many) must be
+    accept-set-equivalent to independent per-block launches — item i of
+    block b at device index off_b + i, empty blocks inert — and stay
+    equivalent when composed with chunk microbatching and with mesh
+    sharding (conftest's 8 forced host devices)."""
+    from fabric_tpu.parallel import mesh as pmesh
+
+    def mk(n, tag):
+        out = []
+        for i in range(n):
+            k = keys[i % 3]
+            e = ec_ref.digest_int(b"%s-%d" % (tag, i))
+            r, s = k.sign_digest(e)
+            if i % 3 == 2:
+                s = ec_ref.N - s  # reject lane
+            out.append((e, r, s, *k.public))
+        return out
+
+    blocks = [mk(5, b"a"), [], mk(9, b"b"), mk(3, b"c")]
+    solo = [v3.verify_launch(b)() for b in blocks]
+    assert any(any(s) for s in solo) and not all(all(s) for s in solo if s)
+
+    co = [h() for h in v3.verify_launch_many(blocks)]
+    assert co == solo
+    # composes with chunk microbatching (the coalesced batch chunks
+    # like any other; per-block slices unchanged)
+    assert [h() for h in v3.verify_launch_many(blocks, chunk=16)] == solo
+    # composes with mesh sharding over the forced host devices
+    mesh = pmesh.resolve_mesh(2)
+    assert [h() for h in v3.verify_launch_many(blocks, mesh=mesh)] == solo
+    # degenerate inputs: all-empty, and a single live block (falls back
+    # to a solo launch, no concatenation)
+    empty = v3.verify_launch_many([[], []])
+    assert [h() for h in empty] == [[], []]
+    one = v3.verify_launch_many([[], mk(5, b"a")])
+    assert [h() for h in one] == [[], solo[0]]
+
+
 def test_batch_inv_and_windows(rng):
     ss = [int.from_bytes(rng.bytes(32), "big") % ec_ref.N or 1 for _ in range(33)]
     inv = v3._batch_inv_mod_n(ss)

@@ -20,6 +20,7 @@ from fabric_tpu.analysis.rules.retrace_hazard import RetraceHazardRule
 from fabric_tpu.analysis.rules.swallowed_exception import (
     SwallowedExceptionRule,
 )
+from fabric_tpu.analysis.rules.kernel_dtype import KernelDtypeMismatchRule
 from fabric_tpu.analysis.rules.union_env import UnionEnvCoercionRule
 
 
@@ -709,6 +710,159 @@ class TestUnionEnvCoercion:
         ) == []
 
 
+# -- FT007 kernel-dtype-mismatch --------------------------------------------
+
+# an ops/ kernel declaring int32 lanes via the repo's trailing-comment
+# convention, plus a docstring-declared lane
+KERNEL_MOD = '''\
+def mvcc_check(
+    read_keys,      # [T, R] int32 block-local key ids
+    ver_ok,         # [T] bool
+    write_keys,     # [T, W] int32
+    windows=None,
+):
+    """Kernel.
+
+    windows: [B, 64] int32 4-bit window digits.
+    """
+    return read_keys
+'''
+
+BAD_CALLER = """\
+import numpy as np
+
+from fabric_tpu.ops.kern import mvcc_check
+
+
+def launch(n):
+    rk = np.zeros((n, 4), np.int64)
+    ok = np.ones(n, bool)
+    mvcc_check(rk, ok, np.arange(n)[:, None])
+"""
+
+
+class TestKernelDtypeMismatch:
+    def _files(self, caller):
+        return {"ops/kern.py": KERNEL_MOD, "peer/caller.py": caller}
+
+    def test_flags_int64_into_int32_lane(self, tmp_path):
+        got = run_rule(
+            tmp_path, KernelDtypeMismatchRule(), self._files(BAD_CALLER)
+        )
+        # rk (assigned int64) into read_keys AND the dtype-less arange
+        # (platform int64) into write_keys — the bool arg is clean
+        assert [(f.rule, f.path, f.line) for f in got] == [
+            ("FT007", "peer/caller.py", 9),
+            ("FT007", "peer/caller.py", 9),
+        ]
+        msgs = " ".join(f.message for f in got)
+        assert "read_keys" in msgs and "write_keys" in msgs
+
+    def test_keyword_and_docstring_lane(self, tmp_path):
+        src = """\
+        import numpy as np
+
+        from fabric_tpu.ops.kern import mvcc_check
+
+
+        def launch(n):
+            w = np.asarray([1, 2], np.int64)
+            mvcc_check(
+                np.zeros((n, 4), np.int32), np.ones(n, bool),
+                np.zeros((n, 2), np.int32), windows=w,
+            )
+        """
+        got = run_rule(
+            tmp_path, KernelDtypeMismatchRule(), self._files(src)
+        )
+        assert len(got) == 1
+        assert "windows" in got[0].message
+
+    def test_int32_caller_is_clean(self, tmp_path):
+        src = BAD_CALLER.replace("np.int64", "np.int32").replace(
+            "np.arange(n)[:, None]",
+            "np.arange(n, dtype=np.int32)[:, None]",
+        )
+        assert run_rule(
+            tmp_path, KernelDtypeMismatchRule(), self._files(src)
+        ) == []
+
+    def test_unknown_dtype_not_flagged(self, tmp_path):
+        src = """\
+        from fabric_tpu.ops.kern import mvcc_check
+
+
+        def launch(rk, ok, wk):
+            mvcc_check(rk[:, :4], ok, wk)
+        """
+        assert run_rule(
+            tmp_path, KernelDtypeMismatchRule(), self._files(src)
+        ) == []
+
+    def test_non_ops_def_not_a_kernel(self, tmp_path):
+        # the same def OUTSIDE ops/ declares nothing → callers clean
+        files = {"peer/kern.py": KERNEL_MOD, "peer/caller.py": BAD_CALLER}
+        assert run_rule(
+            tmp_path, KernelDtypeMismatchRule(), files
+        ) == []
+
+    def test_call_in_closure_flagged_once(self, tmp_path):
+        # the staging-closure pattern (ops/p256v3 stage() closures):
+        # walk_functions yields outer AND inner defs — the call must
+        # not be double-counted from both scopes
+        src = """\
+        import numpy as np
+
+        from fabric_tpu.ops.kern import mvcc_check
+
+
+        def launch(n):
+            rk = np.zeros((n, 4), np.int64)
+
+            def stage(lo, hi):
+                return mvcc_check(rk, None, np.arange(hi - lo)[:, None])
+
+            return stage
+        """
+        got = run_rule(
+            tmp_path, KernelDtypeMismatchRule(), self._files(src)
+        )
+        # exactly one finding (the arange into write_keys); rk's dtype
+        # lives in the OUTER scope's env — the closure's own env does
+        # not see it (under-approximation, never a duplicate)
+        assert len(got) == 1
+        assert "write_keys" in got[0].message
+
+    def test_same_named_local_helper_not_matched(self, tmp_path):
+        # a project function that merely SHARES a kernel's name must
+        # not drag its callers into the rule (import-aware gate)
+        src = """\
+        import numpy as np
+
+
+        def mvcc_check(a, b, c):
+            return a
+
+
+        def launch(n):
+            rk = np.zeros((n, 4), np.int64)
+            mvcc_check(rk, None, np.arange(n))
+        """
+        assert run_rule(
+            tmp_path, KernelDtypeMismatchRule(), self._files(src)
+        ) == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        src = BAD_CALLER.replace(
+            "    mvcc_check(rk, ok, np.arange(n)[:, None])",
+            "    mvcc_check(rk, ok, np.arange(n)[:, None])"
+            "  # fabtpu: noqa(FT007)",
+        )
+        assert run_rule(
+            tmp_path, KernelDtypeMismatchRule(), self._files(src)
+        ) == []
+
+
 # -- engine plumbing --------------------------------------------------------
 
 
@@ -825,4 +979,5 @@ def test_rule_battery_registered():
         "FT004": "lock-discipline",
         "FT005": "swallowed-exception",
         "FT006": "union-env-coercion",
+        "FT007": "kernel-dtype-mismatch",
     }
