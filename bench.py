@@ -204,6 +204,8 @@ def _build_commit_network(n_tx: int, n_blocks: int = 1,
         db.apply_updates(seed, (1, 0))
         return db
 
+    created: list = []
+
     def fresh_validator(state):
         # microbatched device verify (ops/p256v3.py): set e.g. 1024
         # for ~3 chunks per 1000-tx block so chunk k's device compute
@@ -212,12 +214,21 @@ def _build_commit_network(n_tx: int, n_blocks: int = 1,
         # staging, so chunking only adds dispatch overhead (measured
         # +23% on the 2-core container — see CHANGES.md PR 2); enable
         # on real-TPU rounds where the overlap is real.
+        # host_stage_workers / recode_device (ops/p256v3 + hostpool):
+        # shard the host staging over cores and shrink the H2D frame —
+        # the one knob pair that can win on a multi-core CPU host too,
+        # since it parallelizes the HOST side, not the device.
         k = _bench_knobs()
-        return BlockValidator(
+        v = BlockValidator(
             mgr, prov, state, verify_chunk=k["verify_chunk"],
             mesh_devices=k["mesh_devices"],
+            host_stage_workers=k["host_stage_workers"],
+            recode_device=bool(k["recode_device"]),
         )
+        created.append(v)  # the bench reads pool stats off the last one
+        return v
 
+    fresh_validator.created = created
     return blocks, fresh_state, fresh_validator, mgr, prov, CC, n_invalid_per_block
 
 
@@ -233,7 +244,40 @@ def _bench_knobs() -> dict:
         "verify_chunk": int(os.environ.get("FABTPU_BENCH_VERIFY_CHUNK", "0")),
         "mesh_devices": int(os.environ.get("FABTPU_BENCH_MESH", "0")),
         "coalesce_blocks": int(os.environ.get("FABTPU_BENCH_COALESCE", "0")),
+        # host staging pool workers (0 = serial staging, so CPU-only
+        # containers measure the unpooled path unregressed; -1 = cores)
+        "host_stage_workers": int(
+            os.environ.get("FABTPU_BENCH_HOST_WORKERS", "0")
+        ),
+        # 1 = ship u1/u2 as limbs and recode windows on device
+        "recode_device": int(os.environ.get("FABTPU_BENCH_RECODE", "0")),
     }
+
+
+def _host_stage_extras(fresh_validator) -> dict | None:
+    """host_stage sub-breakdown for the JSON extras: resolved worker
+    count, per-shard p50, and the recode location — read off the last
+    validator the run built (None when the pool knob is off)."""
+    created = getattr(fresh_validator, "created", None)
+    if not created:
+        return None
+    v = created[-1]
+    if v.host_pool is None and not v.recode_device:
+        return None
+    out = {"recode": "device" if v.recode_device else "host"}
+    if v.host_pool is not None:
+        out.update(v.host_pool.stats())
+    else:
+        out["workers"] = 0
+    return out
+
+
+def _close_validators(fresh_validator) -> None:
+    """Shut every run's staging pool down once its stats are read —
+    the `created` list pins the validators, so GC alone would leak the
+    worker threads across the bench's multiple runs."""
+    for v in getattr(fresh_validator, "created", ()):
+        v.close()
 
 
 def _serial_baseline_validate(blk, mgr, prov, state):
@@ -449,12 +493,15 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5,
 
     tpu_rate = total / tpu_s
     cpu_rate = total / cpu_s
+    host_stage = _host_stage_extras(fresh_validator)
+    _close_validators(fresh_validator)
     return {
         "metric": f"validated_tx_per_sec_block{n_tx}" + ("_mixed" if invalid_frac else ""),
         "value": round(tpu_rate, 1),
         "unit": "tx/s",
         "vs_baseline": round(tpu_rate / cpu_rate, 3),
         "per_block_ms": per_block_ms,
+        "host_stage": host_stage,
     }
 
 
@@ -528,6 +575,8 @@ def _bench_block_commit_sustained(n_tx: int = 1000, n_blocks: int = 50):
     shutil.rmtree(tmp, ignore_errors=True)
     assert n_valid == expected_valid, (n_valid, expected_valid)
 
+    host_stage = _host_stage_extras(fresh_validator)
+    _close_validators(fresh_validator)
     # per-block commit latency; the first 3 blocks eat the compiles
     # and cache warms — excluded from the percentiles, stated as such
     lats = sorted(
@@ -551,6 +600,7 @@ def _bench_block_commit_sustained(n_tx: int = 1000, n_blocks: int = 50):
                 "warmup_blocks_excluded": 3,
             },
             "knobs": knobs,
+            "host_stage": host_stage,
             "group_commit": group_commit,
         },
     }
@@ -611,6 +661,9 @@ def main():
         # (10% invalid) variant in the same JSON line
         breakdown = result.pop("per_block_ms", None)
         extras = {"per_block_ms": breakdown, "knobs": _bench_knobs()}
+        host_stage = result.pop("host_stage", None)
+        if host_stage is not None:
+            extras["host_stage"] = host_stage
         try:
             mixed = _bench_block_commit(invalid_frac=0.1)
             extras["mixed_10pct_invalid"] = {
@@ -622,6 +675,7 @@ def main():
         result["extras"] = extras
     else:
         result.pop("per_block_ms", None)
+        result.pop("host_stage", None)
     print(json.dumps(result))
 
 

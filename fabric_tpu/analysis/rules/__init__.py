@@ -10,9 +10,11 @@ them); slugs are the human-facing names:
     FT005 swallowed-exception    broad except that drops the error
     FT006 union-env-coercion     env strings coercing non-scalar unions
     FT007 kernel-dtype-mismatch  int64 host arrays into int32 kernel lanes
+    FT008 asyncio-task-leak      dropped ensure_future/create_task results
 """
 
 from fabric_tpu.analysis.rules import (  # noqa: F401
+    asyncio_task_leak,
     host_sync,
     jit_purity,
     kernel_dtype,

@@ -1,0 +1,182 @@
+"""FT008 asyncio-task-leak: fire-and-forget tasks that nothing holds.
+
+``asyncio.ensure_future`` / ``asyncio.create_task`` return a Task the
+event loop references only WEAKLY: if the caller drops the result, the
+task can be garbage-collected mid-flight and dies silently — and even
+when it survives, its exception is swallowed at GC time and nothing
+cancels it on shutdown (the ROADMAP names this rule: "asyncio task
+leaks (ensure_future results never cancelled on stop)").  The repo's
+own discipline is a strong-ref set with a done-callback discard
+(ordering/node.py ``_bg``) or an attribute the stop path cancels.
+
+Mechanics (import-aware per the FT003/FT007 pattern):
+
+1. **Creation sites** — calls that resolve THROUGH the imports to
+   asyncio's task spawners: ``<asyncio alias>.ensure_future/create_task``,
+   bare ``ensure_future``/``create_task`` bound by a from-import of
+   asyncio (renames included), ``<loop var>.create_task`` where the
+   loop var was assigned from ``asyncio.get_event_loop()`` /
+   ``get_running_loop()`` / ``new_event_loop()`` in the same scope, and
+   the chained ``asyncio.get_event_loop().create_task(...)`` form.  A
+   local helper that merely shares the name ``create_task`` never
+   matches (the FT003 lesson).
+2. **Leak test** — a creation site leaks when its Task is
+   (a) an expression statement (the result is discarded outright), or
+   (b) assigned to a plain local name that is never LOADED again
+   anywhere in the enclosing function (closures included — a nested
+   ``finally: t.cancel()`` counts).  Everything else is clean by
+   under-approximation: awaiting, returning, ``.cancel()`` /
+   ``add_done_callback``, storing on ``self``/a container, passing to
+   any call (``gather``, ``tasks.append``) all show up as a Load or a
+   non-Name target.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import (
+    Finding,
+    ModuleCtx,
+    Rule,
+    call_name,
+    register,
+    walk_functions,
+)
+
+_SPAWNERS = {"ensure_future", "create_task"}
+_LOOP_GETTERS = {"get_event_loop", "get_running_loop", "new_event_loop"}
+
+
+def _asyncio_bindings(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """(module_aliases, bare_spawner_names) bound from asyncio anywhere
+    in the module (imports are commonly function-local in this tree)."""
+    aliases: set[str] = set()
+    bare: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "asyncio" or a.name.startswith("asyncio."):
+                    aliases.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "").split(".")[0] != "asyncio":
+                continue
+            for a in node.names:
+                if a.name in _SPAWNERS:
+                    bare.add(a.asname or a.name)
+    return aliases, bare
+
+
+def _walk_own(fn: ast.AST):
+    """A scope's OWN statements (nested defs/lambdas are their own
+    scopes via walk_functions — descending would double-count)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_loop_getter_call(node: ast.AST, aliases: set[str]) -> bool:
+    """True for a DIRECT ``asyncio.get_event_loop()``-style call;
+    loop-var aliasing (``loop2 = loop``) is deliberately not chased —
+    under-approximation keeps false positives at zero."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    if name is None:
+        return False
+    parts = name.split(".")
+    return (len(parts) == 2 and parts[0] in aliases
+            and parts[1] in _LOOP_GETTERS)
+
+
+def _spawner_call(node: ast.Call, aliases: set[str], bare: set[str],
+                  loop_vars: set[str]) -> bool:
+    """True when this Call spawns an asyncio Task, resolved through
+    the module's imports."""
+    name = call_name(node)
+    if name is not None:
+        parts = name.split(".")
+        if len(parts) == 1:
+            return parts[0] in bare
+        if parts[-1] not in _SPAWNERS:
+            return False
+        if parts[0] in aliases and len(parts) == 2:
+            return True  # asyncio.ensure_future(...)
+        return len(parts) == 2 and parts[0] in loop_vars
+    # chained form: asyncio.get_event_loop().create_task(...)
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "create_task"
+            and _is_loop_getter_call(f.value, aliases))
+
+
+@register
+class AsyncioTaskLeakRule(Rule):
+    id = "FT008"
+    name = "asyncio-task-leak"
+    severity = "error"
+    description = (
+        "flags ensure_future/create_task results that are discarded or "
+        "bound to a name never used again — unreferenced tasks can be "
+        "GC'd mid-flight, lose their exceptions, and are never "
+        "cancelled on stop"
+    )
+
+    def check_module(self, ctx: ModuleCtx) -> list[Finding]:
+        aliases, bare = _asyncio_bindings(ctx.tree)
+        if not (aliases or bare):
+            return []
+        out: list[Finding] = []
+        scopes = [ctx.tree] + list(walk_functions(ctx.tree))
+        for fn in scopes:
+            # loop vars assigned from a loop getter in THIS scope
+            loop_vars: set[str] = set()
+            for node in _walk_own(fn):
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and _is_loop_getter_call(node.value, aliases)):
+                    loop_vars.add(node.targets[0].id)
+            # names LOADED anywhere under this scope's subtree (incl.
+            # closures — a nested `finally: t.cancel()` keeps t alive;
+            # for the module scope this is the whole module)
+            loads: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Load):
+                    loads.add(node.id)
+            for node in _walk_own(fn):
+                if isinstance(node, ast.Expr) and isinstance(
+                        node.value, ast.Call) and _spawner_call(
+                        node.value, aliases, bare, loop_vars):
+                    out.append(self.finding(
+                        ctx, node.lineno, node.col_offset,
+                        "the Task returned by "
+                        f"{call_name(node.value) or 'create_task'} is "
+                        "discarded — the loop holds tasks weakly, so it "
+                        "can be GC'd mid-flight and its exception is "
+                        "lost; keep a strong reference (a set with "
+                        "add_done_callback(discard)) and cancel it on "
+                        "stop, or await it",
+                    ))
+                elif (isinstance(node, ast.Assign)
+                      and len(node.targets) == 1
+                      and isinstance(node.targets[0], ast.Name)
+                      and isinstance(node.value, ast.Call)
+                      and _spawner_call(node.value, aliases, bare,
+                                        loop_vars)):
+                    tgt = node.targets[0].id
+                    if tgt not in loads:
+                        out.append(self.finding(
+                            ctx, node.lineno, node.col_offset,
+                            f"the Task bound to '{tgt}' is never "
+                            "awaited, stored, or cancelled — store a "
+                            "strong reference the stop path cancels "
+                            "(or add_done_callback + a task set); an "
+                            "unreferenced task dies silently at GC",
+                        ))
+        return out

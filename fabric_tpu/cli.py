@@ -143,6 +143,9 @@ async def _run_peer(cfg):
         verify_chunk=cfg.verify_chunk,
         mesh_devices=cfg.mesh_devices,
         coalesce_blocks=cfg.coalesce_blocks,
+        host_stage_workers=cfg.host_stage_workers,
+        recode_device=cfg.recode_device,
+        host_stage_mode=cfg.host_stage_mode,
     )
     await node.start(operations_port=cfg.operations_port)
     print(f"peer {node.id} serving on :{node.port}", flush=True)

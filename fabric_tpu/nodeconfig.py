@@ -117,6 +117,28 @@ class PeerConfig:
     # signature batches into one padded verify dispatch.  0/1 = off.
     # Like verify_chunk, wins need a real accelerator.
     coalesce_blocks: int = 0
+    # host staging pool (parallel/hostpool.py): shard the per-block
+    # HOST pipeline — envelope parse fan-out, per-signature admission +
+    # Montgomery batch inversion + residue dgemm, device-path
+    # preprocessing — across N worker threads per validator.  0 = off
+    # (serial staging), -1 = one worker per core, n = n workers.
+    # Bit-equal to serial staging; enable on multi-core hosts whose
+    # sharded device outruns its single-threaded feeder.
+    host_stage_workers: int = 0
+    # host staging pool flavor: "thread" (default — the staging hot
+    # loops are numpy/hashlib/native-C and release the GIL) or
+    # "process" for Python-bound CUSTOM staging workloads on a
+    # directly-constructed HostStagePool.  The validator's built-in
+    # staging is shared-memory (in-place slab writes) and always runs
+    # on threads — it coerces "process" back with a warning.
+    host_stage_mode: str = "thread"
+    # window recoding location (ops/p256v3.py): ship u1/u2 as 16-bit
+    # scalar limbs and derive the 4-bit window digits ON DEVICE, so
+    # the packed verify H2D frame shrinks (window planes 4×, whole
+    # frame ~1.4×).  Default False = host recoding (the native
+    # ec_prepare path computes windows for free; CPU-only hosts have
+    # no H2D frame worth shrinking).  Bit-equal either way.
+    recode_device: bool = False
     # chaincode install surface (peer/node.py _on_install)
     max_package_size: int = DEFAULT_MAX_PACKAGE_SIZE
     install_require_admin: bool = False
@@ -345,6 +367,12 @@ def _load(cls, source, environ=None):
             )
         if len(tmiss) == 3:
             cfg.tls = None  # an all-empty section means no TLS
+    if isinstance(cfg, PeerConfig) and cfg.host_stage_mode not in (
+            "thread", "process"):
+        raise ConfigError(
+            f"key 'host_stage_mode': must be 'thread' or 'process', "
+            f"got {cfg.host_stage_mode!r}"
+        )
     if isinstance(cfg, OrdererConfig) and cfg.consensus not in (
             "raft", "bft"):
         raise ConfigError(

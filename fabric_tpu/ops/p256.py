@@ -440,28 +440,34 @@ def verify_host(items) -> list[bool]:
     return _verify_host_v1(items)
 
 
-def verify_launch(items, chunk: int | None = None, mesh=None):
+def verify_launch(items, chunk: int | None = None, mesh=None, pool=None,
+                  recode_device: bool = False):
     """Async launch + fetch() (see p256v3.verify_launch); the v1/v2
     comparison kernels evaluate eagerly (no device handle — the fused
     device pipeline requires the v3 kernel, and the ``chunk`` /
-    ``mesh`` knobs only apply there)."""
+    ``mesh`` / ``pool`` / ``recode_device`` knobs only apply there)."""
     if _KERNEL not in ("v1", "v2"):
         from fabric_tpu.ops import p256v3
 
-        return p256v3.verify_launch(items, chunk=chunk, mesh=mesh)
+        return p256v3.verify_launch(items, chunk=chunk, mesh=mesh,
+                                    pool=pool,
+                                    recode_device=recode_device)
     if hasattr(items, "tuples"):
         items = items.tuples()
     result = verify_host(items)
     return lambda: result
 
 
-def verify_launch_many(batches, chunk: int | None = None, mesh=None):
+def verify_launch_many(batches, chunk: int | None = None, mesh=None,
+                       pool=None, recode_device: bool = False):
     """Coalesced multi-block launch (see p256v3.verify_launch_many);
     v1/v2 comparison kernels degrade to independent eager launches."""
     if _KERNEL not in ("v1", "v2"):
         from fabric_tpu.ops import p256v3
 
-        return p256v3.verify_launch_many(batches, chunk=chunk, mesh=mesh)
+        return p256v3.verify_launch_many(batches, chunk=chunk, mesh=mesh,
+                                         pool=pool,
+                                         recode_device=recode_device)
     return [verify_launch(b) for b in batches]
 
 

@@ -319,6 +319,47 @@ def _windows(us: list[int]) -> np.ndarray:
     return np.stack([hi, lo], axis=-1).reshape(len(us), 64).astype(np.int32)
 
 
+# window recoding ON DEVICE: u1/u2 ship as 16 big-endian 16-bit limbs
+# (32 int16 columns for the pair) instead of 128 window-digit columns —
+# 4× less H2D for the window planes, ~1.4× for the whole packed frame —
+# and the [B, 64] digits are derived in the stage-1 kernel with pure
+# shift/mask lanes.  Bit-equality vs host _windows is pinned by
+# tests/test_p256v3.py across random scalars and edge cases.
+_PK_LIMBS = 16
+
+
+def _limbs16(us) -> np.ndarray:
+    """[B] ints (< 2^256) → [B, 16] int16 BIG-endian 16-bit limbs.
+    Values ≥ 2^15 wrap into the sign bit (same bit pattern); the
+    device re-masks with ``& 0xFFFF`` after widening."""
+    if not len(us):
+        return np.zeros((0, _PK_LIMBS), np.int16)
+    raw = np.frombuffer(
+        b"".join(int(u).to_bytes(32, "big") for u in us), np.uint8
+    ).reshape(len(us), 32).astype(np.uint16)
+    return ((raw[:, 0::2] << 8) | raw[:, 1::2]).astype(np.int16)
+
+
+def windows_to_limbs(w: np.ndarray) -> np.ndarray:
+    """[B, 64] window digits → [B, 16] int16 limbs — packs the native
+    ec_prepare path's C-computed windows into the limb wire form (the
+    exact inverse of the device recode; each digit < 16)."""
+    if not len(w):
+        return np.zeros((0, _PK_LIMBS), np.int16)
+    d = w.astype(np.uint16).reshape(len(w), _PK_LIMBS, 4)
+    return ((d[..., 0] << 12) | (d[..., 1] << 8) | (d[..., 2] << 4)
+            | d[..., 3]).astype(np.int16)
+
+
+def device_recode_windows(limbs):
+    """[B, 16] int16 big-endian limbs → [B, 64] int32 window digits,
+    ON DEVICE — limb j carries digits 4j..4j+3 MSB-first, matching the
+    host ``_windows`` layout bit for bit."""
+    l = limbs.astype(jnp.int32) & 0xFFFF
+    d = (l[..., None] >> jnp.asarray([12, 8, 4, 0], jnp.int32)) & 0xF
+    return d.reshape(*limbs.shape[:-1], STEPS)
+
+
 def prepare(items, pad_to: int | None = None):
     """Host-side preparation for verify_batch: admission checks,
     batched s⁻¹, scalar recoding, residue conversion.  Returns the
@@ -547,11 +588,16 @@ def _assemble_cols(c: SigCollector):
 
 
 def prepare_cols(digest_b, r_b, s_b, qx_res, qy_res, pub_ok,
-                 pad_to: int | None = None):
+                 pad_to: int | None = None, recode_device: bool = False):
     """Column-form host preparation: same outputs (and accept set) as
     ``prepare`` but residues come from one dgemm over the byte columns
     and cached identity rows; only the admission checks and the
-    batched inversion touch Python ints."""
+    batched inversion touch Python ints.
+
+    ``recode_device``: skip host window recoding — the w1/w2 slots of
+    the returned tuple carry [B, 16] int16 scalar LIMBS instead of
+    [B, 64] digits, for the ``verify_batch_packed_limbs`` kernel that
+    derives the digits on device (4× less H2D for the window planes)."""
     import ctypes
 
     B0 = len(r_b)
@@ -588,6 +634,10 @@ def prepare_cols(digest_b, r_b, s_b, qx_res, qy_res, pub_ok,
             )
             pre_ok[:B0] = pub_ok & (flags & 1).astype(bool)
             rpn_ok[:B0] = (flags & 2).astype(bool)
+            if recode_device:
+                # the C path hands back digits; pack them to limbs so
+                # the wire form (and kernel) match the Python lane
+                w1, w2 = windows_to_limbs(w1), windows_to_limbs(w2)
             w1, w2 = full(w1), full(w2)
 
     if w1 is None:  # pure-Python fallback (no toolchain)
@@ -605,7 +655,10 @@ def prepare_cols(digest_b, r_b, s_b, qx_res, qy_res, pub_ok,
         u2s = [(r * si) % N for r, si in zip(rints, s_inv)]
         u1s += [0] * (Bp - B0)
         u2s += [0] * (Bp - B0)
-        w1, w2 = _windows(u1s), _windows(u2s)
+        if recode_device:
+            w1, w2 = _limbs16(u1s), _limbs16(u2s)
+        else:
+            w1, w2 = _windows(u1s), _windows(u2s)
 
     primes = np.array(rns.BASE_A + rns.BASE_B, np.int32)
     n_res = rns._to_res(N, rns.BASE_A + rns.BASE_B)
@@ -625,18 +678,26 @@ _PK_R = 2 * rns.N_CH
 _PK_COLS = 4 * _PK_R + 2 * STEPS + 2
 
 
+def _pack_rows(out, args, lo, hi, w_cols: int) -> None:
+    """Pack rows [lo, hi) of the eight staged columns into the int16
+    launch frame ``out`` in place — the unit the host pool shards."""
+    view = out[lo:hi]
+    o = 0
+    for a in args[:4]:
+        view[:, o:o + _PK_R] = a[lo:hi]
+        o += _PK_R
+    for a in args[4:6]:
+        view[:, o:o + w_cols] = a[lo:hi]
+        o += w_cols
+    view[:, o] = args[6][lo:hi]
+    view[:, o + 1] = args[7][lo:hi]
+
+
 def pack_cols(qx, qy, r_res, rpn_res, w1, w2, rpn_ok, pre_ok) -> np.ndarray:
     B = len(qx)
     out = np.empty((B, _PK_COLS), np.int16)
-    o = 0
-    for a in (qx, qy, r_res, rpn_res):
-        out[:, o:o + _PK_R] = a
-        o += _PK_R
-    for a in (w1, w2):
-        out[:, o:o + STEPS] = a
-        o += STEPS
-    out[:, o] = rpn_ok
-    out[:, o + 1] = pre_ok
+    _pack_rows(out, (qx, qy, r_res, rpn_res, w1, w2, rpn_ok, pre_ok),
+               0, B, STEPS)
     return out
 
 
@@ -658,6 +719,123 @@ def verify_batch_packed(packed):
 
 
 verify_batch_packed_jit = jax.jit(verify_batch_packed)
+
+
+# recode-on-device packed form: the two 64-digit window planes shrink
+# to 16 limbs each — 218 int16 columns per lane instead of 314.
+_PKL_COLS = 4 * _PK_R + 2 * _PK_LIMBS + 2
+
+
+def pack_cols_limbs(qx, qy, r_res, rpn_res, l1, l2, rpn_ok, pre_ok) -> np.ndarray:
+    """Packed launch frame with u1/u2 as [B, 16] int16 limbs (the
+    ``prepare_cols(recode_device=True)`` outputs) — consumed by
+    ``verify_batch_packed_limbs`` which recodes on device."""
+    B = len(qx)
+    out = np.empty((B, _PKL_COLS), np.int16)
+    _pack_rows(out, (qx, qy, r_res, rpn_res, l1, l2, rpn_ok, pre_ok),
+               0, B, _PK_LIMBS)
+    return out
+
+
+def _unpack_cols_limbs(packed):
+    o = 0
+    res = []
+    for _ in range(4):
+        res.append(packed[:, o:o + _PK_R].astype(jnp.int32))
+        o += _PK_R
+    w1 = device_recode_windows(packed[:, o:o + _PK_LIMBS])
+    o += _PK_LIMBS
+    w2 = device_recode_windows(packed[:, o:o + _PK_LIMBS])
+    o += _PK_LIMBS
+    return (*res, w1, w2, packed[:, o] != 0, packed[:, o + 1] != 0)
+
+
+def verify_batch_packed_limbs(packed):
+    return verify_batch(*_unpack_cols_limbs(packed))
+
+
+verify_batch_packed_limbs_jit = jax.jit(verify_batch_packed_limbs)
+
+
+def _pack_launch(args, recode_device: bool, pool=None) -> np.ndarray:
+    """Staged columns → int16 launch frame; with a host pool the row
+    slabs pack in parallel (the pack is a multi-MB strided copy that
+    otherwise serializes behind the pooled staging)."""
+    if pool is None:
+        return (pack_cols_limbs(*args) if recode_device
+                else pack_cols(*args))
+    B = len(args[0])
+    w_cols = _PK_LIMBS if recode_device else STEPS
+    out = np.empty((B, _PKL_COLS if recode_device else _PK_COLS),
+                   np.int16)
+    bounds = pool.slice_bounds(B, align=MIN_BUCKET)
+    if len(bounds) <= 1:
+        _pack_rows(out, args, 0, B, w_cols)
+        return out
+    pool.map_slices(B, lambda lo, hi: _pack_rows(out, args, lo, hi,
+                                                 w_cols),
+                    stage="pack", align=MIN_BUCKET)
+    return out
+
+
+def _packed_kernel(recode_device: bool):
+    return (verify_batch_packed_limbs_jit if recode_device
+            else verify_batch_packed_jit)
+
+
+def _prepare_cols_pooled(cols, pad_to, pool, recode_device: bool = False):
+    """``prepare_cols`` sharded over the host staging pool along the
+    lane axis at MIN_BUCKET boundaries.  Bit-equal to the serial call:
+    every staged lane is independent (admission flags, window
+    recoding, residue dgemm are per-row, and Montgomery batch
+    inversion yields the exact per-lane modular inverse regardless of
+    how the batch is grouped), so shard outputs ARE the serial output
+    rows; the tail pad rows are all-zero/rejected in both forms.
+    Pinned by tests/test_p256v3.py.
+
+    The full-size output arrays are preallocated HERE and each worker
+    writes its own row slab in place — a gather-then-concatenate would
+    serialize a multi-MB memcpy behind the parallel work (measured
+    ~6 ms on a 3072-lane batch, most of the win)."""
+    B0 = len(cols[1])
+    bounds = pool.slice_bounds(B0, align=MIN_BUCKET)
+    if len(bounds) <= 1:
+        return prepare_cols(*cols, pad_to=pad_to,
+                            recode_device=recode_device)
+    Bp = pad_to if pad_to is not None else B0
+    R = 2 * rns.N_CH
+    wcols = _PK_LIMBS if recode_device else STEPS
+    wdt = np.int16 if recode_device else np.int32
+    out = (
+        np.zeros((Bp, R), np.int32),   # qx_res
+        np.zeros((Bp, R), np.int32),   # qy_res
+        np.zeros((Bp, R), np.int32),   # r_res
+        np.zeros((Bp, R), np.int32),   # rpn_res
+        np.zeros((Bp, wcols), wdt),    # w1 digits | u1 limbs
+        np.zeros((Bp, wcols), wdt),    # w2 digits | u2 limbs
+        np.zeros(Bp, bool),            # rpn_ok
+        np.zeros(Bp, bool),            # pre_ok
+    )
+
+    def stage(lo, hi):
+        res = prepare_cols(*(c[lo:hi] for c in cols),
+                           recode_device=recode_device)
+        for dst, src in zip(out, res):
+            dst[lo:hi] = src
+
+    pool.map_slices(B0, stage, stage="sig_prepare", align=MIN_BUCKET)
+    return out
+
+
+def _h2d_hist():
+    from fabric_tpu.ops_metrics import global_registry
+
+    return global_registry().histogram(
+        "h2d_bytes_per_block",
+        "packed verify-batch H2D bytes per launch",
+        buckets=(1 << 14, 1 << 16, 1 << 18, 1 << 20, 1 << 22,
+                 float("inf")),
+    )
 
 
 class VerifyHandle:
@@ -762,7 +940,44 @@ def _launch_chunked(n_real: int, chunk: int, stage_fn) -> VerifyHandle:
     return VerifyHandle(dev, n_real)
 
 
-def verify_launch(items, chunk: int | None = None, mesh=None) -> VerifyHandle:
+def _stage_prepare(cols, lo, hi, pad, pool, recode_device):
+    """Host staging for rows [lo, hi) of a column set: prepare_cols,
+    sharded over the host pool when one is configured."""
+    sl = cols if (lo == 0 and hi == len(cols[1])) else tuple(
+        c[lo:hi] for c in cols
+    )
+    if pool is not None:
+        return _prepare_cols_pooled(sl, pad, pool,
+                                    recode_device=recode_device)
+    return prepare_cols(*sl, pad_to=pad, recode_device=recode_device)
+
+
+def _launch_cols(n_real, cols, chunk, mesh, pool, recode_device):
+    """Column-form launch: stage (pooled), pack (host or limb wire
+    form), dispatch (sharded), with the H2D frame size observed per
+    dispatch."""
+    kern = _packed_kernel(recode_device)
+    rc = "device" if recode_device else "host"
+    if chunk and n_real > chunk:
+        def stage(lo, hi, pad):
+            args = _stage_prepare(cols, lo, hi, pad, pool, recode_device)
+            packed = _pack_launch(args, recode_device, pool=pool)
+            _h2d_hist().observe(packed.nbytes, recode=rc)
+            return kern(_shard(mesh, packed))
+
+        return _launch_chunked(n_real, chunk, stage)
+    args = _stage_prepare(cols, 0, n_real, _bucket(n_real), pool,
+                          recode_device)
+    packed = _pack_launch(args, recode_device, pool=pool)
+    _h2d_hist().observe(packed.nbytes, recode=rc)
+    out = kern(_shard(mesh, packed))
+    if hasattr(out, "copy_to_host_async"):
+        out.copy_to_host_async()
+    return VerifyHandle(out, n_real)
+
+
+def verify_launch(items, chunk: int | None = None, mesh=None, pool=None,
+                  recode_device: bool = False) -> VerifyHandle:
     """Asynchronously dispatch a verify batch; returns a VerifyHandle
     (callable as a zero-arg fetch for list[bool]).  The jax dispatch is
     non-blocking, so the device crunches while the caller's host thread
@@ -781,7 +996,18 @@ def verify_launch(items, chunk: int | None = None, mesh=None) -> VerifyHandle:
     with axis 0 sharded over it, so XLA partitions the whole ladder
     across the chips (the verify is per-lane independent: bit-equal to
     single-device, pinned by tests/test_multidevice.py).  None =
-    default single-device placement."""
+    default single-device placement.
+
+    ``pool``: a parallel.hostpool.HostStagePool — the per-signature
+    host staging (admission checks, Montgomery batch inversion, window
+    recoding, residue dgemm) shards over its workers along the lane
+    axis at bucket boundaries; bit-equal to serial staging (pinned by
+    tests/test_p256v3.py).  None = serial staging.
+
+    ``recode_device``: ship u1/u2 as 16-bit scalar limbs and derive
+    the 4-bit window digits on device (``verify_batch_packed_limbs``),
+    shrinking the packed H2D frame (the window planes drop 4×, the
+    whole frame ~1.4×); bit-equal to host recoding."""
     chunk = max(int(chunk), MIN_BUCKET) if chunk else 0
     if isinstance(items, (ColumnarSigBatch, SigCollector)):
         if not items.n:
@@ -789,23 +1015,17 @@ def verify_launch(items, chunk: int | None = None, mesh=None) -> VerifyHandle:
         n_real = items.n
         cols = (items.assemble() if isinstance(items, ColumnarSigBatch)
                 else _assemble_cols(items))
-        if chunk and n_real > chunk:
-            def stage(lo, hi, pad):
-                args = prepare_cols(*(c[lo:hi] for c in cols), pad_to=pad)
-                return verify_batch_packed_jit(
-                    _shard(mesh, pack_cols(*args))
-                )
-
-            return _launch_chunked(n_real, chunk, stage)
-        args = prepare_cols(*cols, pad_to=_bucket(n_real))
-        out = verify_batch_packed_jit(_shard(mesh, pack_cols(*args)))
-        if hasattr(out, "copy_to_host_async"):
-            out.copy_to_host_async()
-        return VerifyHandle(out, n_real)
+        return _launch_cols(n_real, cols, chunk, mesh, pool, recode_device)
     items = list(items)
     if not items:
         return VerifyHandle(jnp.zeros((0,), bool), 0)
     n_real = len(items)
+    if pool is not None or recode_device:
+        # pooled staging and device recoding are COLUMN lanes: lift
+        # legacy tuples into the column form (accept-set equal — the
+        # chunked/coalesced differentials already pin this route)
+        n_real, cols = _to_cols(items)
+        return _launch_cols(n_real, cols, chunk, mesh, pool, recode_device)
     if chunk and n_real > chunk:
         def stage(lo, hi, pad):
             return verify_batch_jit(
@@ -839,7 +1059,8 @@ def _to_cols(items):
 
 
 def verify_launch_many(batches, chunk: int | None = None,
-                       mesh=None) -> list[VerifyHandle]:
+                       mesh=None, pool=None,
+                       recode_device: bool = False) -> list[VerifyHandle]:
     """Coalesced dispatch of SEVERAL blocks' signature batches as ONE
     device launch, amortizing the 64-step ladder's dispatch latency
     across the blocks the pipeline has in flight.
@@ -855,8 +1076,10 @@ def verify_launch_many(batches, chunk: int | None = None,
     same bucket family as monolithic launches.
 
     Composes with ``chunk`` (the concatenated batch microbatches like
-    any other) and ``mesh`` (axis-0 sharding).  Accept-set-equivalence
-    vs per-block launches is pinned by tests/test_p256v3.py."""
+    any other), ``mesh`` (axis-0 sharding), ``pool`` (host staging
+    sharded over cores) and ``recode_device`` (limb wire form + device
+    window recoding).  Accept-set-equivalence vs per-block launches is
+    pinned by tests/test_p256v3.py."""
     batches = [
         b if isinstance(b, (ColumnarSigBatch, SigCollector)) else list(b)
         for b in batches
@@ -875,7 +1098,8 @@ def verify_launch_many(batches, chunk: int | None = None,
         out = []
         for b, n in zip(batches, sizes):
             out.append(
-                verify_launch(b, chunk=chunk, mesh=mesh) if n
+                verify_launch(b, chunk=chunk, mesh=mesh, pool=pool,
+                              recode_device=recode_device) if n
                 else VerifyHandle(jnp.zeros((0,), bool), 0)
             )
         return out
@@ -897,21 +1121,11 @@ def verify_launch_many(batches, chunk: int | None = None,
     _coalesce_metric().observe(len(live))
 
     chunk = max(int(chunk), MIN_BUCKET) if chunk else 0
-    if chunk and grand > chunk:
-        def stage(lo, hi, pad):
-            args = prepare_cols(*(c[lo:hi] for c in cat), pad_to=pad)
-            return verify_batch_packed_jit(_shard(mesh, pack_cols(*args)))
-
-        # all `grand` lanes are "real" to the chunker (padding lanes
-        # are pre-rejected rows); its tail invariant pads to
-        # _bucket(grand) == grand
-        big = _launch_chunked(grand, chunk, stage)
-        dev = big.device_out
-    else:
-        args = prepare_cols(*cat, pad_to=grand)
-        dev = verify_batch_packed_jit(_shard(mesh, pack_cols(*args)))
-        if hasattr(dev, "copy_to_host_async"):
-            dev.copy_to_host_async()
+    # all `grand` lanes are "real" to the chunker (padding lanes are
+    # pre-rejected rows); its tail invariant pads to
+    # _bucket(grand) == grand
+    dev = _launch_cols(grand, tuple(cat), chunk, mesh, pool,
+                       recode_device).device_out
     return [
         VerifyHandle(dev[off:off + _bucket(n)], n) if n
         else VerifyHandle(jnp.zeros((0,), bool), 0)

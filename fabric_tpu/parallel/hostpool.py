@@ -1,0 +1,205 @@
+"""Host staging worker pool: sharding the per-block HOST pipeline
+across cores.
+
+PR 3 made the device lane mesh-parallel, but the host side of every
+1000-tx block (envelope parse, per-signature admission + Montgomery
+batch inversion + residue dgemm, device-path preprocessing) stayed a
+single thread feeding a now-parallel device — the classic host-bound
+input pipeline every accelerator stack solves with a worker pool ahead
+of the device (tf.data prefetch workers; the batched-ECDSA GPU
+literature's CPU staging pools).  This module is that pool, shaped for
+this repo's staging work:
+
+* threads by DEFAULT — the hot loops are numpy dgemms, ``hashlib``,
+  the native C pre-parser, and ``int.to_bytes`` batches, all of which
+  release the GIL, so threads scale on the very loops that matter
+  without pickling block-sized arrays across process boundaries;
+* an optional PROCESS mode behind the ``mode`` knob for workloads that
+  really are Python-bound — tasks submitted there must be picklable
+  top-level functions (the validator keeps its bound-method fan-out on
+  threads and says so);
+* slice helpers that shard a batch's lane axis at bucket boundaries
+  (multiples of ``align``) into per-worker contiguous ranges, so the
+  per-shard outputs CONCATENATE back bit-trivially — every staged lane
+  is lane-independent, which is what pins pooled ≡ serial the same way
+  sharded ≡ single-device is pinned on the mesh;
+* per-task telemetry: ``host_stage_pool_seconds{stage,worker}`` rides
+  the process metrics registry so the pool's occupancy is observable
+  next to the validator stage histograms.
+
+The knob (nodeconfig ``host_stage_workers``) resolves exactly like
+``mesh_devices``: 0 = off (serial staging — the safe default, CPU-only
+hosts pay nothing), -1 = one worker per core, n = n workers; a
+resolution below 2 returns None because a 1-worker pool is only queue
+overhead.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
+
+
+def _pool_hist():
+    from fabric_tpu.ops_metrics import global_registry
+
+    return global_registry().histogram(
+        "host_stage_pool_seconds",
+        "host staging pool task time (s) by stage and worker",
+        buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                 0.1, 0.25, 1.0, float("inf")),
+    )
+
+
+class HostStagePool:
+    """Persistent staging worker pool (see module docstring).
+
+    Construct via :func:`resolve_host_pool`; the pool is created once
+    per validator and reused for every block — worker spin-up must not
+    ride the per-block critical path.
+    """
+
+    def __init__(self, workers: int, mode: str = "thread"):
+        if workers < 2:
+            raise ValueError("HostStagePool needs >= 2 workers "
+                             "(resolve_host_pool returns None below that)")
+        if mode not in ("thread", "process"):
+            raise ValueError(f"host pool mode {mode!r}: "
+                             "expected 'thread' or 'process'")
+        self.workers = int(workers)
+        self.mode = mode
+        if mode == "process":
+            import multiprocessing as mp
+
+            # spawn, not fork: this process is multithreaded the
+            # moment jax loads, and forking a threaded process can
+            # deadlock the child in a held allocator/runtime lock
+            self._ex = ProcessPoolExecutor(
+                self.workers, mp_context=mp.get_context("spawn")
+            )
+        else:
+            self._ex = ThreadPoolExecutor(
+                self.workers, thread_name_prefix="fabtpu-hoststage"
+            )
+        self._hist = _pool_hist()
+        # recent per-task durations for the bench's host_stage
+        # sub-breakdown (p50 per shard) — bounded, lock-guarded
+        self._durs: deque = deque(maxlen=1024)
+        self._lock = threading.Lock()
+        self._tasks = 0
+
+    # -- submission --------------------------------------------------------
+
+    def _observe(self, stage: str, worker: str, dt: float) -> None:
+        self._hist.observe(dt, stage=stage, worker=worker)
+        with self._lock:
+            self._durs.append(dt)
+            self._tasks += 1
+
+    def _timed(self, fn, stage: str):
+        """Wrap ``fn`` to observe its duration from INSIDE the worker
+        (thread mode) so the worker label names the executing slot."""
+        def run(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                name = threading.current_thread().name
+                worker = name.rsplit("_", 1)[-1] if "_" in name else name
+                self._observe(stage, worker, time.perf_counter() - t0)
+        return run
+
+    def submit(self, fn, *args, stage: str = "task", **kwargs):
+        """Submit one task; returns a Future.  Thread mode times the
+        task inside its worker; process mode times submit→done in the
+        parent (the child's registry is not this process's)."""
+        if self.mode == "process":
+            t0 = time.perf_counter()
+            fut = self._ex.submit(fn, *args, **kwargs)
+            fut.add_done_callback(
+                lambda f: self._observe(stage, "proc",
+                                        time.perf_counter() - t0)
+            )
+            return fut
+        return self._ex.submit(self._timed(fn, stage), *args, **kwargs)
+
+    def map(self, fn, items, stage: str = "task") -> list:
+        """Ordered parallel map: fan every item out, gather in order.
+        An exception in any task propagates at the gather (the
+        remaining futures still run to completion — staging tasks are
+        short and side-effect-free)."""
+        futs = [self.submit(fn, it, stage=stage) for it in items]
+        return [f.result() for f in futs]
+
+    # -- lane-axis sharding ------------------------------------------------
+
+    def slice_bounds(self, n: int, align: int = 1) -> list[tuple[int, int]]:
+        """Split [0, n) into ≤ ``workers`` contiguous ranges whose
+        boundaries are multiples of ``align`` (bucket boundaries —
+        MIN_BUCKET for signature columns), so each worker stages a
+        self-contained slab and concatenation needs no re-bucketing.
+        The tail range absorbs the remainder."""
+        if n <= 0:
+            return []
+        per = -(-n // self.workers)
+        per = -(-per // align) * align  # round the stride UP to align
+        out = []
+        lo = 0
+        while lo < n:
+            hi = min(n, lo + per)
+            out.append((lo, hi))
+            lo = hi
+        return out
+
+    def map_slices(self, n: int, fn, stage: str = "task",
+                   align: int = 1) -> list:
+        """``fn(lo, hi)`` over :meth:`slice_bounds`, ordered results."""
+        return self.map(lambda b: fn(*b), self.slice_bounds(n, align),
+                        stage=stage)
+
+    # -- introspection / lifecycle ----------------------------------------
+
+    def stats(self) -> dict:
+        """Pool occupancy summary for bench extras: worker count and
+        the p50 of recent per-task (per-shard) durations in ms."""
+        with self._lock:
+            durs = sorted(self._durs)
+            tasks = self._tasks
+        p50 = durs[len(durs) // 2] if durs else 0.0
+        return {
+            "workers": self.workers,
+            "mode": self.mode,
+            "tasks": tasks,
+            "per_shard_p50_ms": round(p50 * 1000.0, 3),
+        }
+
+    def shutdown(self) -> None:
+        self._ex.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.shutdown()
+        return False
+
+
+def resolve_host_pool(workers: int, mode: str = "thread") -> HostStagePool | None:
+    """Production knob → pool (the nodeconfig ``host_stage_workers``
+    knob; mirrors parallel.mesh.resolve_mesh):
+
+    0  = staging pool off (serial host staging — the safe default);
+    -1 = one worker per core;
+    n  = n workers (clamped to the core count; below 2 → None, a
+         1-worker pool is queue overhead with no parallelism).
+    """
+    if workers == 0:
+        return None
+    cores = os.cpu_count() or 1
+    n = cores if workers < 0 else min(workers, cores)
+    if n < 2:
+        return None
+    return HostStagePool(n, mode=mode)

@@ -58,7 +58,9 @@ class PeerChannel:
                  config_processor=None, genesis_block=None,
                  snapshot_dir: str | None = None, pipeline_depth: int = 2,
                  verify_chunk: int = 0, mesh_devices: int = 0,
-                 coalesce_blocks: int = 0):
+                 coalesce_blocks: int = 0, host_stage_workers: int = 0,
+                 recode_device: bool = False,
+                 host_stage_mode: str = "thread"):
         self.id = channel_id
         # commit-path knobs (nodeconfig pipeline_depth / verify_chunk /
         # coalesce_blocks): depth 2 = CommitPipeline overlap on the
@@ -150,6 +152,8 @@ class PeerChannel:
             msp_manager, policy_provider, self.ledger.state,
             block_store=self.ledger.blocks, config_processor=config_processor,
             verify_chunk=verify_chunk, mesh_devices=mesh_devices,
+            host_stage_workers=host_stage_workers,
+            recode_device=recode_device, host_stage_mode=host_stage_mode,
         )
         from fabric_tpu.peer.coordinator import PvtDataCoordinator
         from fabric_tpu.peer.transient import TransientStore
@@ -893,6 +897,7 @@ class PeerChannel:
     def stop(self):
         if self._deliver_task:
             self._deliver_task.cancel()
+        self.validator.close()  # host staging pool worker threads
         self.transient.close()
         self.confighistory.close()
         self.ledger.close()
@@ -909,7 +914,9 @@ class PeerNode:
                  max_package_size: int = DEFAULT_MAX_PACKAGE_SIZE,
                  install_require_admin: bool = False,
                  pipeline_depth: int = 2, verify_chunk: int = 0,
-                 mesh_devices: int = 0, coalesce_blocks: int = 0):
+                 mesh_devices: int = 0, coalesce_blocks: int = 0,
+                 host_stage_workers: int = 0, recode_device: bool = False,
+                 host_stage_mode: str = "thread"):
         self.id = node_id
         self.dir = data_dir
         self.msp = msp_manager
@@ -921,6 +928,9 @@ class PeerNode:
         self.verify_chunk = int(verify_chunk)
         self.mesh_devices = int(mesh_devices)
         self.coalesce_blocks = int(coalesce_blocks)
+        self.host_stage_workers = int(host_stage_workers)
+        self.recode_device = bool(recode_device)
+        self.host_stage_mode = host_stage_mode
         # install-surface admission (see _on_install): a size cap
         # always, and optionally an admin-signed request envelope
         self.max_package_size = int(max_package_size)
@@ -938,6 +948,10 @@ class PeerNode:
         from fabric_tpu.discovery import PeerRegistry
 
         self.registry = PeerRegistry()  # org → endorsing peers (gateway/discovery)
+        # strong refs to fire-and-forget background tasks: the event
+        # loop holds tasks weakly, so an unreferenced task can be GC'd
+        # mid-flight and its exception is lost
+        self._bg: set = set()
 
     # -- lifecycle install / package resolution ------------------------------
 
@@ -1092,6 +1106,9 @@ class PeerNode:
             verify_chunk=self.verify_chunk,
             mesh_devices=self.mesh_devices,
             coalesce_blocks=self.coalesce_blocks,
+            host_stage_workers=self.host_stage_workers,
+            recode_device=self.recode_device,
+            host_stage_mode=self.host_stage_mode,
         )
         ch.client_ssl = self.tls.client_ctx() if self.tls else None
         ch.runtime = self.runtime  # resolved-binding invalidation hook
@@ -1174,10 +1191,12 @@ class PeerNode:
             chan.transient.persist(result.tx_id, result.pvt_cleartext, chan.height)
             gsvc = getattr(self, "gossip_service", None)
             if gsvc is not None:
-                asyncio.ensure_future(gsvc.push_pvt(
+                t = asyncio.ensure_future(gsvc.push_pvt(
                     ch_hdr.channel_id, result.tx_id,
                     result.pvt_cleartext, chan.height,
                 ))
+                self._bg.add(t)
+                t.add_done_callback(self._bg.discard)
         return result.response.SerializeToString()
 
     async def _on_deliver_blocks(self, stream):

@@ -241,6 +241,9 @@ class BlockValidator:
         config_processor=None,
         verify_chunk: int = 0,
         mesh_devices: int = 0,
+        host_stage_workers: int = 0,
+        recode_device: bool = False,
+        host_stage_mode: str = "thread",
     ):
         self.msp = msp_manager
         self.policies = policy_provider
@@ -269,6 +272,47 @@ class BlockValidator:
             self.mesh = resolve_mesh(self.mesh_devices)
         else:
             self.mesh = None
+        # host staging pool (nodeconfig ``host_stage_workers``): the
+        # per-block HOST pipeline — envelope parse fan-out in
+        # preprocess_many, the per-signature admission + batch
+        # inversion + residue dgemm in prepare_cols (sharded along the
+        # lane axis at bucket boundaries), and device-path
+        # preprocessing overlapping the next block's parse — shards
+        # over a persistent worker pool.  0 = off (serial staging,
+        # CPU-only hosts pay nothing), -1 = one worker per core.
+        # Bit-equal to serial staging (every staged lane is
+        # lane-independent; pinned the way sharded ≡ single-device is).
+        self.host_stage_workers = int(host_stage_workers)
+        if self.host_stage_workers:
+            from fabric_tpu.parallel.hostpool import resolve_host_pool
+
+            if host_stage_mode == "process":
+                # the validator's staging is SHARED-MEMORY by design:
+                # workers write row slabs into preallocated arrays in
+                # place and the fan-out submits bound methods/closures
+                # — neither crosses a process boundary.  Process mode
+                # is for custom picklable staging workloads on a
+                # directly-constructed HostStagePool; here it would
+                # crash the first validated block, so coerce loudly.
+                _log.warning(
+                    "host_stage_mode='process' is not usable for the "
+                    "validator's in-place staging; using threads (the "
+                    "staging hot loops release the GIL)"
+                )
+                host_stage_mode = "thread"
+            self.host_pool = resolve_host_pool(
+                self.host_stage_workers, mode=host_stage_mode
+            )
+        else:
+            self.host_pool = None
+        # window recoding location (nodeconfig ``recode_device``):
+        # ship u1/u2 as 16-bit limbs and derive the 4-bit window digits
+        # in the stage-1 kernel — the packed H2D frame shrinks (window
+        # planes 4×), so pooled shards and mesh shards upload less per
+        # worker/chip.  Default False (host recode — the C ec_prepare
+        # path computes windows for free, and CPU-only hosts see no
+        # H2D bottleneck to shrink).  Bit-equal either way.
+        self.recode_device = bool(recode_device)
         # optional phase accumulator (seconds per phase, summed across
         # blocks) — the bench publishes it as the per-phase breakdown
         # artifact; None = no instrumentation overhead
@@ -281,6 +325,15 @@ class BlockValidator:
             "validator_stage_seconds",
             "per-block validator stage time (s), bench-breakdown stages",
         )
+
+    def close(self) -> None:
+        """Release validator-owned resources — the host staging pool's
+        worker threads outlive GC pins (bench result lists, channel
+        registries), so teardown paths must call this (PeerChannel.stop
+        does).  Idempotent."""
+        pool, self.host_pool = self.host_pool, None
+        if pool is not None:
+            pool.shutdown()
 
     def _t(self, key: str, t0: float) -> float:
         t1 = time.perf_counter()
@@ -913,7 +966,8 @@ class BlockValidator:
         txs, items, rwp, fb = self._parse(block)
         t0 = self._t("host_parse", t0)
         fetch = p256.verify_launch(
-            items, chunk=self.verify_chunk or None, mesh=self.mesh
+            items, chunk=self.verify_chunk or None, mesh=self.mesh,
+            pool=self.host_pool, recode_device=self.recode_device,
         )
         t0 = self._t("sig_prepare_launch", t0)
         dpre = self._device_preprocess(txs, rwp, fb)
@@ -939,6 +993,8 @@ class BlockValidator:
         blocks = list(blocks)
         if len(blocks) <= 1:
             return [self.preprocess(b) for b in blocks]
+        if self.host_pool is not None:
+            return self._preprocess_many_pooled(blocks)
         parsed = []
         for block in blocks:
             t0 = time.perf_counter()
@@ -947,7 +1003,7 @@ class BlockValidator:
         t0 = time.perf_counter()
         fetches = p256.verify_launch_many(
             [p[1] for p in parsed], chunk=self.verify_chunk or None,
-            mesh=self.mesh,
+            mesh=self.mesh, recode_device=self.recode_device,
         )
         self._t("sig_prepare_launch", t0)
         out = []
@@ -956,6 +1012,59 @@ class BlockValidator:
         ):
             t0 = time.perf_counter()
             dpre = self._device_preprocess(txs, rwp, fb)
+            t0 = self._t("device_pre", t0)
+            hd_bytes = protoutil.block_header_data_bytes(block)
+            self._t("hd_frame", t0)
+            out.append((txs, items, fetch, self.msp, dpre, fb, hd_bytes))
+        return out
+
+    def _preprocess_many_pooled(self, blocks: list) -> list:
+        """``preprocess_many`` with the host staging pool: every
+        block's parse fans out across the workers at once, and each
+        block's state-independent device preprocessing is submitted
+        the moment its own parse lands — so device_pre(k) overlaps
+        parse(k+1..) on the pool instead of serializing behind the
+        whole parse train.  The coalesced verify staging then shards
+        prepare_cols over the same pool inside verify_launch_many.
+
+        Every task is block-local (parse builds per-block objects;
+        _device_preprocess touches only its block's ParsedTx records —
+        the shared plan/row caches are append-only dict memos whose
+        worst concurrent case is a duplicated compute), so the pooled
+        result is the serial result, pinned by the DeviceToyValidator
+        battery in tests/test_multidevice.py.
+
+        Stage timings record the CALLER's critical-path wait (the time
+        the feeder actually stalls), like the pipeline's prefetch_wait;
+        the per-shard work itself rides
+        ``host_stage_pool_seconds{stage,worker}``."""
+        pool = self.host_pool
+        t0 = time.perf_counter()
+        parse_futs = [
+            pool.submit(self._parse, b, stage="host_parse")
+            for b in blocks
+        ]
+        parsed, dpre_futs = [], []
+        for f in parse_futs:
+            p = f.result()
+            parsed.append(p)
+            dpre_futs.append(pool.submit(
+                self._device_preprocess, p[0], p[2], p[3],
+                stage="device_pre",
+            ))
+        self._t("host_parse", t0)
+        t0 = time.perf_counter()
+        fetches = p256.verify_launch_many(
+            [p[1] for p in parsed], chunk=self.verify_chunk or None,
+            mesh=self.mesh, pool=pool, recode_device=self.recode_device,
+        )
+        t0 = self._t("sig_prepare_launch", t0)
+        out = []
+        for block, (txs, items, rwp, fb), fetch, df in zip(
+            blocks, parsed, fetches, dpre_futs
+        ):
+            t0 = time.perf_counter()
+            dpre = df.result()
             t0 = self._t("device_pre", t0)
             hd_bytes = protoutil.block_header_data_bytes(block)
             self._t("hd_frame", t0)

@@ -1,0 +1,93 @@
+"""Host staging pool unit battery (parallel/hostpool.py): knob
+resolution semantics, bucket-aligned lane sharding, ordered fan-out,
+error propagation, telemetry, and the process-mode smoke."""
+
+import os
+
+import pytest
+
+from fabric_tpu.parallel.hostpool import HostStagePool, resolve_host_pool
+
+
+def test_resolve_semantics():
+    # 0 = off; 1 = pointless (queue overhead, no parallelism)
+    assert resolve_host_pool(0) is None
+    assert resolve_host_pool(1) is None
+    cores = os.cpu_count() or 1
+    auto = resolve_host_pool(-1)
+    if cores >= 2:
+        assert auto is not None and auto.workers == cores
+        auto.shutdown()
+        p = resolve_host_pool(2)
+        assert p is not None and p.workers == 2
+        p.shutdown()
+        # clamped to the core count
+        big = resolve_host_pool(10_000)
+        assert big is not None and big.workers == cores
+        big.shutdown()
+    else:
+        assert auto is None
+
+
+def test_constructor_guards():
+    with pytest.raises(ValueError):
+        HostStagePool(1)
+    with pytest.raises(ValueError):
+        HostStagePool(2, mode="fork")
+
+
+def test_slice_bounds_bucket_aligned():
+    with HostStagePool(2) as p:
+        assert p.slice_bounds(0, align=16) == []
+        # every interior boundary is a multiple of align; the union
+        # covers [0, n) exactly with the tail absorbing the remainder
+        for n in (1, 15, 16, 17, 100, 128, 3072):
+            bounds = p.slice_bounds(n, align=16)
+            assert bounds[0][0] == 0 and bounds[-1][1] == n
+            for (a, b), (c, d) in zip(bounds, bounds[1:]):
+                assert b == c and b % 16 == 0
+            assert len(bounds) <= p.workers
+        # a sub-bucket batch stays one slice (serial fallback upstream)
+        assert p.slice_bounds(8, align=16) == [(0, 8)]
+
+
+def test_map_ordered_and_map_slices():
+    with HostStagePool(2) as p:
+        assert p.map(lambda x: x * x, range(20), stage="sq") == [
+            x * x for x in range(20)
+        ]
+        got = p.map_slices(100, lambda lo, hi: (lo, hi), align=16)
+        assert got[0][0] == 0 and got[-1][1] == 100
+        stats = p.stats()
+        assert stats["workers"] == 2 and stats["tasks"] >= 21
+        assert stats["per_shard_p50_ms"] >= 0.0
+
+
+def test_error_propagates():
+    def boom(x):
+        if x == 3:
+            raise RuntimeError("shard failed")
+        return x
+
+    with HostStagePool(2) as p:
+        with pytest.raises(RuntimeError, match="shard failed"):
+            p.map(boom, range(6))
+
+
+def test_telemetry_labels():
+    from fabric_tpu.ops_metrics import global_registry
+
+    with HostStagePool(2) as p:
+        p.map(lambda x: x, range(4), stage="unit_probe")
+    text = global_registry().render()
+    assert "host_stage_pool_seconds" in text
+    assert 'stage="unit_probe"' in text
+
+
+@pytest.mark.skipif((os.cpu_count() or 1) < 2, reason="needs 2 cores")
+def test_process_mode_smoke():
+    # spawn-context children re-import task functions by qualified
+    # name, so use a builtin (always importable in the child)
+    with HostStagePool(2, mode="process") as p:
+        assert p.map(abs, range(-4, 4)) == [abs(x) for x in range(-4, 4)]
+        assert p.stats()["mode"] == "process"

@@ -179,10 +179,13 @@ class DeviceToyValidator:
 
     VALID, BADSIG, DUP, MVCC = 0, 4, 2, 11
 
-    def __init__(self, state, mesh=None, chunk=0):
+    def __init__(self, state, mesh=None, chunk=0, pool=None,
+                 recode_device=False):
         self.state = state
         self.mesh = mesh
         self.chunk = int(chunk)
+        self.pool = pool
+        self.recode_device = bool(recode_device)
         self.coalesced_calls = 0
         self.launch_order = []
 
@@ -195,7 +198,8 @@ class DeviceToyValidator:
     def preprocess(self, block):
         raw, items = self._decode(block)
         fetch = v3.verify_launch(items, chunk=self.chunk or None,
-                                 mesh=self.mesh)
+                                 mesh=self.mesh, pool=self.pool,
+                                 recode_device=self.recode_device)
         return raw, fetch
 
     def preprocess_many(self, blocks):
@@ -203,7 +207,8 @@ class DeviceToyValidator:
         decoded = [self._decode(b) for b in blocks]
         fetches = v3.verify_launch_many(
             [items for _, items in decoded],
-            chunk=self.chunk or None, mesh=self.mesh,
+            chunk=self.chunk or None, mesh=self.mesh, pool=self.pool,
+            recode_device=self.recode_device,
         )
         return [(raw, f) for (raw, _), f in zip(decoded, fetches)]
 
@@ -280,9 +285,11 @@ def _device_stream(key, n_blocks=6, n_tx=8):
     return blocks
 
 
-def _run_device_pipe(blocks, depth, mesh=None, coalesce=0):
+def _run_device_pipe(blocks, depth, mesh=None, coalesce=0, pool=None,
+                     recode_device=False):
     state = MemVersionedDB()
-    v = DeviceToyValidator(state, mesh=mesh)
+    v = DeviceToyValidator(state, mesh=mesh, pool=pool,
+                           recode_device=recode_device)
     filters = []
 
     def commit_fn(res):
@@ -326,6 +333,72 @@ def test_sharded_coalesced_pipeline_matches_serial(key):
     for _, flt in f_serial:
         assert flt[2] == DeviceToyValidator.BADSIG
         assert DeviceToyValidator.VALID in flt
+
+
+def test_pooled_staging_pipeline_matches_serial(key):
+    """The host-staging acceptance gate: depth-2 CommitPipeline with
+    pooled host staging (2 workers), recode-on-device, the verify
+    dispatch sharded over the full 8-device mesh AND 3-block launch
+    coalescing must produce filters and final state identical to the
+    serial unpooled/unsharded/host-recode oracle.  The coalesced
+    3×bucket-16 concatenation pads to 64 lanes, so the pool really
+    shards (two 32-lane slabs per staging call)."""
+    from fabric_tpu.parallel.hostpool import HostStagePool
+
+    blocks = _device_stream(key, n_blocks=6, n_tx=8)
+    f_serial, s_serial, _ = _run_device_pipe(blocks, depth=1)
+    with HostStagePool(2) as pool:
+        f_pool, s_pool, v = _run_device_pipe(
+            blocks, depth=2, mesh=pmesh.resolve_mesh(8), coalesce=3,
+            pool=pool, recode_device=True,
+        )
+        stats = pool.stats()
+    assert f_pool == f_serial
+    assert s_pool == s_serial
+    assert v.coalesced_calls == 2
+    assert any(ov for _, ov in v.launch_order)  # depth-2 pipelined
+    assert stats["tasks"] > 0  # the pool actually staged shards
+    # device verdicts are load-bearing under pooling+recode too
+    for _, flt in f_pool:
+        assert flt[2] == DeviceToyValidator.BADSIG
+        assert DeviceToyValidator.VALID in flt
+
+
+def test_pooled_block_validator_preprocess_many(tmp_path):
+    """BlockValidator._preprocess_many_pooled (parse fan-out + pooled
+    device_pre + pooled coalesced staging) vs the serial
+    preprocess_many: identical filters and update batches through
+    validate_launch/finish.  Crypto-gated — the seed condition on
+    containers without the ``cryptography`` package."""
+    pytest.importorskip("cryptography")
+    from bench import _build_commit_network
+    from fabric_tpu.peer.validator import BlockValidator
+    from fabric_tpu.protos import common_pb2
+
+    (blocks, fresh_state, _fv, mgr, prov, _cc,
+     _ninv) = _build_commit_network(6, 2)
+
+    def run(workers, recode):
+        state = fresh_state()
+        v = BlockValidator(mgr, prov, state, host_stage_workers=workers,
+                           recode_device=recode)
+        out = []
+        copies = []
+        for blk in blocks:
+            b = common_pb2.Block()
+            b.CopyFrom(blk)
+            copies.append(b)
+        pres = v.preprocess_many(copies)
+        for b, pre in zip(copies, pres):
+            flt, batch, history = v.validate_finish(
+                v.validate_launch(b, pre=pre)
+            )
+            state.apply_updates(batch, (b.header.number, 0))
+            out.append((list(flt), sorted(batch.updates), history))
+        v.close()  # staging pool worker threads
+        return out
+
+    assert run(2, True) == run(0, False)
 
 
 def test_full_validator_sharded_block(tmp_path):

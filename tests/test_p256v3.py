@@ -220,6 +220,93 @@ def test_batch_inv_and_windows(rng):
         assert back == u
 
 
+def test_device_recode_matches_host_windows(rng):
+    """Recode-on-device bit-equality: the [B, 64] window digits the
+    stage-1 kernel derives from 16-bit scalar limbs must equal the
+    host ``_windows`` output for random scalars AND the edge cases
+    (0, 1, n−1, high-bit-set, all-ones) — the wire-form inverse
+    (``windows_to_limbs``) must round-trip too."""
+    us = [0, 1, ec_ref.N - 1, 1 << 255, (1 << 256) - 1, 15, 16] + [
+        int.from_bytes(rng.bytes(32), "big") for _ in range(25)
+    ]
+    host = v3._windows(us)
+    limbs = v3._limbs16(us)
+    assert limbs.dtype == np.int16 and limbs.shape == (len(us), 16)
+    dev = np.asarray(v3.device_recode_windows(jnp.asarray(limbs)))
+    assert np.array_equal(dev, host)
+    # the native ec_prepare path packs C-computed digits into limbs:
+    # digits → limbs → device digits must be the identity
+    assert np.array_equal(v3.windows_to_limbs(host), limbs)
+    # empty batch degenerates cleanly
+    assert v3._limbs16([]).shape == (0, 16)
+    assert v3.windows_to_limbs(np.zeros((0, 64), np.int32)).shape == (0, 16)
+
+
+def test_recode_device_launch_matches_host(keys, rng):
+    """verify_launch(recode_device=True) — the packed limb wire form +
+    on-device recoding — must reproduce the host-recoded accept set
+    bit for bit, with adversarial lanes load-bearing, and compose with
+    chunking and coalescing."""
+    items = []
+    for i in range(16):
+        k = keys[i % 3]
+        e = ec_ref.digest_int(rng.bytes(16))
+        r, s = k.sign_digest(e)
+        if i % 4 == 1:
+            s = ec_ref.N - s  # high-S reject lane
+        elif i % 4 == 3:
+            e = (e + 1) % (1 << 256)  # wrong digest
+        items.append((e, r, s, *k.public))
+    base = v3.verify_launch(items)()
+    assert any(base) and not all(base)
+    assert v3.verify_launch(items, recode_device=True)() == base
+    # prepared columns carry limbs, and the packed frame is smaller
+    n, cols = v3._to_cols(items)
+    args = v3.prepare_cols(*cols, pad_to=16, recode_device=True)
+    assert args[4].shape == (16, 16) and args[4].dtype == np.int16
+    assert v3._PKL_COLS < v3._PK_COLS
+    # composes with coalescing (per-block slices unchanged)
+    many = v3.verify_launch_many([items[:7], items[7:]],
+                                 recode_device=True)
+    assert many[0]() + many[1]() == base
+
+
+def test_pooled_prepare_cols_matches_serial(keys, rng):
+    """Host-pool-sharded staging must be BIT-equal to serial staging:
+    all eight prepare_cols outputs identical (admission flags, batch
+    inversion, window planes — host digits and device limbs alike —
+    residues, padding lanes), and the pooled launch's accept set
+    identical through the kernel."""
+    from fabric_tpu.parallel.hostpool import HostStagePool
+
+    items = []
+    for i in range(100):
+        k = keys[i % 3]
+        e = ec_ref.digest_int(rng.bytes(16))
+        r, s = k.sign_digest(e)
+        if i % 3 == 2:
+            s = ec_ref.N - s
+        items.append((e, r, s, *k.public))
+    n, cols = v3._to_cols(items)
+    with HostStagePool(2) as pool:
+        # shard boundaries land at MIN_BUCKET multiples
+        bounds = pool.slice_bounds(100, align=v3.MIN_BUCKET)
+        assert len(bounds) == 2 and bounds[0][1] % v3.MIN_BUCKET == 0
+        for recode in (False, True):
+            serial = v3.prepare_cols(*cols, pad_to=128,
+                                     recode_device=recode)
+            pooled = v3._prepare_cols_pooled(cols, 128, pool,
+                                             recode_device=recode)
+            for i, (a, b) in enumerate(zip(serial, pooled)):
+                a, b = np.asarray(a), np.asarray(b)
+                assert a.dtype == b.dtype and np.array_equal(a, b), i
+        # and through the kernel on a warm bucket-16 shape
+        base = v3.verify_launch(items[:16])()
+        assert v3.verify_launch(items[:16], pool=pool)() == base
+        assert v3.verify_launch(items[:16], pool=pool,
+                                recode_device=True)() == base
+
+
 def test_prepare_cols_native_matches_python():
     """The native ec_prepare (batch inversion + window recoding +
     admission flags in C) must be bit-exact with the Python prepare

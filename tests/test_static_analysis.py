@@ -22,6 +22,7 @@ from fabric_tpu.analysis.rules.swallowed_exception import (
 )
 from fabric_tpu.analysis.rules.kernel_dtype import KernelDtypeMismatchRule
 from fabric_tpu.analysis.rules.union_env import UnionEnvCoercionRule
+from fabric_tpu.analysis.rules.asyncio_task_leak import AsyncioTaskLeakRule
 
 
 def run_rule(tmp_path, rule, files: dict[str, str]):
@@ -863,6 +864,158 @@ class TestKernelDtypeMismatch:
         ) == []
 
 
+# -- FT008 asyncio-task-leak ------------------------------------------------
+
+BAD_TASK_LEAK = """\
+import asyncio
+
+
+async def fire(coro, other):
+    asyncio.ensure_future(coro())
+    t = asyncio.create_task(other())
+    return 1
+"""
+
+
+class TestAsyncioTaskLeak:
+    def test_flags_discard_and_dead_binding(self, tmp_path):
+        got = run_rule(
+            tmp_path, AsyncioTaskLeakRule(), {"mod.py": BAD_TASK_LEAK}
+        )
+        assert [(f.rule, f.path, f.line) for f in got] == [
+            ("FT008", "mod.py", 5),
+            ("FT008", "mod.py", 6),
+        ]
+        assert "discarded" in got[0].message
+        assert "'t'" in got[1].message
+
+    def test_stored_awaited_cancelled_clean(self, tmp_path):
+        src = """\
+        import asyncio
+
+
+        class Svc:
+            def __init__(self):
+                self._tasks = set()
+
+            def start(self, coro, loop_coro):
+                t = asyncio.ensure_future(coro())
+                self._tasks.add(t)
+                t.add_done_callback(self._tasks.discard)
+                self._main = asyncio.ensure_future(loop_coro())
+
+            async def run(self, coro):
+                t = asyncio.create_task(coro())
+                try:
+                    return await asyncio.wait_for(asyncio.shield(t), 1.0)
+                finally:
+                    if not t.done():
+                        t.cancel()
+        """
+        assert run_rule(
+            tmp_path, AsyncioTaskLeakRule(), {"mod.py": src}
+        ) == []
+
+    def test_cancel_in_nested_closure_clean(self, tmp_path):
+        # the strong ref lives in the outer scope; only a CLOSURE
+        # touches it — still not a leak
+        src = """\
+        import asyncio
+
+
+        def start(coro, stoppers):
+            t = asyncio.ensure_future(coro())
+
+            def stop():
+                t.cancel()
+
+            stoppers.append(stop)
+        """
+        assert run_rule(
+            tmp_path, AsyncioTaskLeakRule(), {"mod.py": src}
+        ) == []
+
+    def test_loop_var_and_chained_create_task_flagged(self, tmp_path):
+        src = """\
+        import asyncio
+
+
+        def kick(coro, other):
+            loop = asyncio.get_event_loop()
+            loop.create_task(coro())
+            asyncio.get_running_loop().create_task(other())
+        """
+        got = run_rule(
+            tmp_path, AsyncioTaskLeakRule(), {"mod.py": src}
+        )
+        assert [(f.rule, f.line) for f in got] == [
+            ("FT008", 6), ("FT008", 7),
+        ]
+
+    def test_from_import_rename_flagged(self, tmp_path):
+        src = """\
+        from asyncio import ensure_future as spawn
+
+
+        def kick(coro):
+            spawn(coro())
+        """
+        got = run_rule(
+            tmp_path, AsyncioTaskLeakRule(), {"mod.py": src}
+        )
+        assert len(got) == 1 and got[0].line == 5
+
+    def test_same_named_local_helper_not_matched(self, tmp_path):
+        # a project function that merely SHARES the spawner name must
+        # not be dragged in (import-aware gate, the FT003 lesson) —
+        # asyncio is imported for unrelated reasons
+        src = """\
+        import asyncio
+
+
+        def create_task(x):
+            return x
+
+
+        def sched(items):
+            create_task(items)
+            tracker = object()
+            tracker.create_task(items)
+        """
+        assert run_rule(
+            tmp_path, AsyncioTaskLeakRule(), {"mod.py": src}
+        ) == []
+
+    def test_passed_or_returned_clean(self, tmp_path):
+        src = """\
+        import asyncio
+
+
+        def start(coro, registry):
+            t = asyncio.ensure_future(coro())
+            registry.append(t)
+
+
+        def handoff(coro):
+            return asyncio.ensure_future(coro())
+        """
+        assert run_rule(
+            tmp_path, AsyncioTaskLeakRule(), {"mod.py": src}
+        ) == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        src = BAD_TASK_LEAK.replace(
+            "    asyncio.ensure_future(coro())",
+            "    asyncio.ensure_future(coro())  # fabtpu: noqa(FT008)",
+        ).replace(
+            "    t = asyncio.create_task(other())",
+            "    t = asyncio.create_task(other())  # fabtpu: noqa(FT008)",
+        )
+        assert run_rule(
+            tmp_path, AsyncioTaskLeakRule(), {"mod.py": src}
+        ) == []
+
+
 # -- engine plumbing --------------------------------------------------------
 
 
@@ -980,4 +1133,5 @@ def test_rule_battery_registered():
         "FT005": "swallowed-exception",
         "FT006": "union-env-coercion",
         "FT007": "kernel-dtype-mismatch",
+        "FT008": "asyncio-task-leak",
     }
