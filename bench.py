@@ -441,6 +441,37 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5,
         f"expected {expected_valid} valid, got {runs[0][1]}"
     )
 
+    # tracer cost + trace artifact: the runs above ran with the span
+    # tracer at its always-on default; FABTPU_BENCH_TRACE exports their
+    # flight recorder as Perfetto-loadable Chrome JSON, and a
+    # trace_ring_blocks=0 re-run measures the tracer's overhead so a
+    # regression in its cost is visible in BENCH_*.json
+    trace_extras = None
+    if invalid_frac == 0.0:
+        import os
+
+        from fabric_tpu import observe
+
+        tracer = observe.global_tracer()
+        trace_path = os.environ.get("FABTPU_BENCH_TRACE", "")
+        if trace_path:
+            tracer.export_chrome(trace_path)
+        prev_ring = tracer.ring_blocks
+        observe.configure(ring_blocks=0)
+        try:
+            # same sample count as the traced side (min-of-3): an
+            # asymmetric min would let run-to-run jitter masquerade as
+            # (often negative) tracer overhead
+            off_s = min(run_tpu()[0] for _ in range(3))
+        finally:
+            observe.configure(ring_blocks=prev_ring)
+        trace_extras = {
+            "trace_overhead_pct": round((tpu_s - off_s) / off_s * 100, 2),
+            "traced_s": round(tpu_s, 4),
+            "untraced_s": round(off_s, 4),
+            "ring_blocks": prev_ring,
+        }
+
     # per-phase breakdown artifact (ms/block of the fastest run) so the
     # next bottleneck is measured, not guessed; the mixed variant must
     # not clobber the clean run's file
@@ -502,6 +533,7 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5,
         "vs_baseline": round(tpu_rate / cpu_rate, 3),
         "per_block_ms": per_block_ms,
         "host_stage": host_stage,
+        "trace": trace_extras,
     }
 
 
@@ -664,6 +696,10 @@ def main():
         host_stage = result.pop("host_stage", None)
         if host_stage is not None:
             extras["host_stage"] = host_stage
+        trace = result.pop("trace", None)
+        if trace is not None:
+            extras["trace_overhead_pct"] = trace.pop("trace_overhead_pct")
+            extras["trace"] = trace
         try:
             mixed = _bench_block_commit(invalid_frac=0.1)
             extras["mixed_10pct_invalid"] = {
@@ -676,6 +712,7 @@ def main():
     else:
         result.pop("per_block_ms", None)
         result.pop("host_stage", None)
+        result.pop("trace", None)
     print(json.dumps(result))
 
 

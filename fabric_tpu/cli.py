@@ -146,6 +146,8 @@ async def _run_peer(cfg):
         host_stage_workers=cfg.host_stage_workers,
         recode_device=cfg.recode_device,
         host_stage_mode=cfg.host_stage_mode,
+        trace_ring_blocks=cfg.trace_ring_blocks,
+        trace_slow_factor=cfg.trace_slow_factor,
     )
     await node.start(operations_port=cfg.operations_port)
     print(f"peer {node.id} serving on :{node.port}", flush=True)

@@ -139,6 +139,18 @@ class PeerConfig:
     # ec_prepare path computes windows for free; CPU-only hosts have
     # no H2D frame worth shrinking).  Bit-equal either way.
     recode_device: bool = False
+    # block-commit span tracer (fabric_tpu/observe): flight-recorder
+    # ring holding the span trees of the last N committed blocks,
+    # served at /trace on the operations server and exportable as
+    # Chrome trace JSON (Perfetto).  Always-on and cheap (perf_counter
+    # pairs + one ring append per block); 0 disables tracing entirely
+    # (overhead measurement / paranoia).
+    trace_ring_blocks: int = 32
+    # slow-block watchdog: WARN with the full span breakdown when a
+    # block's submit→commit time exceeds this multiple of the trailing
+    # median (armed after 8 committed blocks); 0 disables the watchdog
+    # while keeping the flight recorder.
+    trace_slow_factor: float = 5.0
     # chaincode install surface (peer/node.py _on_install)
     max_package_size: int = DEFAULT_MAX_PACKAGE_SIZE
     install_require_admin: bool = False

@@ -1,0 +1,12 @@
+"""fabric_tpu.observe — block-commit span tracing (see tracer.py)."""
+
+from fabric_tpu.observe.tracer import (  # noqa: F401
+    DEFAULT_RING_BLOCKS,
+    DEFAULT_SLOW_FACTOR,
+    Span,
+    Tracer,
+    configure,
+    device_annotation,
+    format_block,
+    global_tracer,
+)

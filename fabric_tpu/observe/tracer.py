@@ -1,0 +1,430 @@
+"""Block-commit span tracer: flight recorder, Perfetto export,
+slow-block watchdog.
+
+The metrics registry (fabric_tpu.ops_metrics) answers *distribution*
+questions — ``commit_pipeline_stage_seconds`` says what finish usually
+costs — but cannot answer "why was block 4217 slow?" or "did
+device_pre(k) actually overlap parse(k+1)?".  This module records a
+per-block *timeline*: a tree of spans rooted at one span per committed
+block, crossing every thread the commit path touches (deliver feeder,
+prefetch thread, committer thread, host staging pool workers).
+
+Design constraints (the telemetry convention of this repo):
+
+* **always-on and cheap** — a span is a perf_counter pair plus one
+  list append; the only lock is taken once per block at finalize (ring
+  append + watchdog median).  ``trace_ring_blocks=0`` turns the whole
+  thing into no-ops for overhead measurement.
+* **explicit handles across threads** — contextvars do NOT follow
+  ThreadPoolExecutor tasks, so spans are passed (``parent=``) or
+  adopted (``attach``/``detach``) explicitly.  Each thread keeps a
+  thread-local *current* span; ``span()``/``add()`` default their
+  parent to it, so instrumented leaf code (validator stage timers,
+  pool workers) needs no plumbing — the pipeline attaches the right
+  parent at each thread boundary.
+* **dependency-free** — stdlib only; the optional
+  :func:`device_annotation` bridges to ``jax.profiler`` when jax is
+  importable so host spans line up with XLA timelines on real-TPU
+  runs.
+
+Three export surfaces:
+
+* :meth:`Tracer.export_chrome` — Chrome trace-event JSON, loadable in
+  Perfetto / ``chrome://tracing`` (one row per thread/worker);
+* the ``/trace`` endpoint on the operations server
+  (fabric_tpu.opsserver) serving the flight recorder as JSON trees;
+* ``scripts/traceview.py`` — a text waterfall for containers with no
+  browser.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from collections import deque
+
+_log = logging.getLogger("fabric_tpu.observe")
+
+#: defaults for the nodeconfig knobs (PeerConfig.trace_ring_blocks /
+#: trace_slow_factor) — one definition so config and tracer agree
+DEFAULT_RING_BLOCKS = 32
+DEFAULT_SLOW_FACTOR = 5.0
+
+#: watchdog arms only after this many committed blocks — the first
+#: blocks of a stream eat compiles and cache warms, and a median of two
+#: samples is noise
+_WATCHDOG_MIN_SAMPLES = 8
+
+_USE_CURRENT = object()  # sentinel: "parent argument not given"
+
+
+class Span:
+    """One timed region.  ``t0``/``t1`` are ``perf_counter`` seconds;
+    ``thread`` is the name of the thread that STARTED the span (the
+    Chrome row it renders on).  ``children`` appends are GIL-atomic, so
+    concurrent pool workers may add children to a shared parent without
+    a lock."""
+
+    __slots__ = ("name", "t0", "t1", "thread", "attrs", "children",
+                 "events")
+
+    def __init__(self, name: str, t0: float, thread: str, attrs: dict):
+        self.name = name
+        self.t0 = t0
+        self.t1: float | None = None
+        self.thread = thread
+        self.attrs = attrs
+        self.children: list[Span] = []
+        self.events: list[tuple] = []  # (name, t, attrs)
+
+    @property
+    def dur(self) -> float:
+        return (self.t1 if self.t1 is not None else self.t0) - self.t0
+
+    def to_dict(self, base: float) -> dict:
+        """JSON-able tree, times in ms relative to ``base``."""
+        d = {
+            "name": self.name,
+            "start_ms": round((self.t0 - base) * 1000.0, 3),
+            "dur_ms": round(self.dur * 1000.0, 3),
+            "thread": self.thread,
+        }
+        if self.attrs:
+            d["attrs"] = self.attrs
+        if self.events:
+            d["events"] = [
+                {"name": n, "at_ms": round((t - base) * 1000.0, 3),
+                 **({"attrs": a} if a else {})}
+                for n, t, a in self.events
+            ]
+        if self.children:
+            d["children"] = [c.to_dict(base) for c in self.children]
+        return d
+
+
+class _SpanCtx:
+    """Context manager for one live span: starts on __enter__, attaches
+    as the thread's current, restores + ends on __exit__.  A None span
+    (disabled tracer / no parent) makes every step a no-op."""
+
+    __slots__ = ("_tracer", "_name", "_parent", "_attrs", "_span", "_tok")
+
+    def __init__(self, tracer, name, parent, attrs):
+        self._tracer = tracer
+        self._name = name
+        self._parent = parent
+        self._attrs = attrs
+
+    def __enter__(self):
+        sp = self._tracer.start(self._name, self._parent, **self._attrs)
+        self._span = sp
+        self._tok = self._tracer.attach(sp) if sp is not None else None
+        return sp
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._span is not None:
+            self._tracer.detach(self._tok)
+            self._tracer.end(self._span)
+        return False
+
+
+class Tracer:
+    """Span recorder + bounded flight recorder + slow-block watchdog.
+
+    One process-global instance (:func:`global_tracer`) backs the
+    production commit path; tests construct their own.  ``clock`` is
+    injectable so watchdog behavior is testable without sleeping.
+    """
+
+    def __init__(self, ring_blocks: int = DEFAULT_RING_BLOCKS,
+                 slow_factor: float = DEFAULT_SLOW_FACTOR,
+                 clock=time.perf_counter):
+        self.clock = clock
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self.configure(ring_blocks=ring_blocks, slow_factor=slow_factor)
+
+    def configure(self, ring_blocks: int | None = None,
+                  slow_factor: float | None = None) -> None:
+        """Re-size the flight recorder / re-arm the watchdog; recent
+        trees survive a resize (truncated to the new capacity)."""
+        with self._lock:
+            if ring_blocks is not None:
+                self.ring_blocks = int(ring_blocks)
+                old = list(getattr(self, "_ring", ()))
+                cap = max(1, self.ring_blocks)
+                self._ring: deque = deque(old[-cap:], maxlen=cap)
+                self._slow: deque = deque(
+                    list(getattr(self, "_slow", ())), maxlen=16
+                )
+                self._durs: deque = deque(
+                    list(getattr(self, "_durs", ())), maxlen=128
+                )
+            if slow_factor is not None:
+                self.slow_factor = float(slow_factor)
+
+    @property
+    def enabled(self) -> bool:
+        return self.ring_blocks > 0
+
+    # -- recording (hot path: no locks) ------------------------------------
+
+    def begin_block(self, number: int, **attrs):
+        """Root span for one block's trip through the commit pipeline
+        (submit → commit complete).  Returns None when disabled — every
+        other method tolerates a None span/parent as a no-op."""
+        if not self.enabled:
+            return None
+        attrs["block"] = int(number)
+        return Span("block", self.clock(),
+                    threading.current_thread().name, attrs)
+
+    def start(self, name: str, parent, **attrs):
+        """Explicit span start under ``parent`` (a handle passed across
+        a thread boundary).  None parent → no-op (returns None)."""
+        if parent is None:
+            return None
+        sp = Span(name, self.clock(), threading.current_thread().name,
+                  attrs)
+        parent.children.append(sp)
+        return sp
+
+    def end(self, span) -> None:
+        if span is not None:
+            span.t1 = self.clock()
+
+    def span(self, name: str, parent=_USE_CURRENT, **attrs) -> _SpanCtx:
+        """``with tracer.span("launch", parent=root):`` — the span
+        becomes the thread's *current* for its extent, so nested
+        ``add()``/``span()`` calls with no explicit parent land under
+        it.  Default parent is the thread's current span."""
+        if parent is _USE_CURRENT:
+            parent = self.current()
+        return _SpanCtx(self, name, parent, attrs)
+
+    def add(self, name: str, t0: float, t1: float, parent=_USE_CURRENT,
+            **attrs) -> None:
+        """Record an already-measured span [t0, t1] (retro form for
+        code that times stages anyway, e.g. BlockValidator._t)."""
+        if parent is _USE_CURRENT:
+            parent = self.current()
+        if parent is None:
+            return
+        sp = Span(name, t0, threading.current_thread().name, attrs)
+        sp.t1 = t1
+        parent.children.append(sp)
+
+    def event(self, name: str, parent=_USE_CURRENT, **attrs) -> None:
+        """Zero-duration annotation (barrier redo, stale-prefetch
+        re-parse, coalesced-group membership)."""
+        if parent is _USE_CURRENT:
+            parent = self.current()
+        if parent is None:
+            return
+        parent.events.append((name, self.clock(), attrs))
+
+    @staticmethod
+    def set_attrs(span, **attrs) -> None:
+        if span is not None:
+            span.attrs.update(attrs)
+
+    # -- thread-local current span -----------------------------------------
+
+    def attach(self, span):
+        """Adopt ``span`` as this thread's current; returns a token for
+        :meth:`detach`.  This is how a pool/executor task inherits the
+        submitting thread's span across the thread boundary."""
+        prev = getattr(self._local, "cur", None)
+        self._local.cur = span
+        return prev
+
+    def detach(self, token) -> None:
+        self._local.cur = token
+
+    def current(self):
+        return getattr(self._local, "cur", None)
+
+    # -- finalize: ring + watchdog (the one lock per block) ----------------
+
+    def finish_block(self, root) -> None:
+        if root is None:
+            return
+        if root.t1 is None:
+            root.t1 = self.clock()
+        dur = root.dur
+        slow = False
+        with self._lock:
+            self._ring.append(root)
+            durs = self._durs
+            if (len(durs) >= _WATCHDOG_MIN_SAMPLES
+                    and self.slow_factor > 0):
+                med = sorted(durs)[len(durs) // 2]
+                if med > 0 and dur > self.slow_factor * med:
+                    slow = True
+                    self._slow.append(root)
+            durs.append(dur)
+        if slow:
+            root.attrs["slow"] = True
+            from fabric_tpu.ops_metrics import global_registry
+
+            global_registry().counter(
+                "trace_slow_blocks_total",
+                "blocks flagged by the slow-block watchdog",
+            ).add(1, channel=str(root.attrs.get("channel", "")))
+            _log.warning(
+                "slow block %s: %.1f ms (> %.1fx trailing median "
+                "%.1f ms)\n%s",
+                root.attrs.get("block"), dur * 1000.0, self.slow_factor,
+                med * 1000.0, format_block(root),
+            )
+
+    # -- readers (flight recorder) -----------------------------------------
+
+    def blocks(self, n: int | None = None) -> list[dict]:
+        """Most recent block trees (oldest first), as JSON-able dicts."""
+        with self._lock:
+            roots = list(self._ring)
+        if n is not None:
+            roots = roots[-n:]
+        return [self._root_dict(r) for r in roots]
+
+    def block(self, number: int) -> dict | None:
+        with self._lock:
+            roots = list(self._ring)
+        for r in reversed(roots):
+            if r.attrs.get("block") == number:
+                return self._root_dict(r)
+        return None
+
+    def slow_blocks(self) -> list[dict]:
+        with self._lock:
+            roots = list(self._slow)
+        return [self._root_dict(r) for r in roots]
+
+    @staticmethod
+    def _root_dict(root) -> dict:
+        d = root.to_dict(root.t0)
+        d["block"] = root.attrs.get("block")
+        return d
+
+    # -- Chrome trace-event export -----------------------------------------
+
+    def chrome_events(self) -> list[dict]:
+        """Flight recorder → Chrome trace-event list ("X" complete
+        events + "i" instants + thread_name metadata), one tid per
+        thread/worker name so Perfetto renders one row each."""
+        with self._lock:
+            roots = list(self._ring)
+        tids: dict[str, int] = {}
+        events: list[dict] = []
+
+        def tid(name: str) -> int:
+            t = tids.get(name)
+            if t is None:
+                t = tids[name] = len(tids) + 1
+            return t
+
+        def walk(sp: Span, block: int) -> None:
+            events.append({
+                "name": sp.name, "cat": "fabtpu", "ph": "X",
+                "ts": sp.t0 * 1e6,
+                "dur": max(0.0, sp.dur) * 1e6,
+                "pid": 0, "tid": tid(sp.thread),
+                "args": {"block": block, **sp.attrs},
+            })
+            for n, t, a in sp.events:
+                events.append({
+                    "name": n, "cat": "fabtpu", "ph": "i", "s": "t",
+                    "ts": t * 1e6, "pid": 0, "tid": tid(sp.thread),
+                    "args": {"block": block, **a},
+                })
+            for c in sp.children:
+                walk(c, block)
+
+        for root in roots:
+            walk(root, int(root.attrs.get("block", -1)))
+        meta = [
+            {"name": "thread_name", "ph": "M", "pid": 0, "tid": t,
+             "args": {"name": n}}
+            for n, t in tids.items()
+        ]
+        return meta + events
+
+    def export_chrome(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.chrome_events(),
+                       "displayTimeUnit": "ms"}, f)
+
+
+def format_block(root) -> str:
+    """Compact indented breakdown of one block tree — the watchdog's
+    WARN payload (scripts/traceview.py renders the richer waterfall)."""
+    base = root.t0
+    lines: list[str] = []
+
+    def walk(sp: Span, depth: int) -> None:
+        lines.append(
+            "%s%-24s %8.2f ms @ %7.2f ms  [%s]" % (
+                "  " * depth, sp.name, sp.dur * 1000.0,
+                (sp.t0 - base) * 1000.0, sp.thread,
+            )
+        )
+        for n, t, _a in sp.events:
+            lines.append("%s! %s @ %.2f ms" % (
+                "  " * (depth + 1), n, (t - base) * 1000.0,
+            ))
+        for c in sp.children:
+            walk(c, depth + 1)
+
+    walk(root, 0)
+    return "\n".join(lines)
+
+
+_global = Tracer()
+
+
+def global_tracer() -> Tracer:
+    return _global
+
+
+def configure(ring_blocks: int | None = None,
+              slow_factor: float | None = None) -> Tracer:
+    """Configure the process-global tracer (the nodeconfig knobs
+    ``trace_ring_blocks`` / ``trace_slow_factor`` land here)."""
+    _global.configure(ring_blocks=ring_blocks, slow_factor=slow_factor)
+    return _global
+
+
+class _NullCtx:
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CTX = _NullCtx()
+_jax_annotation = None
+
+
+def device_annotation(name: str):
+    """Optional jax.profiler.TraceAnnotation around a device dispatch —
+    when a jax profiler trace is being captured (real-TPU runs), the
+    host-side dispatch spans line up with the XLA timeline.  No-op (and
+    import-free after the first call) when jax is unavailable."""
+    global _jax_annotation
+    if _jax_annotation is None:
+        try:
+            from jax.profiler import TraceAnnotation
+
+            _jax_annotation = TraceAnnotation
+        except Exception as e:  # no jax in this interpreter
+            _log.debug("jax profiler annotations unavailable: %s", e)
+            _jax_annotation = False
+    if _jax_annotation is False:
+        return _NULL_CTX
+    return _jax_annotation(name)

@@ -588,7 +588,8 @@ def _assemble_cols(c: SigCollector):
 
 
 def prepare_cols(digest_b, r_b, s_b, qx_res, qy_res, pub_ok,
-                 pad_to: int | None = None, recode_device: bool = False):
+                 pad_to: int | None = None, recode_device: bool = False,
+                 out=None):
     """Column-form host preparation: same outputs (and accept set) as
     ``prepare`` but residues come from one dgemm over the byte columns
     and cached identity rows; only the admission checks and the
@@ -597,18 +598,43 @@ def prepare_cols(digest_b, r_b, s_b, qx_res, qy_res, pub_ok,
     ``recode_device``: skip host window recoding — the w1/w2 slots of
     the returned tuple carry [B, 16] int16 scalar LIMBS instead of
     [B, 64] digits, for the ``verify_batch_packed_limbs`` kernel that
-    derives the digits on device (4× less H2D for the window planes)."""
+    derives the digits on device (4× less H2D for the window planes).
+
+    ``out``: optional 8-tuple of preallocated destinations (qx, qy,
+    r_res, rpn_res, w1, w2, rpn_ok, pre_ok) with leading dim == the
+    padded batch — every staged lane writes IN PLACE (the native
+    ec_prepare digit planes and the residue dgemm land directly in the
+    caller's row slabs), and the pad tail is zeroed.  This is how the
+    pooled workers (``_prepare_cols_pooled``) avoid the
+    allocate-then-copy that made pooled host-recode copy-bound; the
+    result is bit-equal to the allocating form (tests/test_p256v3.py).
+    Returns ``out`` when given, fresh arrays otherwise."""
     import ctypes
 
     B0 = len(r_b)
     Bp = pad_to if pad_to is not None else max(B0, 1)
-    pre_ok = np.zeros(Bp, bool)
-    rpn_ok = np.zeros(Bp, bool)
+    if out is not None:
+        o_qx, o_qy, _o_r, o_rpn, o_w1, o_w2, o_rpn_ok, o_pre = out
+        if len(o_pre) != Bp:
+            raise ValueError(
+                f"out arrays must have leading dim {Bp}, got {len(o_pre)}"
+            )
+        if Bp != B0:
+            for a in out:  # pad tail = all-zero rejected lanes
+                a[B0:] = 0
+        o_qx[:B0] = qx_res
+        o_qy[:B0] = qy_res
+        pre_ok, rpn_ok = o_pre, o_rpn_ok
+    else:
+        o_w1 = o_w2 = None
+        pre_ok = np.zeros(Bp, bool)
+        rpn_ok = np.zeros(Bp, bool)
     full = lambda a: np.concatenate(
         [a, np.zeros((Bp - B0,) + a.shape[1:], a.dtype)]
     ) if Bp != B0 else a
 
     w1 = w2 = None
+    done = False
     if B0:
         try:
             from fabric_tpu.native import ecprep_lib
@@ -624,8 +650,16 @@ def prepare_cols(digest_b, r_b, s_b, qx_res, qy_res, pub_ok,
             eb = np.ascontiguousarray(digest_b)
             rb = np.ascontiguousarray(r_b)
             sb = np.ascontiguousarray(s_b)
-            w1 = np.zeros((B0, STEPS), np.int32)
-            w2 = np.zeros((B0, STEPS), np.int32)
+            direct = False
+            if out is not None and not recode_device:
+                # C writes the digit planes straight into the
+                # destination slabs (row-slab views stay contiguous)
+                w1, w2 = o_w1[:B0], o_w2[:B0]
+                direct = (w1.flags.c_contiguous and w2.flags.c_contiguous
+                          and w1.dtype == np.int32)
+            if not direct:
+                w1 = np.zeros((B0, STEPS), np.int32)
+                w2 = np.zeros((B0, STEPS), np.int32)
             flags = np.zeros(B0, np.uint8)
             ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)
             lib.ec_prepare(
@@ -638,14 +672,20 @@ def prepare_cols(digest_b, r_b, s_b, qx_res, qy_res, pub_ok,
                 # the C path hands back digits; pack them to limbs so
                 # the wire form (and kernel) match the Python lane
                 w1, w2 = windows_to_limbs(w1), windows_to_limbs(w2)
-            w1, w2 = full(w1), full(w2)
+            if out is not None:
+                if not direct:
+                    o_w1[:B0] = w1
+                    o_w2[:B0] = w2
+            else:
+                w1, w2 = full(w1), full(w2)
+            done = True
 
-    if w1 is None:  # pure-Python fallback (no toolchain)
+    if B0 and not done:  # pure-Python fallback (no toolchain)
         ebuf, rbuf, sbuf = digest_b.tobytes(), r_b.tobytes(), s_b.tobytes()
         es = [int.from_bytes(ebuf[32 * i:32 * i + 32], "big") for i in range(B0)]
         rints = [int.from_bytes(rbuf[32 * i:32 * i + 32], "big") for i in range(B0)]
         sints = [int.from_bytes(sbuf[32 * i:32 * i + 32], "big") for i in range(B0)]
-        ss = [1] * Bp
+        ss = [1] * B0
         for i, (r, s) in enumerate(zip(rints, sints)):
             pre_ok[i] = bool(pub_ok[i]) and 0 < r < N and 0 < s <= HALF_N
             rpn_ok[i] = (r + N) < P
@@ -653,15 +693,26 @@ def prepare_cols(digest_b, r_b, s_b, qx_res, qy_res, pub_ok,
         s_inv = _batch_inv_mod_n(ss)
         u1s = [(e * si) % N for e, si in zip(es, s_inv)]
         u2s = [(r * si) % N for r, si in zip(rints, s_inv)]
-        u1s += [0] * (Bp - B0)
-        u2s += [0] * (Bp - B0)
-        if recode_device:
-            w1, w2 = _limbs16(u1s), _limbs16(u2s)
+        w1, w2 = ((_limbs16(u1s), _limbs16(u2s)) if recode_device
+                  else (_windows(u1s), _windows(u2s)))
+        if out is not None:
+            o_w1[:B0] = w1
+            o_w2[:B0] = w2
         else:
-            w1, w2 = _windows(u1s), _windows(u2s)
+            w1, w2 = full(w1), full(w2)
+    elif not B0 and out is None:
+        wcols = _PK_LIMBS if recode_device else STEPS
+        wdt = np.int16 if recode_device else np.int32
+        w1 = np.zeros((Bp, wcols), wdt)
+        w2 = np.zeros((Bp, wcols), wdt)
 
     primes = np.array(rns.BASE_A + rns.BASE_B, np.int32)
     n_res = rns._to_res(N, rns.BASE_A + rns.BASE_B)
+    if out is not None:
+        rv = rns.bytes_to_rns(r_b, out=_o_r[:B0]) if B0 else _o_r[:0]
+        np.mod(rv + n_res[None, :], primes, out=o_rpn[:B0])
+        o_rpn[~rpn_ok] = 0
+        return out
     r_res = full(rns.bytes_to_rns(r_b))
     rpn_res = (r_res + n_res[None, :]) % primes
     rpn_res[~rpn_ok] = 0
@@ -794,9 +845,11 @@ def _prepare_cols_pooled(cols, pad_to, pool, recode_device: bool = False):
     Pinned by tests/test_p256v3.py.
 
     The full-size output arrays are preallocated HERE and each worker
-    writes its own row slab in place — a gather-then-concatenate would
-    serialize a multi-MB memcpy behind the parallel work (measured
-    ~6 ms on a 3072-lane batch, most of the win)."""
+    stages its row slab IN PLACE through ``prepare_cols(out=...)`` —
+    the admission flags, digit planes and residue dgemm land directly
+    in the slab views, so no worker allocates shard outputs and then
+    copies them over (the allocate-then-copy made pooled host-recode
+    copy-bound on small hosts: one full extra frame copy per batch)."""
     B0 = len(cols[1])
     bounds = pool.slice_bounds(B0, align=MIN_BUCKET)
     if len(bounds) <= 1:
@@ -818,10 +871,9 @@ def _prepare_cols_pooled(cols, pad_to, pool, recode_device: bool = False):
     )
 
     def stage(lo, hi):
-        res = prepare_cols(*(c[lo:hi] for c in cols),
-                           recode_device=recode_device)
-        for dst, src in zip(out, res):
-            dst[lo:hi] = src
+        prepare_cols(*(c[lo:hi] for c in cols),
+                     recode_device=recode_device,
+                     out=tuple(d[lo:hi] for d in out))
 
     pool.map_slices(B0, stage, stage="sig_prepare", align=MIN_BUCKET)
     return out
@@ -836,6 +888,18 @@ def _h2d_hist():
         buckets=(1 << 14, 1 << 16, 1 << 18, 1 << 20, 1 << 22,
                  float("inf")),
     )
+
+
+def _trc():
+    from fabric_tpu.observe import global_tracer
+
+    return global_tracer()
+
+
+def _dev_ann(name: str):
+    from fabric_tpu.observe import device_annotation
+
+    return device_annotation(name)
 
 
 class VerifyHandle:
@@ -929,7 +993,10 @@ def _launch_chunked(n_real: int, chunk: int, stage_fn) -> VerifyHandle:
         pad = chunk if off + k < n_real else total - off
         t0 = time.perf_counter()
         out = stage_fn(off, off + k, pad)
-        stage_hist.observe(time.perf_counter() - t0, stage="stage_dispatch")
+        t1 = time.perf_counter()
+        stage_hist.observe(t1 - t0, stage="stage_dispatch")
+        # per-chunk span on the block timeline (no-op off traced paths)
+        _trc().add("verify_chunk", t0, t1, chunk=n_chunks, lanes=int(k))
         outs.append(out)
         off += k
         n_chunks += 1
@@ -963,14 +1030,18 @@ def _launch_cols(n_real, cols, chunk, mesh, pool, recode_device):
             args = _stage_prepare(cols, lo, hi, pad, pool, recode_device)
             packed = _pack_launch(args, recode_device, pool=pool)
             _h2d_hist().observe(packed.nbytes, recode=rc)
-            return kern(_shard(mesh, packed))
+            with _dev_ann("fabtpu.verify_dispatch"):
+                return kern(_shard(mesh, packed))
 
         return _launch_chunked(n_real, chunk, stage)
     args = _stage_prepare(cols, 0, n_real, _bucket(n_real), pool,
                           recode_device)
     packed = _pack_launch(args, recode_device, pool=pool)
     _h2d_hist().observe(packed.nbytes, recode=rc)
-    out = kern(_shard(mesh, packed))
+    # the TraceAnnotation lines this dispatch up with the XLA timeline
+    # when a jax profiler capture is running (real-TPU rounds)
+    with _dev_ann("fabtpu.verify_dispatch"):
+        out = kern(_shard(mesh, packed))
     if hasattr(out, "copy_to_host_async"):
         out.copy_to_host_async()
     return VerifyHandle(out, n_real)
@@ -1036,7 +1107,8 @@ def verify_launch(items, chunk: int | None = None, mesh=None, pool=None,
     args = prepare(items, pad_to=_bucket(n_real))
     if mesh is not None:
         args = tuple(_shard(mesh, a) for a in args)
-    out = verify_batch_jit(*args)  # async under jax's deferred execution
+    with _dev_ann("fabtpu.verify_dispatch"):
+        out = verify_batch_jit(*args)  # async under deferred execution
     if hasattr(out, "copy_to_host_async"):
         # start the D2H as soon as compute finishes: device→host
         # readback latency is substantial on tunneled devices and must

@@ -381,17 +381,25 @@ def ints_to_rns(xs) -> np.ndarray:
     return (acc.astype(np.int64) % primes).astype(np.int32)
 
 
-def bytes_to_rns(be: np.ndarray) -> np.ndarray:
+def bytes_to_rns(be: np.ndarray, out: np.ndarray | None = None) -> np.ndarray:
     """[B, 32] uint8 BIG-endian 256-bit values → [B, 2n] canonical
     residues — the zero-Python-int fast lane for values the native
     pre-parser already delivers as byte arrays (r, s, digests).  Same
-    f64 dgemm as ints_to_rns; bytes reverse to little-endian limbs."""
+    f64 dgemm as ints_to_rns; bytes reverse to little-endian limbs.
+
+    ``out``: optional [B, 2n] int32 destination written in place (the
+    pooled staging path hands row-slab views here so the residues land
+    directly in the preallocated launch columns); returned either way."""
     if not len(be):
-        return np.zeros((0, 2 * N_CH), np.int32)
+        return out if out is not None else np.zeros((0, 2 * N_CH), np.int32)
     le = be[:, ::-1].astype(np.float64)  # [B, 32] little-endian limbs
     acc = le @ _pow8_table()[:32]  # [B, 2n] exact in f64
     primes = np.array(BASE_A + BASE_B, np.int64)
-    return (acc.astype(np.int64) % primes).astype(np.int32)
+    res = acc.astype(np.int64) % primes
+    if out is not None:
+        out[:] = res  # same values, cast into the caller's int32 slab
+        return out
+    return res.astype(np.int32)
 
 
 def to_rns(x: int) -> RV:

@@ -36,7 +36,15 @@ class Counter:
             self._values[k] = self._values.get(k, 0.0) + delta
 
     def value(self, **labels) -> float:
-        return self._values.get(_label_key(labels), 0.0)
+        # under the registry lock: an unlocked read can observe a dict
+        # mid-resize from a concurrent add() on another thread
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def snapshot(self) -> dict[tuple, float]:
+        """Consistent copy of every label variant (render//trace)."""
+        with self._lock:
+            return dict(self._values)
 
 
 class Gauge:
@@ -55,7 +63,12 @@ class Gauge:
             self._values[k] = self._values.get(k, 0.0) + delta
 
     def value(self, **labels) -> float:
-        return self._values.get(_label_key(labels), 0.0)
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def snapshot(self) -> dict[tuple, float]:
+        with self._lock:
+            return dict(self._values)
 
 
 _DEFAULT_BUCKETS = (
@@ -90,6 +103,26 @@ class Histogram:
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     h.counts[i] += 1
+
+    def value(self, **labels) -> dict | None:
+        """Locked read of ONE label variant: {"counts" (cumulative per
+        bucket), "sum", "count"} or None if never observed.  Histograms
+        had no read accessor at all before — reaching into ``_values``
+        raced ``observe`` mid-update (counts bumped, total not yet)."""
+        with self._lock:
+            h = self._values.get(_label_key(labels))
+            if h is None:
+                return None
+            return {"counts": list(h.counts), "sum": h.total, "count": h.n}
+
+    def snapshot(self) -> dict[tuple, dict]:
+        """Consistent copy of every label variant (render//trace)."""
+        with self._lock:
+            return {
+                k: {"counts": list(h.counts), "sum": h.total,
+                    "count": h.n}
+                for k, h in self._values.items()
+            }
 
     def time(self, **labels):
         """Context manager observing elapsed seconds."""
@@ -143,34 +176,46 @@ class Registry:
             parts.append(extra)
         return "{" + ",".join(parts) + "}" if parts else ""
 
-    def render(self) -> str:
-        out = []
+    def metric(self, name: str):
+        """Registered instrument by name, or None (locked lookup — the
+        /trace summary reads selected metrics through their locked
+        snapshot() accessors rather than reaching into ``_values``)."""
         with self._lock:
-            for name, m in sorted(self._metrics.items()):
-                if m.help:
-                    out.append(f"# HELP {name} {m.help}")
-                if isinstance(m, Counter):
-                    out.append(f"# TYPE {name} counter")
-                    for k, v in sorted(m._values.items()):
-                        out.append(f"{name}{self._fmt_labels(k)} {v}")
-                elif isinstance(m, Gauge):
-                    out.append(f"# TYPE {name} gauge")
-                    for k, v in sorted(m._values.items()):
-                        out.append(f"{name}{self._fmt_labels(k)} {v}")
-                elif isinstance(m, Histogram):
-                    out.append(f"# TYPE {name} histogram")
-                    for k, h in sorted(m._values.items()):
-                        for b, c in zip(m.buckets, h.counts):
-                            le = "+Inf" if math.isinf(b) else repr(b)
-                            # hoisted: a backslash inside an f-string
-                            # expression is a SyntaxError before 3.12
-                            le_label = 'le="%s"' % le
-                            out.append(
-                                f"{name}_bucket"
-                                f"{self._fmt_labels(k, le_label)} {c}"
-                            )
-                        out.append(f"{name}_sum{self._fmt_labels(k)} {h.total}")
-                        out.append(f"{name}_count{self._fmt_labels(k)} {h.n}")
+            return self._metrics.get(name)
+
+    def render(self) -> str:
+        # take the registry lock only to copy the metric table; each
+        # instrument's snapshot() then takes the (same, non-reentrant)
+        # lock itself — so render sees per-metric-consistent values
+        # without racing concurrent observe()/add() mid-update
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        out = []
+        for name, m in metrics:
+            if m.help:
+                out.append(f"# HELP {name} {m.help}")
+            if isinstance(m, Counter):
+                out.append(f"# TYPE {name} counter")
+                for k, v in sorted(m.snapshot().items()):
+                    out.append(f"{name}{self._fmt_labels(k)} {v}")
+            elif isinstance(m, Gauge):
+                out.append(f"# TYPE {name} gauge")
+                for k, v in sorted(m.snapshot().items()):
+                    out.append(f"{name}{self._fmt_labels(k)} {v}")
+            elif isinstance(m, Histogram):
+                out.append(f"# TYPE {name} histogram")
+                for k, h in sorted(m.snapshot().items()):
+                    for b, c in zip(m.buckets, h["counts"]):
+                        le = "+Inf" if math.isinf(b) else repr(b)
+                        # hoisted: a backslash inside an f-string
+                        # expression is a SyntaxError before 3.12
+                        le_label = 'le="%s"' % le
+                        out.append(
+                            f"{name}_bucket"
+                            f"{self._fmt_labels(k, le_label)} {c}"
+                        )
+                    out.append(f"{name}_sum{self._fmt_labels(k)} {h['sum']}")
+                    out.append(f"{name}_count{self._fmt_labels(k)} {h['count']}")
         return "\n".join(out) + "\n"
 
 

@@ -46,10 +46,16 @@ class OperationsServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  registry: Registry | None = None,
-                 health: HealthRegistry | None = None):
+                 health: HealthRegistry | None = None,
+                 tracer=None):
         self.host, self.port = host, port
         self.registry = registry or global_registry()
         self.health = health or HealthRegistry()
+        if tracer is None:
+            from fabric_tpu.observe import global_tracer
+
+            tracer = global_tracer()
+        self.tracer = tracer  # /trace: the block-commit flight recorder
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self):
@@ -140,9 +146,64 @@ class OperationsServer:
                     return 400, "application/json", json.dumps(
                         {"error": str(e)}
                     ).encode()
+        if path == "/trace" or path.startswith("/trace?"):
+            return self._route_trace(path)
         if path.startswith("/debug/"):
             return self._route_debug(path)
         return 404, "application/json", b'{"error": "not found"}'
+
+    #: histograms the /trace summary reads (through the locked
+    #: snapshot accessors) next to the span trees
+    TRACE_SUMMARY_METRICS = (
+        "commit_pipeline_stage_seconds",
+        "commit_pipeline_overlap_ratio",
+        "validator_stage_seconds",
+        "host_stage_pool_seconds",
+    )
+
+    def _route_trace(self, path: str):
+        """Flight-recorder surface (fabric_tpu.observe): ``/trace``
+        serves recent slow blocks (plus the most recent trees and an
+        aggregate-stage summary); ``/trace?block=N`` serves one block's
+        full span tree."""
+        from urllib.parse import parse_qs, urlparse
+
+        q = parse_qs(urlparse(path).query)
+        if "block" in q:
+            try:
+                num = int(q["block"][0])
+            except ValueError:
+                return 400, "application/json", b'{"error": "bad block"}'
+            tree = self.tracer.block(num)
+            if tree is None:
+                return 404, "application/json", json.dumps(
+                    {"error": f"block {num} not in the flight recorder"}
+                ).encode()
+            return 200, "application/json", json.dumps(tree).encode()
+
+        summary = {}
+        for name in self.TRACE_SUMMARY_METRICS:
+            m = self.registry.metric(name)
+            if m is None or not hasattr(m, "snapshot"):
+                continue
+            summary[name] = {
+                ",".join(f"{k}={v}" for k, v in key) or "_": {
+                    "count": s["count"],
+                    "sum_s": round(s["sum"], 6),
+                }
+                for key, s in sorted(m.snapshot().items())
+            }
+        ring = self.tracer.blocks()
+        payload = {
+            "enabled": self.tracer.enabled,
+            "ring_blocks": self.tracer.ring_blocks,
+            "slow_factor": self.tracer.slow_factor,
+            "slow_blocks": self.tracer.slow_blocks(),
+            "recent_blocks": ring[-4:],
+            "blocks_in_ring": [b.get("block") for b in ring],
+            "summary": summary,
+        }
+        return 200, "application/json", json.dumps(payload).encode()
 
     def _route_debug(self, path: str):
         """Live profiling surface (the reference's peer.profile pprof

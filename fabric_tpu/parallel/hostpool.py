@@ -54,6 +54,12 @@ def _pool_hist():
     )
 
 
+def _pool_tracer():
+    from fabric_tpu.observe import global_tracer
+
+    return global_tracer()
+
+
 class HostStagePool:
     """Persistent staging worker pool (see module docstring).
 
@@ -85,6 +91,7 @@ class HostStagePool:
                 self.workers, thread_name_prefix="fabtpu-hoststage"
             )
         self._hist = _pool_hist()
+        self._trc = _pool_tracer()
         # recent per-task durations for the bench's host_stage
         # sub-breakdown (p50 per shard) — bounded, lock-guarded
         self._durs: deque = deque(maxlen=1024)
@@ -99,16 +106,23 @@ class HostStagePool:
             self._durs.append(dt)
             self._tasks += 1
 
-    def _timed(self, fn, stage: str):
+    def _timed(self, fn, stage: str, parent):
         """Wrap ``fn`` to observe its duration from INSIDE the worker
-        (thread mode) so the worker label names the executing slot."""
+        (thread mode) so the worker label names the executing slot.
+        ``parent`` is the SUBMITTING thread's current tracer span,
+        captured at submit time — the worker adopts it so its task
+        span lands in the right block tree (the explicit cross-thread
+        handoff; thread-locals do not follow executor tasks)."""
+        trc = self._trc
+
         def run(*args, **kwargs):
+            name = threading.current_thread().name
+            worker = name.rsplit("_", 1)[-1] if "_" in name else name
             t0 = time.perf_counter()
             try:
-                return fn(*args, **kwargs)
+                with trc.span(stage, parent=parent, worker=worker):
+                    return fn(*args, **kwargs)
             finally:
-                name = threading.current_thread().name
-                worker = name.rsplit("_", 1)[-1] if "_" in name else name
                 self._observe(stage, worker, time.perf_counter() - t0)
         return run
 
@@ -124,7 +138,9 @@ class HostStagePool:
                                         time.perf_counter() - t0)
             )
             return fut
-        return self._ex.submit(self._timed(fn, stage), *args, **kwargs)
+        return self._ex.submit(
+            self._timed(fn, stage, self._trc.current()), *args, **kwargs
+        )
 
     def map(self, fn, items, stage: str = "task") -> list:
         """Ordered parallel map: fan every item out, gather in order.

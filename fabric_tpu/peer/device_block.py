@@ -219,7 +219,12 @@ class DeviceBlockPipeline:
                 shard_batch(mesh, jnp.asarray(launch_vec))]
         args += [shard_batch(mesh, gp) for _, gp, _, _ in groups]
         args += [shard_batch(mesh, static_packed)]
-        packed = fn(*args)
+        from fabric_tpu.observe import device_annotation
+
+        # lines the fused stage-2 dispatch up with the XLA timeline
+        # when a jax profiler capture is running (real-TPU rounds)
+        with device_annotation("fabtpu.stage2_dispatch"):
+            packed = fn(*args)
         if hasattr(packed, "copy_to_host_async"):
             packed.copy_to_host_async()
         self._dispatch_hist.observe(time.perf_counter() - t0)

@@ -60,8 +60,19 @@ class PeerChannel:
                  verify_chunk: int = 0, mesh_devices: int = 0,
                  coalesce_blocks: int = 0, host_stage_workers: int = 0,
                  recode_device: bool = False,
-                 host_stage_mode: str = "thread"):
+                 host_stage_mode: str = "thread",
+                 trace_ring_blocks: int | None = None,
+                 trace_slow_factor: float | None = None):
         self.id = channel_id
+        # block-commit span tracer knobs (nodeconfig trace_ring_blocks
+        # / trace_slow_factor): configure the process-global tracer the
+        # CommitPipeline, validator stage timers, host pool workers and
+        # the operations server's /trace endpoint all share
+        from fabric_tpu import observe
+
+        observe.configure(ring_blocks=trace_ring_blocks,
+                          slow_factor=trace_slow_factor)
+        self.tracer = observe.global_tracer()
         # commit-path knobs (nodeconfig pipeline_depth / verify_chunk /
         # coalesce_blocks): depth 2 = CommitPipeline overlap on the
         # deliver loop, 1 = strict serial commit_block per block;
@@ -247,13 +258,17 @@ class PeerChannel:
         return flt
 
     async def _commit_inner(self, block, txs, flt, batch, history,
-                            hd_bytes) -> None:
+                            hd_bytes, root=None) -> None:
         """Validated triple → committed ledger state: pvt-data phase,
         ledger commit + fsync, post-commit bookkeeping.  The caller
         holds the commit writer lock; ``txs`` are the block's parsed
         records (under pipelining ``validator.last_parsed`` already
         points at the NEXT launched block, so they ride in
-        explicitly)."""
+        explicitly).
+
+        ``root``: the block's tracer root span, passed EXPLICITLY —
+        this coroutine runs on the event-loop thread, where the
+        pipeline committer thread's span attachment cannot follow."""
         # pvt phase (StoreBlock, coordinator.go:190-220): cleartext
         # from transient/pull, hash-verified, into pvt namespaces
         from fabric_tpu.peer.transient import encode_kv
@@ -287,11 +302,15 @@ class PeerChannel:
         # interleave transactions on one connection.  The pipeline's
         # overlap is unaffected — the NEXT block validates on the
         # feeder thread while this runs.
-        self.ledger.commit_block(
-            block, flt, batch, history, pvt_data=pvt_store,
-            txids=[(p.txid, p.idx) for p in txs if p.txid],
-            hd_bytes=hd_bytes,
-        )
+        from fabric_tpu.observe import global_tracer
+
+        tracer = global_tracer()
+        with tracer.span("ledger_commit", parent=root):
+            self.ledger.commit_block(
+                block, flt, batch, history, pvt_data=pvt_store,
+                txids=[(p.txid, p.idx) for p in txs if p.txid],
+                hd_bytes=hd_bytes,
+            )
         if pvt.missing:
             self.ledger.pvtdata.commit_block(
                 block.header.number, {},
@@ -306,7 +325,8 @@ class PeerChannel:
         # height / commit status, so an acknowledged block can never
         # be lost to a crash on a quiet channel (the add-block-time
         # lag check only runs while traffic flows)
-        self.ledger.blocks.sync()
+        with tracer.span("fsync", parent=root):
+            self.ledger.blocks.sync()
         self._post_commit(block, flt, batch, txs)
 
     def _commit_metrics(self, flt: bytes, validate_s: float,
@@ -351,7 +371,7 @@ class PeerChannel:
         async with self.commit_lock.writer():
             await self._commit_inner(
                 res.block, res.pend.txs, res.tx_filter, res.batch,
-                res.history, res.pend.hd_bytes,
+                res.history, res.pend.hd_bytes, root=res.root_span,
             )
         commit_s = _time.perf_counter() - t0
         # launch + finish ≈ the serial path's validate span, so a
@@ -651,7 +671,7 @@ class PeerChannel:
         pipe = CommitPipeline(
             self.validator, commit_fn, depth=self.pipeline_depth,
             pre_launch_fn=self.verify_block_signature, channel=self.id,
-            coalesce_blocks=self.coalesce_blocks,
+            coalesce_blocks=self.coalesce_blocks, tracer=self.tracer,
         )
         # submit() blocks for device syncs and for the committer
         # thread — feeding from the shared default executor could
@@ -916,7 +936,9 @@ class PeerNode:
                  pipeline_depth: int = 2, verify_chunk: int = 0,
                  mesh_devices: int = 0, coalesce_blocks: int = 0,
                  host_stage_workers: int = 0, recode_device: bool = False,
-                 host_stage_mode: str = "thread"):
+                 host_stage_mode: str = "thread",
+                 trace_ring_blocks: int | None = None,
+                 trace_slow_factor: float | None = None):
         self.id = node_id
         self.dir = data_dir
         self.msp = msp_manager
@@ -931,6 +953,9 @@ class PeerNode:
         self.host_stage_workers = int(host_stage_workers)
         self.recode_device = bool(recode_device)
         self.host_stage_mode = host_stage_mode
+        # span-tracer knobs (None = leave the global tracer as-is)
+        self.trace_ring_blocks = trace_ring_blocks
+        self.trace_slow_factor = trace_slow_factor
         # install-surface admission (see _on_install): a size cap
         # always, and optionally an admin-signed request envelope
         self.max_package_size = int(max_package_size)
@@ -1109,6 +1134,8 @@ class PeerNode:
             host_stage_workers=self.host_stage_workers,
             recode_device=self.recode_device,
             host_stage_mode=self.host_stage_mode,
+            trace_ring_blocks=self.trace_ring_blocks,
+            trace_slow_factor=self.trace_slow_factor,
         )
         ch.client_ssl = self.tls.client_ctx() if self.tls else None
         ch.runtime = self.runtime  # resolved-binding invalidation hook

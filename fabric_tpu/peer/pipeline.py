@@ -66,6 +66,11 @@ class CommittedBlock:
     barrier: bool = False
     # filled by the pipeline for telemetry (seconds)
     stage_s: dict = field(default_factory=dict)
+    # this block's tracer root span (fabric_tpu.observe) — commit_fn
+    # implementations hang their ledger-commit/fsync spans off it
+    # explicitly (the commit may hop to an event-loop thread, where
+    # the committer thread's span attachment cannot follow)
+    root_span: object = None
 
     @property
     def txids(self) -> list:
@@ -137,9 +142,19 @@ class CommitPipeline:
 
     def __init__(self, validator, commit_fn, depth: int = 2,
                  prefetch_fn=None, pre_launch_fn=None, registry=None,
-                 channel: str = "", coalesce_blocks: int = 0):
+                 channel: str = "", coalesce_blocks: int = 0,
+                 tracer=None):
         self.validator = validator
         self.commit_fn = commit_fn
+        if tracer is None:
+            from fabric_tpu.observe import global_tracer
+
+            tracer = global_tracer()
+        # span tracer (fabric_tpu.observe): one root span per block
+        # (submit → commit complete) with prefetch/launch/finish/commit
+        # children across the three threads — the flight recorder and
+        # /trace read what this records
+        self.tracer = tracer
         # the overlay mechanism covers exactly ONE in-flight
         # predecessor, so useful depths are 1 (serial) and 2
         self.depth = 1 if depth <= 1 else 2
@@ -180,8 +195,9 @@ class CommitPipeline:
         self._committer = ThreadPoolExecutor(
             1, thread_name_prefix="fabtpu-committer"
         )
-        self._pre: tuple | None = None       # (block, prefetch Future)
+        self._pre: tuple | None = None   # (block, prefetch Future, root)
         self._launched = None                # PendingBlock in flight
+        self._launched_root = None           # its tracer root span
         self._commit_fut: Future | None = None
         self._overlay = None
         self._extra = None
@@ -247,7 +263,13 @@ class CommitPipeline:
         # parse + device verify launch overlap the predecessor's
         # device sync below
         assert self._pre is None, "submit() before the previous returned"
-        self._pre = (block, self._prefetch.submit(self.prefetch_fn, block))
+        root = self.tracer.begin_block(block.header.number,
+                                       channel=self.channel)
+        self._pre = (
+            block,
+            self._prefetch.submit(self._prefetch_traced, block, root),
+            root,
+        )
         self._inflight_gauge.set(self.inflight, channel=self.channel)
 
         out = None
@@ -255,6 +277,28 @@ class CommitPipeline:
             out = self._finish_and_commit(self._launched)
         self._launch_next(out.stage_s if out is not None else {}, t_sub)
         return out
+
+    def _prefetch_traced(self, block, root):
+        """Prefetch-thread task: the explicit span handle crosses the
+        executor boundary here (contextvars would not), and the span's
+        attachment makes the validator's parse/device_pre stage timers
+        and any host-pool worker tasks nest under it."""
+        with self.tracer.span("prefetch", parent=root):
+            return self.prefetch_fn(block)
+
+    def _prefetch_many_traced(self, group, root, n):
+        with self.tracer.span("prefetch", parent=root, coalesced=n):
+            return self._prefetch_many_fn(group)
+
+    def _commit_traced(self, res, root):
+        """Committer-thread task: commit under its span, then finalize
+        the block's root — ring append + slow-block watchdog run here,
+        off the caller thread's critical path."""
+        try:
+            with self.tracer.span("commit", parent=root):
+                self.commit_fn(res)
+        finally:
+            self.tracer.finish_block(root)
 
     def submit_many(self, blocks) -> list:
         """Feed several height-ordered blocks, coalescing their verify
@@ -286,8 +330,21 @@ class CommitPipeline:
             # ONE prefetch-thread call stages every block in the group
             # and launches their signature batches as one coalesced
             # device dispatch; each block then takes the normal path
-            # on its own slice of the device output
-            fut = self._prefetch.submit(self._prefetch_many_fn, group)
+            # on its own slice of the device output.  The group's
+            # prefetch span hangs off the LEADER's root; every member
+            # root records its membership so /trace shows which blocks
+            # shared the dispatch.
+            lead = group[0].header.number
+            roots = []
+            for b in group:
+                r = self.tracer.begin_block(b.header.number,
+                                            channel=self.channel)
+                self.tracer.set_attrs(r, coalesce_group=int(lead),
+                                      coalesce_size=len(group))
+                roots.append(r)
+            fut = self._prefetch.submit(
+                self._prefetch_many_traced, group, roots[0], len(group)
+            )
             # barrier taint: the WHOLE group was staged just now, so a
             # barrier committing anywhere during this loop (an in-group
             # config/lifecycle block, or the previous group's tail
@@ -302,7 +359,7 @@ class CommitPipeline:
                 assert self._pre is None, (
                     "submit_many() before the previous returned"
                 )
-                self._pre = (block, _SliceFuture(fut, j))
+                self._pre = (block, _SliceFuture(fut, j), roots[j])
                 self._inflight_gauge.set(self.inflight,
                                          channel=self.channel)
                 res = None
@@ -329,21 +386,25 @@ class CommitPipeline:
             self._launched = None
         if self._pre is not None:
             # a prefetched block with no successor: run it serially
-            block, fut = self._pre
+            block, fut, root = self._pre
             self._pre = None
             pre = fut.result()
             if self._stale_prefetch:
                 # prefetched before its barrier predecessor committed
                 self._stale_prefetch = False
-                pre = self.prefetch_fn(block)
-            if self.pre_launch_fn is not None:
-                self.pre_launch_fn(block)
-            t0 = time.perf_counter()
-            pend = self.validator.validate_launch(
-                block, pre=pre, overlay=self._overlay,
-                extra_txids=self._extra,
-            )
-            self._launch_s = time.perf_counter() - t0
+                self.tracer.event("stale_prefetch_reparse", parent=root)
+                with self.tracer.span("re-prefetch", parent=root):
+                    pre = self.prefetch_fn(block)
+            with self.tracer.span("launch", parent=root):
+                if self.pre_launch_fn is not None:
+                    self.pre_launch_fn(block)
+                t0 = time.perf_counter()
+                pend = self.validator.validate_launch(
+                    block, pre=pre, overlay=self._overlay,
+                    extra_txids=self._extra,
+                )
+                self._launch_s = time.perf_counter() - t0
+            self._launched_root = root
             out = self._finish_and_commit(pend, tail=True)
         if self._commit_fut is not None:
             self._commit_fut.result()
@@ -357,20 +418,29 @@ class CommitPipeline:
         return out
 
     def _submit_serial(self, block) -> CommittedBlock:
+        tr = self.tracer
+        root = tr.begin_block(block.header.number, channel=self.channel,
+                              mode="serial")
         t0 = time.perf_counter()
-        if self.pre_launch_fn is not None:
-            self.pre_launch_fn(block)
-        pend = self.validator.validate_launch(
-            block, pre=self.prefetch_fn(block)
-        )
-        flt, batch, history = self.validator.validate_finish(pend)
+        with tr.span("launch", parent=root):
+            if self.pre_launch_fn is not None:
+                self.pre_launch_fn(block)
+            with tr.span("prefetch"):  # inline in serial mode
+                pre = self.prefetch_fn(block)
+            pend = self.validator.validate_launch(block, pre=pre)
+        with tr.span("finish", parent=root):
+            flt, batch, history = self.validator.validate_finish(pend)
         t1 = time.perf_counter()
         res = CommittedBlock(
             block=block, pend=pend, tx_filter=flt, batch=batch,
             history=history, barrier=_is_barrier(pend, batch),
-            stage_s={"finish": t1 - t0},
+            stage_s={"finish": t1 - t0}, root_span=root,
         )
-        self.commit_fn(res)
+        try:
+            with tr.span("commit", parent=root):
+                self.commit_fn(res)
+        finally:
+            tr.finish_block(root)
         res.stage_s["commit_wait"] = time.perf_counter() - t1
         self._blocks_ctr.add(1, channel=self.channel, mode="serial")
         return res
@@ -380,19 +450,24 @@ class CommitPipeline:
         ledger commit, then either commit inline (barrier) or hand the
         commit to the committer thread and expose the batch as the
         successor's overlay."""
+        root = self._launched_root
+        self._launched_root = None
         t0 = time.perf_counter()
-        flt, batch, history = self.validator.validate_finish(pend)
+        with self.tracer.span("finish", parent=root):
+            flt, batch, history = self.validator.validate_finish(pend)
         t1 = time.perf_counter()
         if self._commit_fut is not None:
             self._commit_fut.result()  # ledger commits stay in order
             self._commit_fut = None
         t2 = time.perf_counter()
+        self.tracer.add("commit_wait", t1, t2, parent=root)
         barrier = _is_barrier(pend, batch)
         res = CommittedBlock(
             block=pend.block, pend=pend, tx_filter=flt, batch=batch,
             history=history, barrier=barrier,
             stage_s={"launch": self._launch_s, "finish": t1 - t0,
                      "commit_wait": t2 - t1},
+            root_span=root,
         )
         self._launch_s = 0.0
         self._stage_hist.observe(t1 - t0, channel=self.channel,
@@ -403,12 +478,21 @@ class CommitPipeline:
             # barrier: rotated validation inputs must be fully
             # committed (and the overlay dropped) before any launch;
             # tail: nothing left to overlap with
-            self.commit_fn(res)
+            self.tracer.set_attrs(
+                root, **({"barrier": True} if barrier else {"tail": True})
+            )
+            try:
+                with self.tracer.span("commit", parent=root):
+                    self.commit_fn(res)
+            finally:
+                self.tracer.finish_block(root)
             self._overlay = self._extra = None
             if barrier:
                 self._stale_prefetch = True
         else:
-            self._commit_fut = self._committer.submit(self.commit_fn, res)
+            self._commit_fut = self._committer.submit(
+                self._commit_traced, res, root
+            )
             self._overlay, self._extra = batch, pend.txids
         self._blocks_ctr.add(
             1, channel=self.channel,
@@ -418,7 +502,7 @@ class CommitPipeline:
         return res
 
     def _launch_next(self, prev_stage_s: dict, t_sub: float) -> None:
-        block, fut = self._pre
+        block, fut, root = self._pre
         self._pre = None
         t0 = time.perf_counter()
         pre = fut.result()  # host parse ran while the device synced
@@ -431,16 +515,22 @@ class CommitPipeline:
             # Redo the parse against post-barrier state; barriers are
             # rare, the serial redo is the correctness price.
             self._stale_prefetch = False
-            pre = self.prefetch_fn(block)
+            self.tracer.event("stale_prefetch_reparse", parent=root)
+            with self.tracer.span("re-prefetch", parent=root):
+                pre = self.prefetch_fn(block)
         t1 = time.perf_counter()
-        if self.pre_launch_fn is not None:
-            # caller thread, AFTER any predecessor barrier flushed —
-            # the node verifies orderer block signatures here against
-            # the post-rotation bundle
-            self.pre_launch_fn(block)
-        self._launched = self.validator.validate_launch(
-            block, pre=pre, overlay=self._overlay, extra_txids=self._extra
-        )
+        self.tracer.add("prefetch_wait", t0, t1, parent=root)
+        with self.tracer.span("launch", parent=root):
+            if self.pre_launch_fn is not None:
+                # caller thread, AFTER any predecessor barrier flushed —
+                # the node verifies orderer block signatures here
+                # against the post-rotation bundle
+                self.pre_launch_fn(block)
+            self._launched = self.validator.validate_launch(
+                block, pre=pre, overlay=self._overlay,
+                extra_txids=self._extra,
+            )
+        self._launched_root = root
         t2 = time.perf_counter()
         self._launch_s = t2 - t1
         self._inflight_gauge.set(self.inflight, channel=self.channel)

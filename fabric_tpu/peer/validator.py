@@ -325,6 +325,13 @@ class BlockValidator:
             "validator_stage_seconds",
             "per-block validator stage time (s), bench-breakdown stages",
         )
+        # the span tracer mirrors the same stages onto the per-block
+        # timeline: _t records each stage under whatever span the
+        # calling thread is attached to (the pipeline's prefetch/
+        # launch/finish spans), so no span handles thread through here
+        from fabric_tpu.observe import global_tracer
+
+        self._tracer = global_tracer()
 
     def close(self) -> None:
         """Release validator-owned resources — the host staging pool's
@@ -340,6 +347,7 @@ class BlockValidator:
         if self.timings is not None:
             self.timings[key] = self.timings.get(key, 0.0) + (t1 - t0)
         self._stage_hist.observe(t1 - t0, stage=key)
+        self._tracer.add(key, t0, t1)  # no-op off the traced paths
         return t1
 
     def warmup(self, n_sigs: int = 16) -> None:
@@ -1197,10 +1205,12 @@ class BlockValidator:
         self._materialize_for_host(txs, fb)
         # phase 1a: one batched ECDSA verify for the whole block —
         # the host path's ONE intended device sync
+        t0 = time.perf_counter()
         sig_valid = (
             np.asarray(fetch(), bool)  # fabtpu: noqa(FT003)
             if items else np.zeros(0, bool)
         )
+        self._t("device_wait", t0)
 
         for ptx in txs:
             if ptx.undetermined and ptx.creator_item_idx >= 0:

@@ -1,0 +1,424 @@
+"""Block-commit span tracer tests (fabric_tpu.observe): span-tree
+shape through a real depth-2 CommitPipeline run over the crypto-free
+DeviceToyValidator, ring-buffer eviction, slow-block watchdog, Chrome
+trace-event schema, cross-thread span adoption (host pool workers),
+the /trace operations-server endpoint, the locked ops_metrics read
+accessors, and the traceview text waterfall."""
+
+import asyncio
+import json
+import logging
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+# scripts/ is not a package: make traceview importable for its tests
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                    "scripts")
+)
+
+from fabric_tpu import observe
+from fabric_tpu.observe import Span, Tracer  # noqa: F401
+from fabric_tpu.ledger.statedb import MemVersionedDB
+from fabric_tpu.peer.pipeline import CommitPipeline
+
+
+class _Clock:
+    """Deterministic perf_counter stand-in for watchdog tests."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# core span mechanics
+
+
+def test_span_nesting_and_thread_local_current():
+    tr = Tracer(ring_blocks=4, slow_factor=0)
+    root = tr.begin_block(1, channel="c")
+    with tr.span("launch", parent=root) as sp:
+        # the launch span became this thread's current: a parentless
+        # retro add() lands under it (how validator._t plugs in)
+        assert tr.current() is sp
+        tr.add("state_fill", 0.0, 0.001)
+        tr.event("note", detail="x")
+    assert tr.current() is None
+    tr.finish_block(root)
+    (tree,) = tr.blocks()
+    assert tree["block"] == 1
+    (launch,) = tree["children"]
+    assert launch["name"] == "launch"
+    assert [c["name"] for c in launch["children"]] == ["state_fill"]
+    assert launch["events"][0]["name"] == "note"
+
+
+def test_disabled_tracer_is_noop():
+    tr = Tracer(ring_blocks=0)
+    assert not tr.enabled
+    root = tr.begin_block(5)
+    assert root is None
+    with tr.span("x", parent=root) as sp:
+        assert sp is None
+        tr.add("y", 0.0, 1.0)  # parentless: dropped
+        tr.event("z")
+    tr.finish_block(root)
+    assert tr.blocks() == [] and tr.slow_blocks() == []
+
+
+def test_explicit_handle_crosses_executor_threads():
+    """contextvars don't follow ThreadPoolExecutor tasks — the span
+    handle passed + attach() is the supported crossing."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    tr = Tracer(ring_blocks=4, slow_factor=0)
+    root = tr.begin_block(2)
+
+    def task():
+        assert tr.current() is None  # nothing followed implicitly
+        tok = tr.attach(root)
+        try:
+            with tr.span("worker-stage"):
+                pass
+        finally:
+            tr.detach(tok)
+        return threading.current_thread().name
+
+    with ThreadPoolExecutor(1, thread_name_prefix="tw") as ex:
+        worker_name = ex.submit(task).result()
+    tr.finish_block(root)
+    (child,) = root.children
+    assert child.name == "worker-stage" and child.thread == worker_name
+
+
+def test_ring_eviction():
+    tr = Tracer(ring_blocks=2, slow_factor=0)
+    for n in range(3):
+        tr.finish_block(tr.begin_block(n))
+    assert [b["block"] for b in tr.blocks()] == [1, 2]
+    assert tr.block(0) is None
+    assert tr.block(2)["block"] == 2
+
+
+def test_watchdog_flags_slow_block(caplog):
+    clk = _Clock()
+    tr = Tracer(ring_blocks=32, slow_factor=3.0, clock=clk)
+    for n in range(9):  # arm the median (8+ samples) at 10 ms/block
+        root = tr.begin_block(n)
+        clk.advance(0.010)
+        tr.finish_block(root)
+    assert tr.slow_blocks() == []
+    with caplog.at_level(logging.WARNING, logger="fabric_tpu.observe"):
+        root = tr.begin_block(9)
+        with tr.span("finish", parent=root):
+            clk.advance(0.500)  # 50x the trailing median
+        tr.finish_block(root)
+    (slow,) = tr.slow_blocks()
+    assert slow["block"] == 9 and slow["attrs"]["slow"] is True
+    assert any("slow block 9" in r.getMessage()
+               and "finish" in r.getMessage()
+               for r in caplog.records)
+    # a watchdog of 0 never flags
+    clk2 = _Clock()
+    tr2 = Tracer(ring_blocks=32, slow_factor=0, clock=clk2)
+    for n in range(12):
+        root = tr2.begin_block(n)
+        clk2.advance(10.0 if n == 11 else 0.01)
+        tr2.finish_block(root)
+    assert tr2.slow_blocks() == []
+
+
+def test_configure_resize_keeps_recent_trees():
+    tr = Tracer(ring_blocks=8, slow_factor=0)
+    for n in range(5):
+        tr.finish_block(tr.begin_block(n))
+    tr.configure(ring_blocks=2)
+    assert [b["block"] for b in tr.blocks()] == [3, 4]
+    tr.configure(ring_blocks=0)
+    assert not tr.enabled and tr.begin_block(9) is None
+
+
+# ---------------------------------------------------------------------------
+# the real thing: a depth-2 pipelined run over the device toy validator
+
+
+@pytest.fixture(scope="module")
+def toy_run():
+    """One depth-2 CommitPipeline run (5 blocks, real device verifies,
+    bad-sig lanes) captured by a fresh tracer."""
+    from test_multidevice import DeviceToyValidator, _device_stream
+    from fabric_tpu.crypto import ec_ref
+
+    tr = Tracer(ring_blocks=16, slow_factor=0)
+    key = ec_ref.SigningKey.generate()
+    blocks = _device_stream(key, n_blocks=5, n_tx=8)
+    state = MemVersionedDB()
+    v = DeviceToyValidator(state)
+    filters = []
+
+    def commit_fn(res):
+        state.apply_updates(res.batch, (res.block.header.number, 0))
+        filters.append((res.block.header.number, list(res.tx_filter)))
+
+    with CommitPipeline(v, commit_fn, depth=2, tracer=tr) as pipe:
+        for b in blocks:
+            pipe.submit(b)
+    return tr, sorted(filters)
+
+
+def test_pipeline_span_tree_shape(toy_run):
+    """Every committed block leaves one finalized tree whose
+    prefetch/launch/finish/commit children are complete, nested inside
+    the root's window, and placed on the right threads."""
+    tr, filters = toy_run
+    assert len(filters) == 5  # nothing lost to tracing
+    roots = list(tr._ring)
+    assert [r.attrs["block"] for r in roots] == [0, 1, 2, 3, 4]
+    for r in roots:
+        names = [c.name for c in r.children]
+        for want in ("prefetch", "prefetch_wait", "launch", "finish",
+                     "commit_wait", "commit"):
+            assert names.count(want) == 1, (r.attrs, want, names)
+        for c in r.children:
+            assert c.t1 is not None, (r.attrs, c.name)
+            assert c.t0 >= r.t0 - 1e-6 and c.t1 <= r.t1 + 1e-6
+        by = {c.name: c for c in r.children}
+        # prefetch ran on the prefetch thread; pipelined commits on the
+        # committer thread (the tail flushes inline on the caller)
+        assert by["prefetch"].thread.startswith("fabtpu-prefetch")
+        if "tail" not in r.attrs:
+            assert by["commit"].thread.startswith("fabtpu-committer")
+        # stage order within the block: launch → finish → commit
+        assert by["launch"].t0 <= by["finish"].t0 <= by["commit"].t0
+    # the tail block is annotated as such
+    assert roots[-1].attrs.get("tail") is True
+
+
+def test_pipeline_overlap_visible(toy_run):
+    """The depth-2 win on the timeline: block k+1's prefetch begins
+    while block k is still in flight (strictly before k's commit
+    completes) — impossible under depth-1, where root k finalizes
+    before submit(k+1) runs."""
+    tr, _ = toy_run
+    roots = list(tr._ring)
+    for prev, cur in zip(roots, roots[1:]):
+        prefetch = next(c for c in cur.children if c.name == "prefetch")
+        commit = next(c for c in prev.children if c.name == "commit")
+        assert prefetch.t0 < commit.t1, (prev.attrs, cur.attrs)
+        assert prefetch.t0 < prev.t1
+
+
+def test_chrome_export_schema_and_overlap(toy_run, tmp_path):
+    """The export is Chrome-trace-event JSON Perfetto can load: X/i
+    events with ts/dur/pid/tid + thread_name metadata rows, block
+    numbers in args — and the prefetch(k+1)-before-commit(k)-ends
+    overlap is readable straight off the event timestamps."""
+    tr, _ = toy_run
+    path = tmp_path / "trace.json"
+    tr.export_chrome(str(path))
+    data = json.loads(path.read_text())
+    events = data["traceEvents"]
+    meta = [e for e in events if e.get("ph") == "M"]
+    names = {e["args"]["name"] for e in meta}
+    assert any(n.startswith("fabtpu-prefetch") for n in names)
+    assert any(n.startswith("fabtpu-committer") for n in names)
+    xs = [e for e in events if e["ph"] == "X"]
+    assert xs, "no complete events"
+    for e in xs:
+        for k in ("name", "ts", "dur", "pid", "tid", "args"):
+            assert k in e, (k, e)
+    by_block: dict = {}
+    for e in xs:
+        by_block.setdefault(e["args"]["block"], []).append(e)
+    assert sorted(by_block) == [0, 1, 2, 3, 4]
+    for k in range(4):
+        commit_k = next(e for e in by_block[k] if e["name"] == "commit")
+        pre_k1 = next(e for e in by_block[k + 1]
+                      if e["name"] == "prefetch")
+        assert pre_k1["ts"] < commit_k["ts"] + commit_k["dur"]
+
+    # the text waterfall renders the same file without a browser
+    import traceview
+
+    text = traceview.render(data)
+    assert "block 3" in text and "prefetch" in text and "#" in text
+    one = traceview.render(data, block=2)
+    assert "block 2" in one and "block 3" not in one
+
+
+def test_traceview_renders_trace_dump(toy_run):
+    import traceview
+
+    tr, _ = toy_run
+    dump = {
+        "slow_blocks": tr.slow_blocks(),
+        "recent_blocks": tr.blocks(4),
+    }
+    text = traceview.render(dump)
+    assert "block 4" in text and "commit" in text
+    single = traceview.render(tr.block(3))
+    assert single.startswith("block 3") and "finish" in single
+
+
+# ---------------------------------------------------------------------------
+# host pool workers adopt the submitting thread's span
+
+
+def test_hostpool_worker_spans_cross_thread():
+    from fabric_tpu.parallel.hostpool import HostStagePool
+
+    tr = observe.global_tracer()
+    root = tr.begin_block(991)
+    assert root is not None  # global default is always-on
+    tok = tr.attach(root)
+    try:
+        with HostStagePool(2) as pool:
+            assert pool.map(lambda x: x * 2, [1, 2, 3],
+                            stage="unit") == [2, 4, 6]
+    finally:
+        tr.detach(tok)
+    tasks = [c for c in root.children if c.name == "unit"]
+    assert len(tasks) == 3
+    assert all(c.thread.startswith("fabtpu-hoststage") for c in tasks)
+    assert all("worker" in c.attrs for c in tasks)
+
+
+# ---------------------------------------------------------------------------
+# /trace endpoint round-trip
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_trace_endpoint_roundtrip(toy_run):
+    from fabric_tpu.ops_metrics import Registry
+    from fabric_tpu.opsserver import HealthRegistry, OperationsServer
+
+    tr, _ = toy_run
+    reg = Registry()
+    reg.histogram("validator_stage_seconds").observe(0.01, stage="finish")
+
+    async def scenario():
+        srv = await OperationsServer(
+            port=0, registry=reg, health=HealthRegistry(), tracer=tr
+        ).start()
+        try:
+            loop = asyncio.get_event_loop()
+            st, idx = await loop.run_in_executor(
+                None, _get, srv.port, "/trace"
+            )
+            assert st == 200 and idx["enabled"]
+            assert idx["blocks_in_ring"] == [0, 1, 2, 3, 4]
+            assert [b["block"] for b in idx["recent_blocks"]] == [1, 2, 3, 4]
+            # the summary reads histograms through the LOCKED snapshot
+            summ = idx["summary"]["validator_stage_seconds"]
+            assert summ["stage=finish"]["count"] == 1
+            st, tree = await loop.run_in_executor(
+                None, _get, srv.port, "/trace?block=3"
+            )
+            assert st == 200 and tree["block"] == 3
+            assert {c["name"] for c in tree["children"]} >= {
+                "prefetch", "launch", "finish", "commit"
+            }
+            try:
+                await loop.run_in_executor(
+                    None, _get, srv.port, "/trace?block=77"
+                )
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            await srv.stop()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(asyncio.wait_for(scenario(), 30))
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# ops_metrics locked read accessors
+
+
+def test_metrics_locked_accessors():
+    from fabric_tpu.ops_metrics import Registry
+
+    reg = Registry()
+    c = reg.counter("c_total")
+    c.add(2, channel="a")
+    c.add(3, channel="a")
+    assert c.value(channel="a") == 5.0
+    assert c.snapshot() == {(("channel", "a"),): 5.0}
+    g = reg.gauge("g")
+    g.set(7, channel="a")
+    assert g.value(channel="a") == 7.0
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0, float("inf")))
+    assert h.value(stage="x") is None
+    h.observe(0.05, stage="x")
+    h.observe(0.5, stage="x")
+    snap = h.value(stage="x")
+    assert snap["count"] == 2 and snap["counts"] == [1, 2, 2]
+    assert abs(snap["sum"] - 0.55) < 1e-9
+    assert reg.metric("h_seconds") is h and reg.metric("nope") is None
+
+    # render still emits the same exposition format off the snapshots
+    text = reg.render()
+    assert 'c_total{channel="a"} 5.0' in text
+    assert 'h_seconds_bucket{stage="x",le="0.1"} 1' in text
+    assert 'h_seconds_count{stage="x"} 2' in text
+
+
+def test_metrics_concurrent_read_write_smoke():
+    """Readers (render / value / snapshot) race writers without
+    torn/failed reads — the bug was unlocked reads of ``_values``."""
+    from fabric_tpu.ops_metrics import Registry
+
+    reg = Registry()
+    c = reg.counter("rw_total")
+    h = reg.histogram("rw_seconds")
+    stop = threading.Event()
+    errors = []
+
+    def writer(i):
+        try:
+            n = 0
+            while not stop.is_set():
+                # fresh label keys force dict growth mid-read
+                c.add(1, worker=str(i), n=str(n % 97))
+                h.observe(0.001, worker=str(i), n=str(n % 97))
+                n += 1
+        except Exception as e:  # surface, don't swallow
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            reg.render()
+            c.value(worker="0", n="1")
+            h.snapshot()
+    except Exception as e:
+        errors.append(e)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, errors

@@ -307,6 +307,67 @@ def test_pooled_prepare_cols_matches_serial(keys, rng):
                                 recode_device=True)() == base
 
 
+def test_prepare_cols_out_views_match_alloc(keys, rng):
+    """``prepare_cols(out=...)`` — the pooled workers' direct-slab
+    write path (no allocate-then-copy) — must be BIT-equal to the
+    allocating form for host digits and device limbs alike, with every
+    destination element written (slabs prefilled with garbage) and the
+    pad tail zeroed.  ``bytes_to_rns(out=)`` rides the same path."""
+    items = []
+    for i in range(48):
+        k = keys[i % 3]
+        e = ec_ref.digest_int(rng.bytes(16))
+        r, s = k.sign_digest(e)
+        if i % 4 == 1:
+            s = ec_ref.N - s  # high-S reject lane
+        if i % 11 == 0:
+            r = ec_ref.N + 5  # out-of-range r
+        items.append((e, r, s, *k.public))
+    n, cols = v3._to_cols(items)
+    pad = v3._bucket(n)
+    assert pad > n  # the pad-tail zeroing is load-bearing here
+    R = 2 * rns.N_CH
+    for recode in (False, True):
+        base = v3.prepare_cols(*cols, pad_to=pad, recode_device=recode)
+        wcols = v3._PK_LIMBS if recode else v3.STEPS
+        wdt = np.int16 if recode else np.int32
+        out = (
+            np.full((pad, R), 7, np.int32),
+            np.full((pad, R), 7, np.int32),
+            np.full((pad, R), 7, np.int32),
+            np.full((pad, R), 7, np.int32),
+            np.full((pad, wcols), 7, wdt),
+            np.full((pad, wcols), 7, wdt),
+            np.ones(pad, bool),
+            np.ones(pad, bool),
+        )
+        got = v3.prepare_cols(*cols, pad_to=pad, recode_device=recode,
+                              out=out)
+        assert got is out
+        for i, (a, b) in enumerate(zip(base, out)):
+            a = np.asarray(a)
+            assert a.dtype == b.dtype and np.array_equal(a, b), (recode, i)
+        # row-slab views (what _prepare_cols_pooled hands workers):
+        # stage [16:48) of fresh slabs in place, compare the rows
+        slab = tuple(np.full_like(np.asarray(a), 3) for a in base)
+        v3.prepare_cols(*(c[16:48] for c in cols), recode_device=recode,
+                        out=tuple(d[16:48] for d in slab))
+        for i, (a, b) in enumerate(zip(base, slab)):
+            assert np.array_equal(np.asarray(a)[16:48], b[16:48]), (recode, i)
+
+    # bytes_to_rns(out=) ≡ allocating form
+    r_b = cols[1]
+    dst = np.full((len(r_b), R), 9, np.int32)
+    assert rns.bytes_to_rns(r_b, out=dst) is dst
+    assert np.array_equal(dst, rns.bytes_to_rns(r_b))
+    empty = np.zeros((0, R), np.int32)
+    assert rns.bytes_to_rns(r_b[:0], out=empty) is empty
+
+    # the mismatched-size guard fails loudly, not with silent wraps
+    with pytest.raises(ValueError):
+        v3.prepare_cols(*cols, pad_to=pad, out=tuple(a[:8] for a in out))
+
+
 def test_prepare_cols_native_matches_python():
     """The native ec_prepare (batch inversion + window recoding +
     admission flags in C) must be bit-exact with the Python prepare
