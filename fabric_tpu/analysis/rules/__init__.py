@@ -11,10 +11,12 @@ them); slugs are the human-facing names:
     FT006 union-env-coercion     env strings coercing non-scalar unions
     FT007 kernel-dtype-mismatch  int64 host arrays into int32 kernel lanes
     FT008 asyncio-task-leak      dropped ensure_future/create_task results
+    FT009 unbounded-blocking-wait  no-timeout Future/Queue/Event/Thread waits
 """
 
 from fabric_tpu.analysis.rules import (  # noqa: F401
     asyncio_task_leak,
+    blocking_wait,
     host_sync,
     jit_purity,
     kernel_dtype,

@@ -1,0 +1,320 @@
+"""FT009 unbounded-blocking-wait: thread-blocking waits with no
+timeout outside test code.
+
+The commit path is a lattice of worker threads (prefetch, committer,
+host-pool, feeder) handing work through futures, queues and events.  A
+``Future.result()`` / ``Queue.get()`` / ``Event.wait()`` /
+``Thread.join()`` with NO timeout turns any wedged producer (a hung
+fsync, a dead device runtime, a stuck RPC) into a silently frozen
+consumer — the exact failure the chaos harness (fabric_tpu.faults)
+injects and the degraded-mode machinery routes around.  The bounded
+discipline: pass ``timeout=`` and handle it (retry loop with progress
+logging, or abort), or mark an INTENTIONALLY unbounded wait with
+``# fabtpu: noqa(FT009)`` and a justification.
+
+Mechanics (import-aware per the FT003/FT007/FT008 pattern, strictly
+under-approximating so a finding is always real):
+
+1. **Tracked objects** — resolved THROUGH the module's imports
+   (aliases and from-import renames included):
+
+   * ``threading.Event()``            → event   (``.wait()``)
+   * ``threading.Thread(...)``        → thread  (``.join()``)
+   * ``queue.Queue/LifoQueue/PriorityQueue/SimpleQueue()``
+                                      → queue   (``.get()``)
+   * ``concurrent.futures.Future()``  → future  (``.result()``)
+   * ``asyncio.run_coroutine_threadsafe(...)`` → future
+   * ``ThreadPoolExecutor/ProcessPoolExecutor(...)`` → executor, whose
+     ``.submit(...)`` results are futures (chained
+     ``ex.submit(...).result()`` included)
+
+   Receivers are tracked through same-scope local assignment
+   (element-wise tuple assigns included — the ``fut, self._f =
+   self._f, None`` pop idiom), through ``self.<attr>`` assigned
+   anywhere in the SAME class, and through direct chained calls.
+   Anything else (tuple unpacks of unknown tuples, containers,
+   parameters) is invisible by design — under-approximation keeps
+   false positives at zero.
+
+2. **Bounded test** — ``.get()`` is bounded with a ``timeout=`` kw or
+   a second positional (``get(True, 5)``); the others with any
+   positional or a ``timeout=`` kw.  ``get_nowait`` etc. never match.
+   ``await``-ed calls never match (asyncio waits don't block a
+   thread; cancellation is the loop's concern).
+
+3. **Test code is exempt** — paths under ``tests/``, ``test_*.py``
+   and ``conftest.py``: an unbounded wait in a test hangs CI, which
+   has its own timeout, and test clarity wins.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import (
+    Finding,
+    ModuleCtx,
+    Rule,
+    call_name,
+    register,
+)
+
+_MODULES = ("threading", "queue", "asyncio", "concurrent.futures",
+            "concurrent")
+
+_CTOR_KINDS = {
+    ("threading", "Event"): "event",
+    ("threading", "Thread"): "thread",
+    ("threading", "Timer"): "thread",
+    ("queue", "Queue"): "queue",
+    ("queue", "LifoQueue"): "queue",
+    ("queue", "PriorityQueue"): "queue",
+    ("queue", "SimpleQueue"): "queue",
+    ("concurrent.futures", "ThreadPoolExecutor"): "executor",
+    ("concurrent.futures", "ProcessPoolExecutor"): "executor",
+    ("concurrent.futures", "Future"): "future",
+    ("asyncio", "run_coroutine_threadsafe"): "future",
+}
+
+#: method → the receiver kind it blocks on
+_WAITS = {"wait": "event", "join": "thread", "get": "queue",
+          "result": "future"}
+
+_ADVICE = {
+    "event": "Event.wait() with no timeout blocks this thread forever "
+             "if the setter dies",
+    "thread": "Thread.join() with no timeout blocks forever if the "
+              "thread wedges",
+    "queue": "Queue.get() with no timeout blocks forever if the "
+             "producer dies",
+    "future": "Future.result() with no timeout blocks forever if the "
+              "producer wedges",
+}
+
+
+def _bindings(tree: ast.Module):
+    """(dotted-prefix → canonical module, bare name → (module, orig))
+    for the modules of interest, from every import in the module."""
+    prefixes: dict[str, str] = {}
+    bare: dict[str, tuple] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                root = a.name.split(".")[0]
+                if root not in ("threading", "queue", "asyncio",
+                                "concurrent"):
+                    continue
+                if a.asname:
+                    prefixes[a.asname] = a.name
+                else:
+                    # `import concurrent.futures` binds "concurrent";
+                    # the dotted CALL path is the full module path
+                    prefixes[a.name] = a.name
+                    prefixes[root] = root
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "concurrent" :
+                for a in node.names:
+                    if a.name == "futures":
+                        prefixes[a.asname or "futures"] = (
+                            "concurrent.futures"
+                        )
+                continue
+            if mod not in ("threading", "queue", "asyncio",
+                           "concurrent.futures"):
+                continue
+            for a in node.names:
+                bare[a.asname or a.name] = (mod, a.name)
+    return prefixes, bare
+
+
+def _classify_call(call: ast.Call, prefixes, bare) -> str | None:
+    """Call → tracked kind, resolved through the imports."""
+    name = call_name(call)
+    if name is None:
+        return None
+    if "." in name:
+        mod_path, _, attr = name.rpartition(".")
+        module = prefixes.get(mod_path)
+        if module == "concurrent":
+            module = None  # bare `concurrent.X` is not a tracked attr
+        if module is None:
+            return None
+        return _CTOR_KINDS.get((module, attr))
+    return _CTOR_KINDS.get(bare.get(name, ("", "")))
+
+
+def _class_attrs(cls: ast.ClassDef, prefixes, bare) -> dict[str, str]:
+    """self.<attr> kinds assigned anywhere in the class (ctor calls,
+    then submit-derived futures off executor attrs)."""
+    attrs: dict[str, str] = {}
+
+    def targets(node):
+        for t in node.targets if isinstance(node, ast.Assign) else ():
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                yield t.attr
+
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            kind = _classify_call(node.value, prefixes, bare)
+            if kind:
+                for attr in targets(node):
+                    attrs[attr] = kind
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            f = node.value.func
+            if (isinstance(f, ast.Attribute) and f.attr == "submit"
+                    and isinstance(f.value, ast.Attribute)
+                    and isinstance(f.value.value, ast.Name)
+                    and f.value.value.id == "self"
+                    and attrs.get(f.value.attr) == "executor"):
+                for attr in targets(node):
+                    attrs[attr] = "future"
+    return attrs
+
+
+def _walk_own(scope: ast.AST):
+    """A scope's OWN nodes (nested defs/lambdas are their own scopes)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _bounded(call: ast.Call, meth: str) -> bool:
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    if meth == "get":
+        # get(block=False) / get(False) never blocks — it raises
+        # queue.Empty immediately, so there is no wait to bound
+        for kw in call.keywords:
+            if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is False:
+                return True
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and call.args[0].value is False:
+            return True
+    need = 2 if meth == "get" else 1  # get(block, timeout)
+    return len(call.args) >= need
+
+
+@register
+class BlockingWaitRule(Rule):
+    id = "FT009"
+    name = "unbounded-blocking-wait"
+    severity = "error"
+    description = (
+        "flags Future.result()/Queue.get()/Event.wait()/Thread.join() "
+        "without a timeout outside test code — a wedged producer "
+        "freezes the waiting thread forever; pass timeout= and handle "
+        "it, or noqa an intentionally unbounded wait"
+    )
+
+    def check_module(self, ctx: ModuleCtx) -> list[Finding]:
+        rel = ctx.relpath
+        base = rel.rsplit("/", 1)[-1]
+        if ("tests/" in rel or rel.startswith("tests")
+                or base.startswith("test_") or base == "conftest.py"):
+            return []
+        prefixes, bare = _bindings(ctx.tree)
+        if not (prefixes or bare):
+            return []
+        # awaited calls never block a thread — mark and skip them
+        awaited = {
+            id(node.value) for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.Await)
+        }
+        out: list[Finding] = []
+        for scope, cls_attrs in self._scopes(ctx.tree, prefixes, bare):
+            self._check_scope(ctx, scope, cls_attrs, prefixes, bare,
+                              awaited, out)
+        return out
+
+    def _scopes(self, tree, prefixes, bare):
+        """(scope, enclosing-class attr kinds) for the module and every
+        function, computing each class's attr map once."""
+        out = [(tree, {})]
+
+        def rec(node, cls_attrs):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    rec(child, _class_attrs(child, prefixes, bare))
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    out.append((child, cls_attrs))
+                    rec(child, cls_attrs)
+                else:
+                    rec(child, cls_attrs)
+
+        rec(tree, {})
+        return out
+
+    def _check_scope(self, ctx, scope, cls_attrs, prefixes, bare,
+                     awaited, out):
+        # pass 1: same-scope local kinds (element-wise tuple assigns
+        # included — the `fut, self._f = self._f, None` pop idiom)
+        local: dict[str, str] = {}
+
+        def expr_kind(expr) -> str | None:
+            if isinstance(expr, ast.Name):
+                return local.get(expr.id)
+            if (isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"):
+                return cls_attrs.get(expr.attr)
+            if isinstance(expr, ast.Call):
+                kind = _classify_call(expr, prefixes, bare)
+                if kind:
+                    return kind
+                f = expr.func
+                if (isinstance(f, ast.Attribute) and f.attr == "submit"
+                        and expr_kind(f.value) == "executor"):
+                    return "future"
+            return None
+
+        # source order: `f = ex.submit(...)` must see the earlier
+        # `ex = ThreadPoolExecutor(...)` (the walk itself is unordered)
+        assigns = sorted(
+            (n for n in _walk_own(scope) if isinstance(n, ast.Assign)),
+            key=lambda n: (n.lineno, n.col_offset),
+        )
+        for node in assigns:
+            if len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                kind = expr_kind(node.value)
+                if kind:
+                    local[tgt.id] = kind
+            elif (isinstance(tgt, ast.Tuple)
+                  and isinstance(node.value, ast.Tuple)
+                  and len(tgt.elts) == len(node.value.elts)):
+                for t, v in zip(tgt.elts, node.value.elts):
+                    if isinstance(t, ast.Name):
+                        kind = expr_kind(v)
+                        if kind:
+                            local[t.id] = kind
+
+        # pass 2: the waits
+        for node in _walk_own(scope):
+            if not isinstance(node, ast.Call) or id(node) in awaited:
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute) or f.attr not in _WAITS:
+                continue
+            want = _WAITS[f.attr]
+            if expr_kind(f.value) != want or _bounded(node, f.attr):
+                continue
+            out.append(self.finding(
+                ctx, node.lineno, node.col_offset,
+                f"{_ADVICE[want]} — pass timeout= and handle it "
+                "(bounded retry loop with progress logging, or abort), "
+                "or mark an intentionally unbounded wait with "
+                "# fabtpu: noqa(FT009)",
+            ))

@@ -148,6 +148,11 @@ async def _run_peer(cfg):
         host_stage_mode=cfg.host_stage_mode,
         trace_ring_blocks=cfg.trace_ring_blocks,
         trace_slow_factor=cfg.trace_slow_factor,
+        device_fail_threshold=cfg.device_fail_threshold,
+        device_retries=cfg.device_retries,
+        device_recovery_s=cfg.device_recovery_s,
+        verify_deadline_ms=cfg.verify_deadline_ms,
+        faults=cfg.faults,
     )
     await node.start(operations_port=cfg.operations_port)
     print(f"peer {node.id} serving on :{node.port}", flush=True)

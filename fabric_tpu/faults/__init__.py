@@ -1,0 +1,16 @@
+"""fabric_tpu.faults — deterministic fault injection (see plan.py)."""
+
+from fabric_tpu.faults.plan import (  # noqa: F401
+    ENV_SEED,
+    ENV_SPEC,
+    FaultPlan,
+    FaultSpecError,
+    InjectedFault,
+    afire,
+    configure,
+    fire,
+    install,
+    plan,
+    reset,
+    shield,
+)

@@ -1,0 +1,361 @@
+"""Deterministic fault-injection registry for the commit path.
+
+The north-star deployment is one device fabric validating blocks for
+many peers: a TPU launch failure, a wedged staging worker, or a crash
+mid-fsync must degrade ONE block's latency, not tear down a channel.
+Hardening that requires reproducing those failures on demand — this
+module is the chaos harness: a seedable :class:`FaultPlan` mapping
+**named injection points** in the hot path to **fault kinds**, armed
+per process and consulted by tiny ``fire(point)`` hooks threaded
+through the code that must survive:
+
+==============================  ============================================
+injection point                 fires
+==============================  ============================================
+``p256v3.verify_launch``        inside the ops-level verify dispatch
+``validator.verify_launch``     DeviceLaneGuard's device-lane attempt
+                                (BlockValidator AND the toy validators the
+                                crypto-free chaos tests drive)
+``validator.stage2``            the fused stage-2 dispatch/sync
+``hostpool.task``               inside every HostStagePool worker task
+``pipeline.prefetch``           CommitPipeline's prefetch-thread stage
+``pipeline.launch``             CommitPipeline's caller-thread launch stage
+``pipeline.commit``             CommitPipeline's committer-thread stage
+``peer.ledger_commit``          PeerChannel._commit_inner, before the ledger
+``ledger.fsync.before``         BlockStore, right before ``os.fsync``
+``ledger.fsync.after``          BlockStore, right after ``os.fsync``
+``deliver.read``                the deliver stream reader, per block
+==============================  ============================================
+
+Fault kinds:
+
+* ``raise``      — raise :class:`InjectedFault` (a RuntimeError),
+* ``latency``    — sleep ``ms`` milliseconds (device stall / slow disk),
+* ``disconnect`` — raise ``ConnectionResetError`` (stream torn down),
+* ``truncate``   — raise an ``asyncio.IncompleteReadError``-shaped
+  ``ConnectionResetError`` (stream cut mid-frame),
+* ``crash``      — ``os._exit(86)``: the kill-mid-fsync crash tests run
+  this in a child process and assert the ledger replays to a
+  consistent height on reopen.
+
+Spec string (the ``FABTPU_FAULTS`` env var / nodeconfig ``faults``
+knob)::
+
+    point:kind[:p=0.5][:n=3][:after=2][:ms=50] [; more specs]
+
+``p``     trigger probability per arrival (default 1.0; each rule draws
+          from its OWN ``random.Random`` derived from (seed, point,
+          kind) so a draw depends only on that rule's arrival count —
+          seeded runs replay exactly even when OTHER points' arrivals
+          interleave differently across threads between runs),
+``n``     total trigger budget (default unlimited),
+``after`` skip the first k arrivals at the point (deterministic
+          placement: "the 6th block's launch fails"),
+``ms``    sleep for ``latency``.
+
+Example — three device-launch failures then one deliver disconnect::
+
+    FABTPU_FAULTS='validator.verify_launch:raise:n=3;deliver.read:disconnect:n=1:after=5'
+
+Everything defaults OFF: with no spec armed, ``fire()`` is one module
+attribute read and a ``None`` check — tier-1 and production hosts pay
+nothing.  Every triggered fault also rides the
+``faults_injected_total{point,kind}`` counter so a chaos run's injected
+load is observable next to the recovery metrics it provokes.
+
+``shield()`` marks the current thread as running a RECOVERY path (the
+degraded CPU fallback re-verifying a block the faulty device lane
+dropped): arrivals from a shielded thread never trigger.  Without it a
+persistent device fault would chase the fallback through the shared
+ops entry points and no experiment could ever prove recovery.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import threading
+import time
+
+_KINDS = ("raise", "latency", "disconnect", "truncate", "crash")
+
+ENV_SPEC = "FABTPU_FAULTS"
+ENV_SEED = "FABTPU_FAULTS_SEED"
+
+
+class FaultSpecError(ValueError):
+    """A malformed fault spec string, phrased for the operator."""
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a ``raise``-kind injection point."""
+
+    def __init__(self, point: str):
+        super().__init__(f"injected fault at {point}")
+        self.point = point
+
+
+class _Rule:
+    __slots__ = ("point", "kind", "p", "n", "after", "ms", "arrivals",
+                 "fired", "rng")
+
+    def __init__(self, point: str, kind: str, p: float = 1.0,
+                 n: int | None = None, after: int = 0, ms: float = 0.0):
+        self.point, self.kind = point, kind
+        self.p, self.n, self.after, self.ms = p, n, after, ms
+        self.arrivals = 0  # times the point was reached for this rule
+        self.fired = 0     # times the fault actually triggered
+        self.rng: random.Random | None = None  # set by FaultPlan
+
+
+class FaultPlan:
+    """A parsed, armed set of injection rules (see module docstring).
+
+    Thread-safe: budgets and the RNG are guarded by one lock, taken
+    only at points that HAVE rules — unmatched points never lock.
+    """
+
+    def __init__(self, spec: str = "", seed: int | None = None):
+        self.spec = spec
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._rules: dict[str, list[_Rule]] = {}
+        for i, rule in enumerate(self._parse(spec)):
+            # per-rule RNG derived from (seed, point, kind, position):
+            # a probability draw depends only on this rule's OWN
+            # arrival count, never on how other points' arrivals
+            # interleave across threads — so a seeded run replays even
+            # under depth-2 scheduling noise.  (A str seed hashes via
+            # sha512, stable across processes unlike hash().)
+            rule.rng = (
+                random.Random(f"{seed}:{rule.point}:{rule.kind}:{i}")
+                if seed is not None else random.Random()
+            )
+            self._rules.setdefault(rule.point, []).append(rule)
+
+    @staticmethod
+    def _parse(spec: str) -> list[_Rule]:
+        rules = []
+        for part in (p.strip() for p in spec.split(";")):
+            if not part:
+                continue
+            fields = part.split(":")
+            if len(fields) < 2:
+                raise FaultSpecError(
+                    f"fault spec {part!r}: expected 'point:kind[:k=v...]'"
+                )
+            point, kind = fields[0].strip(), fields[1].strip()
+            if kind not in _KINDS:
+                raise FaultSpecError(
+                    f"fault spec {part!r}: unknown kind {kind!r} "
+                    f"(expected one of {', '.join(_KINDS)})"
+                )
+            kw: dict = {}
+            for f in fields[2:]:
+                k, _, v = f.partition("=")
+                k = k.strip()
+                try:
+                    if k == "p":
+                        kw["p"] = float(v)
+                    elif k == "n":
+                        kw["n"] = int(v)
+                    elif k == "after":
+                        kw["after"] = int(v)
+                    elif k == "ms":
+                        kw["ms"] = float(v)
+                    else:
+                        raise FaultSpecError(
+                            f"fault spec {part!r}: unknown param {k!r} "
+                            "(expected p/n/after/ms)"
+                        )
+                except ValueError as e:
+                    if isinstance(e, FaultSpecError):
+                        raise
+                    raise FaultSpecError(
+                        f"fault spec {part!r}: cannot parse '{k}={v}'"
+                    ) from None
+            if kw.get("p", 1.0) < 0 or kw.get("p", 1.0) > 1:
+                raise FaultSpecError(
+                    f"fault spec {part!r}: p must be in [0, 1]"
+                )
+            if kind == "latency" and kw.get("ms", 0.0) <= 0:
+                raise FaultSpecError(
+                    f"fault spec {part!r}: latency needs ms=<positive>"
+                )
+            rules.append(_Rule(point, kind, **kw))
+        return rules
+
+    @property
+    def points(self) -> tuple[str, ...]:
+        return tuple(sorted(self._rules))
+
+    def _admit(self, rule: _Rule) -> bool:
+        """One arrival against ``rule``'s budget/probability; True when
+        the fault should trigger (and has been counted as fired)."""
+        with self._lock:
+            rule.arrivals += 1
+            if rule.arrivals <= rule.after:
+                return False
+            if rule.n is not None and rule.fired >= rule.n:
+                return False
+            if rule.p < 1.0 and rule.rng.random() >= rule.p:
+                return False
+            rule.fired += 1
+        _injected_counter().add(1, point=rule.point, kind=rule.kind)
+        return True
+
+    def fire(self, point: str, **ctx) -> None:
+        """Arrival at ``point``: trigger any armed rule whose budget
+        and probability allow.  May raise, sleep, or exit the process;
+        returns normally otherwise."""
+        rules = self._rules.get(point)
+        if not rules:
+            return
+        if _shielded():
+            return
+        for rule in rules:
+            if self._admit(rule):
+                self._trigger(rule, point, ctx)
+
+    async def afire(self, point: str, **ctx) -> None:
+        """``fire`` for async-context points (``deliver.read``):
+        latency faults await ``asyncio.sleep`` so an armed plan slows
+        ONE stream instead of freezing the whole event loop."""
+        rules = self._rules.get(point)
+        if not rules:
+            return
+        if _shielded():
+            return
+        for rule in rules:
+            if self._admit(rule):
+                if rule.kind == "latency":
+                    await asyncio.sleep(rule.ms / 1000.0)
+                else:
+                    self._trigger(rule, point, ctx)
+
+    @staticmethod
+    def _trigger(rule: _Rule, point: str, ctx: dict) -> None:
+        if rule.kind == "latency":
+            time.sleep(rule.ms / 1000.0)
+            return
+        if rule.kind == "raise":
+            raise InjectedFault(point)
+        if rule.kind == "disconnect":
+            raise ConnectionResetError(f"injected disconnect at {point}")
+        if rule.kind == "truncate":
+            raise ConnectionResetError(
+                f"injected truncated stream at {point}"
+            )
+        # crash: hard process death with NOTHING flushed — the
+        # crash-consistency tests run this in a child process
+        os._exit(86)
+
+    def stats(self) -> dict:
+        """{point: [{kind, arrivals, fired}]} — bench extras read this
+        so a chaos run's JSON states exactly what was injected."""
+        with self._lock:
+            return {
+                point: [
+                    {"kind": r.kind, "arrivals": r.arrivals,
+                     "fired": r.fired}
+                    for r in rules
+                ]
+                for point, rules in sorted(self._rules.items())
+            }
+
+    def fired(self, point: str | None = None) -> int:
+        with self._lock:
+            rules = (
+                self._rules.get(point, ()) if point is not None
+                else [r for rs in self._rules.values() for r in rs]
+            )
+            return sum(r.fired for r in rules)
+
+
+def _injected_counter():
+    from fabric_tpu.ops_metrics import global_registry
+
+    return global_registry().counter(
+        "faults_injected_total", "chaos faults triggered by point and kind"
+    )
+
+
+# -- process-global plan ----------------------------------------------------
+
+_plan: FaultPlan | None = None
+_tl = threading.local()
+
+
+def _shielded() -> bool:
+    return getattr(_tl, "shield", 0) > 0
+
+
+class shield:
+    """Context manager marking the current thread as a recovery path:
+    injection points it passes never trigger (see module docstring)."""
+
+    def __enter__(self):
+        _tl.shield = getattr(_tl, "shield", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _tl.shield -= 1
+        return False
+
+
+def configure(spec: str = "", seed: int | None = None) -> FaultPlan | None:
+    """Arm the process-global plan from a spec string (empty = disarm).
+    ``seed`` defaults to ``FABTPU_FAULTS_SEED`` so a peer whose config
+    re-arms the plan (nodeconfig ``faults`` → PeerNode) keeps the
+    env-requested deterministic replay instead of silently dropping it.
+    Returns the installed plan (None when disarmed)."""
+    global _plan
+    if seed is None:
+        seed_s = os.environ.get(ENV_SEED, "")
+        seed = int(seed_s) if seed_s else None
+    _plan = FaultPlan(spec, seed=seed) if spec else None
+    return _plan
+
+
+def install(plan: FaultPlan | None) -> None:
+    """Install an already-built plan (tests hold the object to read
+    stats)."""
+    global _plan
+    _plan = plan
+
+
+def reset() -> None:
+    global _plan
+    _plan = None
+
+
+def plan() -> FaultPlan | None:
+    return _plan
+
+
+def fire(point: str, **ctx) -> None:
+    """The hot-path hook: one global read when no plan is armed."""
+    p = _plan
+    if p is not None:
+        p.fire(point, **ctx)
+
+
+async def afire(point: str, **ctx) -> None:
+    """Async hook for event-loop call sites (guard with ``plan() is
+    not None`` so the unarmed path stays coroutine-free)."""
+    p = _plan
+    if p is not None:
+        await p.afire(point, **ctx)
+
+
+def _init_from_env() -> None:
+    """Arm from FABTPU_FAULTS at import so child processes (the crash
+    tests) and bench runs need no explicit plumbing."""
+    spec = os.environ.get(ENV_SPEC, "")
+    if spec:
+        seed_s = os.environ.get(ENV_SEED, "")
+        configure(spec, seed=int(seed_s) if seed_s else None)
+
+
+_init_from_env()

@@ -18,6 +18,7 @@ import struct
 
 from google.protobuf.message import DecodeError
 
+from fabric_tpu import faults as _faults
 from fabric_tpu import protoutil
 from fabric_tpu.protos import common_pb2
 
@@ -295,7 +296,14 @@ class BlockStore:
             or _time.monotonic() - self._oldest_unsynced
             >= self.group_max_lag_s
         ):
+            # crash-consistency hooks: the kill-mid-fsync chaos tests
+            # exit the process HERE (before = the whole window is lost
+            # and _recover must truncate the torn tail; after = the
+            # window is durable) and assert replay to a consistent
+            # height on reopen
+            _faults.fire("ledger.fsync.before")
             os.fsync(self._fh.fileno())
+            _faults.fire("ledger.fsync.after")
             self._unsynced = 0
             self._oldest_unsynced = None
         self._index_block(block, self._seg, off, txids=txids)
@@ -349,7 +357,9 @@ class BlockStore:
         """Force-fsync any group-commit window still open."""
         if self._unsynced:
             self._fh.flush()
+            _faults.fire("ledger.fsync.before")
             os.fsync(self._fh.fileno())
+            _faults.fire("ledger.fsync.after")
             self._unsynced = 0
             self._oldest_unsynced = None
 

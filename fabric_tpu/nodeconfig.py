@@ -151,6 +151,33 @@ class PeerConfig:
     # median (armed after 8 committed blocks); 0 disables the watchdog
     # while keeping the flight recorder.
     trace_slow_factor: float = 5.0
+    # device-lane degradation (peer/degrade.py DeviceLaneGuard): after
+    # device_fail_threshold CONSECUTIVE device-verify failures the
+    # validator latches a degraded CPU mode (ops/p256.verify_host +
+    # the host MVCC path — correctness identical, the channel stays
+    # live) with a recovery probe every device_recovery_s.  0 = guard
+    # off entirely (failures raise through, today's behavior) — the
+    # safe default for CPU-only hosts and tier-1.
+    device_fail_threshold: int = 0
+    # device-launch attempts retried (capped exponential backoff +
+    # jitter) before a block falls back to the CPU lane; counts on
+    # device_verify_retries_total.  Only meaningful with the guard on.
+    device_retries: int = 2
+    # seconds between recovery probes while degraded: one block rides
+    # the device lane; success re-arms it (validator_degraded gauge 0)
+    device_recovery_s: float = 30.0
+    # device verify deadline (ms): a device launch/sync slower than
+    # this COUNTS AS A FAILURE toward the degraded latch.  The result
+    # is still used — a blocked XLA sync cannot be preempted from
+    # Python — so this is a latch signal for future blocks, not a
+    # per-block abort.  0 = no deadline.
+    verify_deadline_ms: float = 0.0
+    # chaos fault plan (fabric_tpu/faults): spec string arming named
+    # injection points, e.g.
+    # 'validator.verify_launch:raise:n=3;deliver.read:disconnect:n=1'.
+    # Staging/soak rigs only; empty = no injection (and fire() costs
+    # one attribute read).  FABTPU_FAULTS overrides like any scalar.
+    faults: str = ""
     # chaincode install surface (peer/node.py _on_install)
     max_package_size: int = DEFAULT_MAX_PACKAGE_SIZE
     install_require_admin: bool = False

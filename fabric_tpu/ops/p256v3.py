@@ -36,6 +36,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from fabric_tpu import faults as _faults
 from fabric_tpu.crypto import ec_ref
 from fabric_tpu.ops import rns
 from fabric_tpu.utils.batching import next_pow2
@@ -1079,6 +1080,9 @@ def verify_launch(items, chunk: int | None = None, mesh=None, pool=None,
     the 4-bit window digits on device (``verify_batch_packed_limbs``),
     shrinking the packed H2D frame (the window planes drop 4×, the
     whole frame ~1.4×); bit-equal to host recoding."""
+    # chaos hook (fabric_tpu.faults): a FaultPlan can fail/slow the
+    # ops-level dispatch itself — no-op when no plan is armed
+    _faults.fire("p256v3.verify_launch")
     chunk = max(int(chunk), MIN_BUCKET) if chunk else 0
     if isinstance(items, (ColumnarSigBatch, SigCollector)):
         if not items.n:
@@ -1176,6 +1180,9 @@ def verify_launch_many(batches, chunk: int | None = None,
             )
         return out
 
+    # chaos hook — fired here (not at function entry) so the solo
+    # delegation above doesn't double-count against a fault budget
+    _faults.fire("p256v3.verify_launch")
     # concatenate per-block columns, each padded to its own bucket
     offs, total = [], 0
     for n in sizes:

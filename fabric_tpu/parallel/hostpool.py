@@ -60,6 +60,30 @@ def _pool_tracer():
     return global_tracer()
 
 
+def _label_task_error(e: BaseException, stage: str, worker: str) -> None:
+    """Attach the failing stage/worker to a task exception in place —
+    the TYPE is preserved (callers catch specific exceptions) and the
+    first string arg gains a ``[host pool stage=… worker=…]`` suffix so
+    logs name the slot.  Idempotent across re-submission layers."""
+    if getattr(e, "fab_stage", None) is not None:
+        return
+    try:
+        e.fab_stage = stage
+        e.fab_worker = worker
+        if e.args and isinstance(e.args[0], str):
+            e.args = (
+                f"{e.args[0]} [host pool stage={stage} worker={worker}]",
+            ) + e.args[1:]
+    except Exception as label_err:
+        # frozen/slots exception types: labels are best-effort — the
+        # original error still propagates unlabeled
+        import logging
+
+        logging.getLogger("fabric_tpu.hostpool").debug(
+            "could not label task error: %s", label_err
+        )
+
+
 class HostStagePool:
     """Persistent staging worker pool (see module docstring).
 
@@ -112,16 +136,30 @@ class HostStagePool:
         ``parent`` is the SUBMITTING thread's current tracer span,
         captured at submit time — the worker adopts it so its task
         span lands in the right block tree (the explicit cross-thread
-        handoff; thread-locals do not follow executor tasks)."""
+        handoff; thread-locals do not follow executor tasks).
+
+        A task exception is ANNOTATED with the failing stage/worker
+        before it propagates (``fab_stage``/``fab_worker`` attributes
+        plus a message suffix): by the time the ordered ``map`` gather
+        re-raises it on the submitting thread, the executing slot is
+        long gone — without the labels a one-in-N shard failure is
+        undebuggable.  The ``hostpool.task`` fault-injection point
+        fires here so a chaos plan can kill exactly one worker task."""
         trc = self._trc
 
         def run(*args, **kwargs):
+            from fabric_tpu import faults as _faults
+
             name = threading.current_thread().name
             worker = name.rsplit("_", 1)[-1] if "_" in name else name
             t0 = time.perf_counter()
             try:
                 with trc.span(stage, parent=parent, worker=worker):
+                    _faults.fire("hostpool.task", stage=stage)
                     return fn(*args, **kwargs)
+            except BaseException as e:
+                _label_task_error(e, stage, worker)
+                raise
             finally:
                 self._observe(stage, worker, time.perf_counter() - t0)
         return run
@@ -144,11 +182,22 @@ class HostStagePool:
 
     def map(self, fn, items, stage: str = "task") -> list:
         """Ordered parallel map: fan every item out, gather in order.
-        An exception in any task propagates at the gather (the
-        remaining futures still run to completion — staging tasks are
-        short and side-effect-free)."""
+        The FIRST task exception (submission order) propagates at the
+        gather with the failing stage/worker labels attached — never a
+        wedged gather, never a silently dropped shard; the remaining
+        futures still run to completion (staging tasks are short and
+        side-effect-free)."""
         futs = [self.submit(fn, it, stage=stage) for it in items]
-        return [f.result() for f in futs]
+        out = []
+        for f in futs:
+            try:
+                out.append(f.result())
+            except BaseException as e:
+                # thread mode labeled inside the worker; process mode
+                # (exception pickled back from the child) labels here
+                _label_task_error(e, stage, "proc")
+                raise
+        return out
 
     # -- lane-axis sharding ------------------------------------------------
 

@@ -62,7 +62,11 @@ class PeerChannel:
                  recode_device: bool = False,
                  host_stage_mode: str = "thread",
                  trace_ring_blocks: int | None = None,
-                 trace_slow_factor: float | None = None):
+                 trace_slow_factor: float | None = None,
+                 device_fail_threshold: int = 0,
+                 device_retries: int = 2,
+                 device_recovery_s: float = 30.0,
+                 verify_deadline_ms: float = 0.0):
         self.id = channel_id
         # block-commit span tracer knobs (nodeconfig trace_ring_blocks
         # / trace_slow_factor): configure the process-global tracer the
@@ -165,6 +169,11 @@ class PeerChannel:
             verify_chunk=verify_chunk, mesh_devices=mesh_devices,
             host_stage_workers=host_stage_workers,
             recode_device=recode_device, host_stage_mode=host_stage_mode,
+            device_fail_threshold=device_fail_threshold,
+            device_retries=device_retries,
+            device_recovery_s=device_recovery_s,
+            verify_deadline_ms=verify_deadline_ms,
+            channel=channel_id,
         )
         from fabric_tpu.peer.coordinator import PvtDataCoordinator
         from fabric_tpu.peer.transient import TransientStore
@@ -302,8 +311,10 @@ class PeerChannel:
         # interleave transactions on one connection.  The pipeline's
         # overlap is unaffected — the NEXT block validates on the
         # feeder thread while this runs.
+        from fabric_tpu import faults as _faults
         from fabric_tpu.observe import global_tracer
 
+        _faults.fire("peer.ledger_commit", block=block.header.number)
         tracer = global_tracer()
         with tracer.span("ledger_commit", parent=root):
             self.ledger.commit_block(
@@ -650,11 +661,12 @@ class PeerChannel:
                     return fut.result(timeout=5.0)
                 except _cf.TimeoutError:
                     if fut.done():
-                        # py3.11+: concurrent.futures.TimeoutError is
-                        # builtin TimeoutError — this one came from
-                        # the COMMIT itself (e.g. an fsync ETIMEDOUT),
-                        # not from our poll; surface it
-                        raise
+                        # completed inside the race window (or the
+                        # COMMIT itself raised builtin TimeoutError,
+                        # py3.11+): a done future answers non-blocking
+                        # with the real value or real error — never
+                        # re-raise our own poll timeout as the work's
+                        return fut.result(timeout=0)
                     if loop.is_closed():
                         fut.cancel()
                         raise RuntimeError(
@@ -690,8 +702,18 @@ class PeerChannel:
         q: asyncio.Queue = asyncio.Queue(maxsize=4)
 
         async def reader():
+            from fabric_tpu import faults as _faults
+
             try:
                 async for blk in gen:
+                    # chaos hook: a FaultPlan can cut the stream here
+                    # (disconnect/truncate) — the reconnect loop's
+                    # backoff + replay-from-height path must absorb it.
+                    # afire so a latency fault slows THIS stream via
+                    # asyncio.sleep instead of freezing the event loop
+                    if _faults.plan() is not None:
+                        await _faults.afire("deliver.read",
+                                            block=blk.header.number)
                     await q.put(blk)
             except BaseException as e:
                 reader_exc.append(e)
@@ -768,7 +790,19 @@ class PeerChannel:
                 raise reader_exc[0]
         except BaseException:
             # drop the in-flight tail: height never advanced for it,
-            # so the reconnect re-delivers from the right place
+            # so the reconnect re-delivers from the right place.  A
+            # pipeline STAGE exception already failed the pipe closed
+            # (quarantining the failing block — pipe.last_failure);
+            # say which block so a deterministic poison pill is
+            # diagnosable instead of an anonymous reconnect storm.
+            if pipe.last_failure is not None:
+                num, stage = pipe.last_failure
+                _log.warning(
+                    "%s: quarantining block %s after a %s-stage "
+                    "failure; pipe drained, resuming deliver from "
+                    "committed height %d", self.id, num, stage,
+                    self.height,
+                )
             await loop.run_in_executor(
                 feeder, lambda: pipe.close(flush=False)
             )
@@ -868,19 +902,42 @@ class PeerChannel:
                 if not t.done():
                     t.cancel()
 
+        from fabric_tpu.ops_metrics import global_registry
+        from fabric_tpu.utils.backoff import Backoff
+
+        reconnects = global_registry().counter(
+            "deliver_reconnects_total",
+            "deliver stream reconnect attempts by channel",
+        )
+
         async def loop():
+            # capped exponential backoff + full jitter (utils.backoff):
+            # the old fixed 0.2s retry turned an orderer outage into a
+            # lockstep connect storm from every peer; progress (height
+            # advanced during the attempt) resets the cadence so a
+            # healthy stream that drops reconnects promptly
+            bo = Backoff(base=0.2, cap=15.0, jitter=0.5)
             i = 0
             while True:
                 addr = orderer_addrs[i % len(orderer_addrs)]
                 i += 1
+                h0 = self.height
                 try:
                     await deliver_monitored(addr)
                 except Exception as e:
                     # a deterministic commit failure re-fails forever;
                     # it must at least be VISIBLE
-                    log.warning("%s deliver from %s: %s: %s",
-                                self.id, addr, type(e).__name__, e)
-                    await asyncio.sleep(0.2)
+                    if self.height > h0:
+                        bo.reset()
+                    reconnects.add(1, channel=self.id)
+                    delay = bo.next()
+                    log.warning(
+                        "%s deliver from %s: %s: %s — reconnecting "
+                        "from height %d in %.2fs (attempt %d)",
+                        self.id, addr, type(e).__name__, e,
+                        self.height, delay, bo.attempt,
+                    )
+                    await asyncio.sleep(delay)
 
         self._deliver_task = asyncio.ensure_future(loop())
 
@@ -938,7 +995,12 @@ class PeerNode:
                  host_stage_workers: int = 0, recode_device: bool = False,
                  host_stage_mode: str = "thread",
                  trace_ring_blocks: int | None = None,
-                 trace_slow_factor: float | None = None):
+                 trace_slow_factor: float | None = None,
+                 device_fail_threshold: int = 0,
+                 device_retries: int = 2,
+                 device_recovery_s: float = 30.0,
+                 verify_deadline_ms: float = 0.0,
+                 faults: str = ""):
         self.id = node_id
         self.dir = data_dir
         self.msp = msp_manager
@@ -956,6 +1018,18 @@ class PeerNode:
         # span-tracer knobs (None = leave the global tracer as-is)
         self.trace_ring_blocks = trace_ring_blocks
         self.trace_slow_factor = trace_slow_factor
+        # device-lane degradation knobs (peer/degrade.py): threshold 0
+        # keeps the guard off — the safe default everywhere
+        self.device_fail_threshold = int(device_fail_threshold)
+        self.device_retries = int(device_retries)
+        self.device_recovery_s = float(device_recovery_s)
+        self.verify_deadline_ms = float(verify_deadline_ms)
+        if faults:
+            # chaos spec (nodeconfig ``faults`` / FABTPU_FAULTS): arm
+            # the process-global fault plan — staging/soak rigs only
+            from fabric_tpu import faults as _faults_mod
+
+            _faults_mod.configure(faults)
         # install-surface admission (see _on_install): a size cap
         # always, and optionally an admin-signed request envelope
         self.max_package_size = int(max_package_size)
@@ -1136,6 +1210,10 @@ class PeerNode:
             host_stage_mode=self.host_stage_mode,
             trace_ring_blocks=self.trace_ring_blocks,
             trace_slow_factor=self.trace_slow_factor,
+            device_fail_threshold=self.device_fail_threshold,
+            device_retries=self.device_retries,
+            device_recovery_s=self.device_recovery_s,
+            verify_deadline_ms=self.verify_deadline_ms,
         )
         ch.client_ssl = self.tls.client_ctx() if self.tls else None
         ch.runtime = self.runtime  # resolved-binding invalidation hook
@@ -1178,6 +1256,23 @@ class PeerNode:
                 return None
 
             health.register("ledgers", _ledgers)
+
+            def _device_lanes():
+                # degraded is a WARNING state the fleet must see, but
+                # the channel is still committing (CPU fallback) — so
+                # /healthz reports it as a failed check with an
+                # explanatory reason rather than silence
+                for cid, ch in self.channels.items():
+                    g = getattr(ch.validator, "device_guard", None)
+                    if g is not None and g.degraded:
+                        return (
+                            f"channel {cid}: device verify lane "
+                            "DEGRADED — committing via CPU fallback, "
+                            "recovery probe armed"
+                        )
+                return None
+
+            health.register("device_verify_lane", _device_lanes)
             self.operations = await OperationsServer(
                 port=operations_port, health=health
             ).start()

@@ -46,9 +46,45 @@ telemetry agree:
 
 from __future__ import annotations
 
+import logging
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
+
+from concurrent.futures import TimeoutError as _CfTimeout
+
+from fabric_tpu import faults as _faults
+
+_log = logging.getLogger("fabric_tpu.pipeline")
+
+#: seconds between "still waiting" warnings while blocked on a worker
+#: thread — bounded-wait discipline (FT009): a wedged fsync or a hung
+#: prefetch must be VISIBLE in logs, not a silently frozen feeder
+WAIT_WARN_S = 60.0
+
+
+def _wait_result(fut, what: str, channel: str = ""):
+    """``fut.result()`` as a bounded poll: same blocking semantics (a
+    legitimately slow commit still completes), but a warning fires
+    every ``WAIT_WARN_S`` so a wedged worker thread is diagnosable."""
+    waited = 0.0
+    while True:
+        try:
+            return fut.result(timeout=WAIT_WARN_S)
+        except _CfTimeout:
+            if fut.done():
+                # the future completed in the race window while our
+                # poll timeout propagated (or, py3.11+, the WORK itself
+                # raised builtin TimeoutError) — a done future answers
+                # non-blocking with the real value or the real error,
+                # never with our poll timeout
+                return fut.result()
+            waited += WAIT_WARN_S
+            _log.warning(
+                "%s: still waiting on the %s worker after %.0fs — "
+                "thread wedged? (/debug/stacks names it)",
+                channel or "pipeline", what, waited,
+            )
 
 
 @dataclass
@@ -92,8 +128,11 @@ class _SliceFuture:
         self.fut = fut
         self.i = i
 
-    def result(self):
-        return self.fut.result()[self.i]
+    def result(self, timeout=None):
+        return self.fut.result(timeout)[self.i]
+
+    def done(self) -> bool:
+        return self.fut.done()
 
 
 def _is_barrier(pend, batch) -> bool:
@@ -189,6 +228,14 @@ class CommitPipeline:
         self._blocks_ctr = registry.counter(
             "commit_pipeline_blocks_total", "blocks through the pipeline"
         )
+        self._stage_fail_ctr = registry.counter(
+            "commit_pipeline_stage_failures_total",
+            "pipeline stage exceptions by stage",
+        )
+        # (block_number, stage) of the most recent stage failure — the
+        # deliver driver reads this to log WHICH block was quarantined
+        # when it drains the pipe and resumes from committed height
+        self.last_failure: tuple | None = None
         self._prefetch = ThreadPoolExecutor(
             1, thread_name_prefix="fabtpu-prefetch"
         )
@@ -211,6 +258,43 @@ class CommitPipeline:
         # predecessor and is deliberately excluded)
         self._launch_s = 0.0
         self._closed = False
+
+    # -- failure containment ----------------------------------------------
+
+    def _note_stage_failure(self, stage: str, block_num) -> None:
+        """Record a stage exception (counter + quarantine pointer) on
+        its way out; the exception itself keeps propagating."""
+        self.last_failure = (block_num, stage)
+        self._stage_fail_ctr.add(1, channel=self.channel, stage=stage)
+        _log.warning(
+            "%s: pipeline %s stage failed for block %s — pipe will "
+            "drain and fail closed; resume from committed height",
+            self.channel or "pipeline", stage, block_num,
+        )
+
+    def _fail_closed(self) -> None:
+        """A stage exception left the pipe mid-flight: drop the
+        in-flight state, drain both worker threads, and latch closed so
+        the NEXT submit raises 'pipeline is closed' cleanly instead of
+        tripping internal asserts.  The caller (deliver driver, bench
+        chaos harness) rebuilds a fresh pipeline and resumes from the
+        last committed height — the replay check skips what already
+        landed.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._pre = None
+        self._launched = None
+        self._launched_root = None
+        # a still-pending committer task finishes inside shutdown's
+        # wait; its error (if any) was either surfaced already or is
+        # superseded by the failure that got us here
+        self._commit_fut = None
+        self._stale_prefetch = False
+        self._overlay = self._extra = None
+        self._prefetch.shutdown(wait=True)
+        self._committer.shutdown(wait=True)
+        self._inflight_gauge.set(0, channel=self.channel)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -253,30 +337,43 @@ class CommitPipeline:
         predecessor's CommittedBlock (commit in flight on the
         committer thread unless it was a barrier) or None while the
         pipe fills.  Serial (depth=1): validates AND commits ``block``
-        inline, returning its CommittedBlock."""
+        inline, returning its CommittedBlock.
+
+        A stage exception FAILS THE PIPE CLOSED (see ``_fail_closed``):
+        it surfaces here exactly once, the worker threads drain, and
+        the next submit raises 'pipeline is closed' — callers rebuild a
+        fresh pipeline and resume from the last committed height."""
         if self._closed:
             raise RuntimeError("pipeline is closed")
-        if self.depth == 1:
-            return self._submit_serial(block)
-        t_sub = time.perf_counter()
-        # stage the new block on the prefetch thread FIRST: its host
-        # parse + device verify launch overlap the predecessor's
-        # device sync below
-        assert self._pre is None, "submit() before the previous returned"
-        root = self.tracer.begin_block(block.header.number,
-                                       channel=self.channel)
-        self._pre = (
-            block,
-            self._prefetch.submit(self._prefetch_traced, block, root),
-            root,
-        )
-        self._inflight_gauge.set(self.inflight, channel=self.channel)
+        try:
+            if self.depth == 1:
+                return self._submit_serial(block)
+            t_sub = time.perf_counter()
+            # stage the new block on the prefetch thread FIRST: its
+            # host parse + device verify launch overlap the
+            # predecessor's device sync below
+            assert self._pre is None, (
+                "submit() before the previous returned"
+            )
+            root = self.tracer.begin_block(block.header.number,
+                                           channel=self.channel)
+            self._pre = (
+                block,
+                self._prefetch.submit(self._prefetch_traced, block, root),
+                root,
+            )
+            self._inflight_gauge.set(self.inflight, channel=self.channel)
 
-        out = None
-        if self._launched is not None:
-            out = self._finish_and_commit(self._launched)
-        self._launch_next(out.stage_s if out is not None else {}, t_sub)
-        return out
+            out = None
+            if self._launched is not None:
+                out = self._finish_and_commit(self._launched)
+            self._launch_next(
+                out.stage_s if out is not None else {}, t_sub
+            )
+            return out
+        except BaseException:
+            self._fail_closed()
+            raise
 
     def _prefetch_traced(self, block, root):
         """Prefetch-thread task: the explicit span handle crosses the
@@ -284,10 +381,12 @@ class CommitPipeline:
         attachment makes the validator's parse/device_pre stage timers
         and any host-pool worker tasks nest under it."""
         with self.tracer.span("prefetch", parent=root):
+            _faults.fire("pipeline.prefetch")
             return self.prefetch_fn(block)
 
     def _prefetch_many_traced(self, group, root, n):
         with self.tracer.span("prefetch", parent=root, coalesced=n):
+            _faults.fire("pipeline.prefetch")
             return self._prefetch_many_fn(group)
 
     def _commit_traced(self, res, root):
@@ -296,7 +395,11 @@ class CommitPipeline:
         off the caller thread's critical path."""
         try:
             with self.tracer.span("commit", parent=root):
+                _faults.fire("pipeline.commit")
                 self.commit_fn(res)
+        except BaseException:
+            self._note_stage_failure("commit", res.block.header.number)
+            raise
         finally:
             self.tracer.finish_block(root)
 
@@ -317,6 +420,13 @@ class CommitPipeline:
             ]
         if self._closed:
             raise RuntimeError("pipeline is closed")
+        try:
+            return self._submit_many_coalesced(blocks, k)
+        except BaseException:
+            self._fail_closed()
+            raise
+
+    def _submit_many_coalesced(self, blocks, k) -> list:
         out = []
         i = 0
         while i < len(blocks):
@@ -379,7 +489,16 @@ class CommitPipeline:
     def flush(self):
         """Drain: finish + commit the last launched block and wait for
         every committer-thread commit.  Returns the final
-        CommittedBlock (or None if nothing was in flight)."""
+        CommittedBlock (or None if nothing was in flight).  A stage
+        or commit exception fails the pipe closed and surfaces ONCE
+        (see ``submit``)."""
+        try:
+            return self._flush_inner()
+        except BaseException:
+            self._fail_closed()
+            raise
+
+    def _flush_inner(self):
         out = None
         if self._launched is not None:
             out = self._finish_and_commit(self._launched, tail=True)
@@ -388,27 +507,40 @@ class CommitPipeline:
             # a prefetched block with no successor: run it serially
             block, fut, root = self._pre
             self._pre = None
-            pre = fut.result()
-            if self._stale_prefetch:
-                # prefetched before its barrier predecessor committed
-                self._stale_prefetch = False
-                self.tracer.event("stale_prefetch_reparse", parent=root)
-                with self.tracer.span("re-prefetch", parent=root):
-                    pre = self.prefetch_fn(block)
-            with self.tracer.span("launch", parent=root):
-                if self.pre_launch_fn is not None:
-                    self.pre_launch_fn(block)
-                t0 = time.perf_counter()
-                pend = self.validator.validate_launch(
-                    block, pre=pre, overlay=self._overlay,
-                    extra_txids=self._extra,
-                )
-                self._launch_s = time.perf_counter() - t0
+            try:
+                pre = _wait_result(fut, "prefetch", self.channel)
+                if self._stale_prefetch:
+                    # prefetched before its barrier predecessor
+                    # committed
+                    self._stale_prefetch = False
+                    self.tracer.event("stale_prefetch_reparse",
+                                      parent=root)
+                    with self.tracer.span("re-prefetch", parent=root):
+                        pre = self.prefetch_fn(block)
+            except BaseException:
+                self._note_stage_failure("prefetch", block.header.number)
+                raise
+            try:
+                with self.tracer.span("launch", parent=root):
+                    _faults.fire("pipeline.launch")
+                    if self.pre_launch_fn is not None:
+                        self.pre_launch_fn(block)
+                    t0 = time.perf_counter()
+                    pend = self.validator.validate_launch(
+                        block, pre=pre, overlay=self._overlay,
+                        extra_txids=self._extra,
+                    )
+                    self._launch_s = time.perf_counter() - t0
+            except BaseException:
+                self._note_stage_failure("launch", block.header.number)
+                raise
             self._launched_root = root
             out = self._finish_and_commit(pend, tail=True)
         if self._commit_fut is not None:
-            self._commit_fut.result()
-            self._commit_fut = None
+            # pop BEFORE waiting: a commit error must surface exactly
+            # once, not re-raise from the stored future at close()
+            fut, self._commit_fut = self._commit_fut, None
+            _wait_result(fut, "committer", self.channel)
         self._overlay = self._extra = None
         # nothing is prefetched past this point: a barrier flushed as
         # the tail must not make the NEXT submit discard and redo its
@@ -422,14 +554,24 @@ class CommitPipeline:
         root = tr.begin_block(block.header.number, channel=self.channel,
                               mode="serial")
         t0 = time.perf_counter()
-        with tr.span("launch", parent=root):
-            if self.pre_launch_fn is not None:
-                self.pre_launch_fn(block)
-            with tr.span("prefetch"):  # inline in serial mode
-                pre = self.prefetch_fn(block)
-            pend = self.validator.validate_launch(block, pre=pre)
-        with tr.span("finish", parent=root):
-            flt, batch, history = self.validator.validate_finish(pend)
+        stage = "launch"  # failure label tracks the stage under way
+        try:
+            with tr.span("launch", parent=root):
+                _faults.fire("pipeline.launch")
+                if self.pre_launch_fn is not None:
+                    self.pre_launch_fn(block)
+                with tr.span("prefetch"):  # inline in serial mode
+                    stage = "prefetch"
+                    _faults.fire("pipeline.prefetch")
+                    pre = self.prefetch_fn(block)
+                    stage = "launch"
+                pend = self.validator.validate_launch(block, pre=pre)
+            stage = "finish"
+            with tr.span("finish", parent=root):
+                flt, batch, history = self.validator.validate_finish(pend)
+        except BaseException:
+            self._note_stage_failure(stage, block.header.number)
+            raise
         t1 = time.perf_counter()
         res = CommittedBlock(
             block=block, pend=pend, tx_filter=flt, batch=batch,
@@ -438,7 +580,11 @@ class CommitPipeline:
         )
         try:
             with tr.span("commit", parent=root):
+                _faults.fire("pipeline.commit")
                 self.commit_fn(res)
+        except BaseException:
+            self._note_stage_failure("commit", block.header.number)
+            raise
         finally:
             tr.finish_block(root)
         res.stage_s["commit_wait"] = time.perf_counter() - t1
@@ -453,12 +599,17 @@ class CommitPipeline:
         root = self._launched_root
         self._launched_root = None
         t0 = time.perf_counter()
-        with self.tracer.span("finish", parent=root):
-            flt, batch, history = self.validator.validate_finish(pend)
+        try:
+            with self.tracer.span("finish", parent=root):
+                flt, batch, history = self.validator.validate_finish(pend)
+        except BaseException:
+            self._note_stage_failure("finish", pend.block.header.number)
+            raise
         t1 = time.perf_counter()
         if self._commit_fut is not None:
-            self._commit_fut.result()  # ledger commits stay in order
-            self._commit_fut = None
+            # pop BEFORE waiting so a commit error surfaces exactly once
+            fut, self._commit_fut = self._commit_fut, None
+            _wait_result(fut, "committer", self.channel)  # in order
         t2 = time.perf_counter()
         self.tracer.add("commit_wait", t1, t2, parent=root)
         barrier = _is_barrier(pend, batch)
@@ -483,7 +634,13 @@ class CommitPipeline:
             )
             try:
                 with self.tracer.span("commit", parent=root):
+                    _faults.fire("pipeline.commit")
                     self.commit_fn(res)
+            except BaseException:
+                self._note_stage_failure(
+                    "commit", res.block.header.number
+                )
+                raise
             finally:
                 self.tracer.finish_block(root)
             self._overlay = self._extra = None
@@ -505,31 +662,42 @@ class CommitPipeline:
         block, fut, root = self._pre
         self._pre = None
         t0 = time.perf_counter()
-        pre = fut.result()  # host parse ran while the device synced
-        if self._stale_prefetch:
-            # this block was staged on the prefetch thread BEFORE its
-            # barrier predecessor committed, so its parse/policy plans
-            # saw pre-barrier state — and validate_launch's staleness
-            # backstop is an identity check that state-backed policy
-            # providers (lifecycle caches rotate IN PLACE) never trip.
-            # Redo the parse against post-barrier state; barriers are
-            # rare, the serial redo is the correctness price.
-            self._stale_prefetch = False
-            self.tracer.event("stale_prefetch_reparse", parent=root)
-            with self.tracer.span("re-prefetch", parent=root):
-                pre = self.prefetch_fn(block)
+        try:
+            # host parse ran while the device synced
+            pre = _wait_result(fut, "prefetch", self.channel)
+            if self._stale_prefetch:
+                # this block was staged on the prefetch thread BEFORE
+                # its barrier predecessor committed, so its parse/
+                # policy plans saw pre-barrier state — and
+                # validate_launch's staleness backstop is an identity
+                # check that state-backed policy providers (lifecycle
+                # caches rotate IN PLACE) never trip.  Redo the parse
+                # against post-barrier state; barriers are rare, the
+                # serial redo is the correctness price.
+                self._stale_prefetch = False
+                self.tracer.event("stale_prefetch_reparse", parent=root)
+                with self.tracer.span("re-prefetch", parent=root):
+                    pre = self.prefetch_fn(block)
+        except BaseException:
+            self._note_stage_failure("prefetch", block.header.number)
+            raise
         t1 = time.perf_counter()
         self.tracer.add("prefetch_wait", t0, t1, parent=root)
-        with self.tracer.span("launch", parent=root):
-            if self.pre_launch_fn is not None:
-                # caller thread, AFTER any predecessor barrier flushed —
-                # the node verifies orderer block signatures here
-                # against the post-rotation bundle
-                self.pre_launch_fn(block)
-            self._launched = self.validator.validate_launch(
-                block, pre=pre, overlay=self._overlay,
-                extra_txids=self._extra,
-            )
+        try:
+            with self.tracer.span("launch", parent=root):
+                _faults.fire("pipeline.launch")
+                if self.pre_launch_fn is not None:
+                    # caller thread, AFTER any predecessor barrier
+                    # flushed — the node verifies orderer block
+                    # signatures here against the post-rotation bundle
+                    self.pre_launch_fn(block)
+                self._launched = self.validator.validate_launch(
+                    block, pre=pre, overlay=self._overlay,
+                    extra_txids=self._extra,
+                )
+        except BaseException:
+            self._note_stage_failure("launch", block.header.number)
+            raise
         self._launched_root = root
         t2 = time.perf_counter()
         self._launch_s = t2 - t1

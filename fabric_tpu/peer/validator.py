@@ -40,6 +40,7 @@ from functools import cached_property
 
 import numpy as np
 
+from fabric_tpu import faults as _faults
 from fabric_tpu import protoutil
 from fabric_tpu.crypto import policy as pol
 from fabric_tpu.crypto.identity import Identity, sig_to_ints
@@ -211,6 +212,76 @@ class _SlowItems:
         return len(self.slow) - 1
 
 
+class _HostVerifyHandle:
+    """A completed CPU verify masquerading as a fetch handle: the
+    degraded device lane routes blocks here (``ops/p256.verify_host``
+    under ``faults.shield()``, pure-Python ``ec_ref`` as the last
+    ditch).  It deliberately exposes NO ``device_out`` — the fused
+    stage-2 program never launches for these blocks, so they take the
+    host MVCC path with identical verdicts."""
+
+    __slots__ = ("result",)
+
+    def __init__(self, result: list):
+        self.result = result
+
+    def fetch(self) -> list:
+        return self.result
+
+    def __call__(self) -> list:
+        return self.result
+
+
+class _GuardedHandle:
+    """A device VerifyHandle wrapped with the lane guard's success /
+    failure / deadline accounting at the fetch (device sync) boundary.
+    ``device_out`` forwards so the fused stage-2 path is unchanged; a
+    fetch-side device failure re-verifies THIS block on the CPU
+    (correctness first) and counts toward the degraded latch."""
+
+    __slots__ = ("_h", "_guard", "_validator", "_items", "_result")
+
+    def __init__(self, handle, guard, validator, items):
+        self._h = handle
+        self._guard = guard
+        self._validator = validator
+        self._items = items
+        self._result = None
+
+    @property
+    def device_out(self):
+        return getattr(self._h, "device_out", None)
+
+    @property
+    def n_real(self) -> int:
+        return getattr(self._h, "n_real", 0)
+
+    def fetch(self) -> list:
+        if self._result is not None:
+            return self._result
+        t0 = time.perf_counter()
+        try:
+            out = self._h()
+        except Exception as e:
+            self._guard.record_failure(e)
+            self._guard.count_fallback()  # this block rides the CPU
+            _log.warning(
+                "device verify sync failed (%s) — re-verifying this "
+                "block on the CPU fallback", e,
+            )
+            self._result = self._validator._host_verify_fallback(
+                self._items
+            )
+            return self._result
+        if not self._guard.check_deadline(time.perf_counter() - t0):
+            self._guard.record_success()
+        self._result = out
+        return out
+
+    def __call__(self) -> list:
+        return self.fetch()
+
+
 @dataclass
 class _DevicePre:
     """State-independent device-path inputs built at preprocess time
@@ -244,6 +315,11 @@ class BlockValidator:
         host_stage_workers: int = 0,
         recode_device: bool = False,
         host_stage_mode: str = "thread",
+        device_fail_threshold: int = 0,
+        device_retries: int = 2,
+        device_recovery_s: float = 30.0,
+        verify_deadline_ms: float = 0.0,
+        channel: str = "",
     ):
         self.msp = msp_manager
         self.policies = policy_provider
@@ -313,6 +389,27 @@ class BlockValidator:
         # path computes windows for free, and CPU-only hosts see no
         # H2D bottleneck to shrink).  Bit-equal either way.
         self.recode_device = bool(recode_device)
+        # device-lane degradation guard (peer/degrade.py, nodeconfig
+        # device_fail_threshold / device_retries / device_recovery_s /
+        # verify_deadline_ms): bounded-retry device launches that latch
+        # a degraded CPU mode (ops/p256.verify_host + the host MVCC
+        # path — correctness identical, the channel stays live) after
+        # consecutive failures, with a periodic recovery probe.
+        # threshold 0 = guard off entirely (today's raise-through
+        # behavior; tier-1 and CPU-only hosts unchanged).
+        self.channel = channel
+        if device_fail_threshold > 0:
+            from fabric_tpu.peer.degrade import DeviceLaneGuard
+
+            self.device_guard = DeviceLaneGuard(
+                retries=device_retries,
+                fail_threshold=device_fail_threshold,
+                recovery_s=device_recovery_s,
+                deadline_ms=verify_deadline_ms,
+                channel=channel,
+            )
+        else:
+            self.device_guard = None
         # optional phase accumulator (seconds per phase, summed across
         # blocks) — the bench publishes it as the per-phase breakdown
         # artifact; None = no instrumentation overhead
@@ -360,6 +457,85 @@ class BlockValidator:
         e = ec_ref.digest_int(b"warmup")
         r, s = k.sign_digest(e)
         p256.verify_host([(e, r, s, *k.public)] * n_sigs)
+
+    # -- device lane: guarded dispatch + CPU fallback ----------------------
+
+    def _verify_launch_guarded(self, items):
+        """One block's verify dispatch through the device-lane guard
+        (bounded retry → degraded CPU fallback); the raw launch when no
+        guard is configured — the zero-overhead default."""
+
+        def launch():
+            return p256.verify_launch(
+                items, chunk=self.verify_chunk or None, mesh=self.mesh,
+                pool=self.host_pool, recode_device=self.recode_device,
+            )
+
+        if self.device_guard is None:
+            return launch()
+        out = self.device_guard.run_launch(
+            launch, lambda: self._host_verify_handle(items)
+        )
+        if isinstance(out, _HostVerifyHandle):
+            return out
+        return _GuardedHandle(out, self.device_guard, self, items)
+
+    def _verify_launch_many_guarded(self, itemsets, pool=None):
+        """Coalesced multi-block dispatch through the guard: one
+        device attempt covers the group; a degraded lane verifies each
+        block's batch on the CPU instead (every block counted on
+        ``fallback_blocks_total``)."""
+
+        def launch():
+            return p256.verify_launch_many(
+                itemsets, chunk=self.verify_chunk or None,
+                mesh=self.mesh, pool=pool,
+                recode_device=self.recode_device,
+            )
+
+        if self.device_guard is None:
+            return launch()
+        out = self.device_guard.run_launch(
+            launch,
+            lambda: [self._host_verify_handle(it) for it in itemsets],
+            fallback_count=len(itemsets),
+        )
+        return [
+            h if isinstance(h, _HostVerifyHandle)
+            else _GuardedHandle(h, self.device_guard, self, it)
+            for h, it in zip(out, itemsets)
+        ]
+
+    def _host_verify_handle(self, items) -> "_HostVerifyHandle":
+        """The degraded route for one block's signature batch: a
+        synchronous CPU verify with no async device handle, no fused
+        stage-2, no mesh/chunk/pool machinery."""
+        return _HostVerifyHandle(self._host_verify_fallback(items))
+
+    def _host_verify_fallback(self, items) -> list:
+        """items → list[bool] on the CPU lane.  ``ops/p256.verify_host``
+        under ``faults.shield()`` first (the plain synchronous path);
+        if even that lane is dead, the pure-Python ``ec_ref`` oracle
+        verifies signature by signature — slow, dependency-free, and
+        bit-identical in accept set (low-S included)."""
+        tuples = items.tuples() if hasattr(items, "tuples") else list(items)
+        if not tuples:
+            return []
+        try:
+            with _faults.shield():
+                return [bool(v) for v in p256.verify_host(tuples)]
+        except Exception as e:
+            _log.warning(
+                "CPU verify_host lane failed too (%s) — falling back to "
+                "the pure-Python reference verifier for %d signatures",
+                e, len(tuples),
+            )
+            from fabric_tpu.crypto import ec_ref
+
+            return [
+                ec_ref.verify_digest((qx, qy), e_, r, s)
+                for (e_, r, s, qx, qy) in tuples
+            ]
 
     # -- phase 0: parse + collect -----------------------------------------
 
@@ -973,10 +1149,7 @@ class BlockValidator:
         t0 = time.perf_counter()
         txs, items, rwp, fb = self._parse(block)
         t0 = self._t("host_parse", t0)
-        fetch = p256.verify_launch(
-            items, chunk=self.verify_chunk or None, mesh=self.mesh,
-            pool=self.host_pool, recode_device=self.recode_device,
-        )
+        fetch = self._verify_launch_guarded(items)
         t0 = self._t("sig_prepare_launch", t0)
         dpre = self._device_preprocess(txs, rwp, fb)
         t0 = self._t("device_pre", t0)
@@ -1009,9 +1182,8 @@ class BlockValidator:
             parsed.append(self._parse(block))
             self._t("host_parse", t0)
         t0 = time.perf_counter()
-        fetches = p256.verify_launch_many(
-            [p[1] for p in parsed], chunk=self.verify_chunk or None,
-            mesh=self.mesh, recode_device=self.recode_device,
+        fetches = self._verify_launch_many_guarded(
+            [p[1] for p in parsed]
         )
         self._t("sig_prepare_launch", t0)
         out = []
@@ -1062,9 +1234,8 @@ class BlockValidator:
             ))
         self._t("host_parse", t0)
         t0 = time.perf_counter()
-        fetches = p256.verify_launch_many(
-            [p[1] for p in parsed], chunk=self.verify_chunk or None,
-            mesh=self.mesh, pool=pool, recode_device=self.recode_device,
+        fetches = self._verify_launch_many_guarded(
+            [p[1] for p in parsed], pool=pool
         )
         t0 = self._t("sig_prepare_launch", t0)
         out = []
@@ -1153,9 +1324,21 @@ class BlockValidator:
             getattr(fetch, "device_out", None) is not None and txs and dpre
             and not self._sbe_launch_veto(txs, dpre, overlay)
         ):
-            pending.fetch2, pending.range_phantom = self._launch_device(
-                block, txs, fetch, dpre, overlay
-            )
+            try:
+                pending.fetch2, pending.range_phantom = self._launch_device(
+                    block, txs, fetch, dpre, overlay
+                )
+            except Exception as e:
+                # fused stage-2 dispatch died: with a lane guard this
+                # block degrades to the host MVCC path (fetch2 stays
+                # None) instead of tearing the stream down
+                if self.device_guard is None:
+                    raise
+                self.device_guard.record_failure(e)
+                _log.warning(
+                    "fused stage-2 dispatch failed (%s) — block %d "
+                    "takes the host path", e, block.header.number,
+                )
         return pending
 
     def _sbe_launch_veto(self, txs, dpre, overlay) -> bool:
@@ -1188,9 +1371,33 @@ class BlockValidator:
 
     def validate_finish(self, pending: "PendingBlock"):
         """Sync the device stage-2 of a launched block and produce the
-        (filter, batch, history) triple."""
+        (filter, batch, history) triple.  With a device-lane guard, a
+        stage-2 sync failure degrades THIS block to the host path (the
+        guarded verify handle re-verifies on CPU if the device output
+        is gone too) and counts toward the degraded latch."""
         if pending.fetch2 is not None:
-            result = self._finish_device(pending)
+            self._last_device_sync_s = 0.0
+            try:
+                result = self._finish_device(pending)
+            except Exception as e:
+                if self.device_guard is None:
+                    raise
+                self.device_guard.record_failure(e)
+                _log.warning(
+                    "device stage-2 sync failed (%s) — block %d "
+                    "re-validating on the host path", e,
+                    pending.block.header.number,
+                )
+                result = None
+            else:
+                if result is not None and self.device_guard is not None:
+                    # only the fetch2() sync is the lane's latency —
+                    # the host postprocess after it must not trip a
+                    # deadline tuned for the device
+                    if not self.device_guard.check_deadline(
+                        self._last_device_sync_s
+                    ):
+                        self.device_guard.record_success()
             if result is not None:
                 return result
         return self._validate_host(
@@ -1755,6 +1962,7 @@ class BlockValidator:
 
         if self._device_pipeline is None:
             self._device_pipeline = DeviceBlockPipeline()
+        _faults.fire("validator.stage2")  # chaos hook (no-op unarmed)
         fetch2 = self._device_pipeline.run(
             handle, launch_vec, dpre.groups, static.packed_static(),
             static.dims, t_bucket, mesh=self.mesh,
@@ -1797,7 +2005,11 @@ class BlockValidator:
         dpre = pending.dpre
         t0 = time.perf_counter()
         out = pending.fetch2()
-        t0 = self._t("device_wait", t0)
+        t1 = self._t("device_wait", t0)
+        # sync-only duration for the guard's deadline: the host-side
+        # postprocess below must not count against the DEVICE lane
+        self._last_device_sync_s = t1 - t0
+        t0 = t1
 
         # consumption-unsafe rows → exact host interpreter path
         for safe_bits, ents in zip(out["safe"], dpre.group_entries):

@@ -1,0 +1,78 @@
+"""Capped exponential backoff with jitter — the one retry cadence the
+commit path shares.
+
+Two hot consumers: the deliver loop's orderer reconnects (which used
+to spin on a fixed 0.2 s — an orderer outage turned every peer into a
+connect storm) and the validator's device-verify retries (a transient
+XLA launch failure deserves a brief, bounded pause, not a tight loop
+against a wedged runtime).  Both want the same shape: delays that grow
+``factor``× per consecutive failure, never exceed ``cap``, carry
+full jitter (each delay is drawn uniformly from [delay·(1−jitter),
+delay]) so a fleet of peers doesn't reconnect in lockstep, and reset
+to ``base`` the moment progress happens.
+
+The class only COMPUTES delays — callers sleep (``time.sleep`` on
+worker threads, ``asyncio.sleep`` on the loop), so one implementation
+serves both worlds.  Seedable for deterministic tests.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+
+
+class Backoff:
+    """Capped exponential delay sequence with full jitter.
+
+    >>> bo = Backoff(base=0.2, cap=5.0, rng=random.Random(0))
+    >>> bo.next()  # ~0.2, then ~0.4, ~0.8 ... capped at 5.0
+    """
+
+    def __init__(self, base: float = 0.2, cap: float = 15.0,
+                 factor: float = 2.0, jitter: float = 0.5,
+                 rng: random.Random | None = None):
+        if base <= 0 or cap < base or factor < 1.0:
+            raise ValueError(
+                f"Backoff(base={base}, cap={cap}, factor={factor}): "
+                "need base > 0, cap >= base, factor >= 1"
+            )
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError(f"Backoff jitter {jitter}: must be in [0, 1]")
+        self.base, self.cap, self.factor = base, cap, factor
+        self.jitter = jitter
+        self._rng = rng or random.Random()
+        self._attempt = 0
+        # smallest exponent at which base*factor**k already reaches
+        # cap: peek() clamps to it so a long outage (attempt ~1024 at
+        # factor 2.0) cannot overflow float exponentiation
+        self._exp_cap = (
+            0 if factor == 1.0
+            else math.ceil(math.log(cap / base, factor))
+        )
+
+    @property
+    def attempt(self) -> int:
+        """Consecutive failures since the last reset()."""
+        return self._attempt
+
+    def peek(self) -> float:
+        """The un-jittered delay the next ``next()`` would scale."""
+        return min(
+            self.cap,
+            self.base * self.factor ** min(self._attempt, self._exp_cap),
+        )
+
+    def next(self) -> float:
+        """Record one failure and return the delay to sleep before the
+        next attempt."""
+        d = self.peek()
+        self._attempt += 1
+        if self.jitter:
+            lo = d * (1.0 - self.jitter)
+            d = lo + self._rng.random() * (d - lo)
+        return d
+
+    def reset(self) -> None:
+        """Progress happened: the next failure starts from ``base``."""
+        self._attempt = 0

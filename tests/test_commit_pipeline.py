@@ -468,3 +468,133 @@ def test_commit_failure_surfaces_and_tail_not_silently_lost():
         assert boom["n"] >= 1
     finally:
         pipe.close(flush=False)
+
+
+def _no_live_pipeline_threads():
+    import threading
+
+    return [
+        t.name for t in threading.enumerate()
+        if t.is_alive() and t.name.startswith(
+            ("fabtpu-prefetch", "fabtpu-committer"))
+    ]
+
+
+def test_committer_exception_during_flush_fails_closed():
+    """Committer-thread exception surfacing at flush: the pipe drains,
+    the error surfaces exactly ONCE, the next submit raises 'pipeline
+    is closed' cleanly, and no non-daemon worker threads survive."""
+    blocks = _stream(3, 2)
+    state = MemVersionedDB()
+    v = ToyValidator(state)
+
+    def commit_fn(res):
+        raise RuntimeError("fsync wedged")
+
+    pipe = CommitPipeline(v, commit_fn, depth=2)
+    pipe.submit(blocks[0])
+    pipe.submit(blocks[1])  # block 0's commit fails on the committer
+    with pytest.raises(RuntimeError, match="fsync wedged"):
+        pipe.flush()
+    # once: the stored future was popped before the wait — the next
+    # calls see a cleanly closed pipe, not the same error again
+    with pytest.raises(RuntimeError, match="pipeline is closed"):
+        pipe.submit(blocks[2])
+    assert pipe.close() is None  # idempotent, no re-raise
+    assert _no_live_pipeline_threads() == []
+    assert pipe.last_failure is not None
+    assert pipe.last_failure[1] == "commit"
+
+
+def test_barrier_redo_prefetch_failure_no_wedged_threads():
+    """A barrier block whose successor's prefetch REDO itself fails:
+    the error surfaces as a prefetch-stage failure, the pipe fails
+    closed, and both worker threads drain — no wedged non-daemon
+    threads."""
+    blocks = _stream(4, 2)
+    lc = json.loads(bytes(blocks[1].data.data[0]))
+    lc["writes"]["_lifecycle/cc1"] = "defn"  # block 1 = barrier
+    blocks[1].data.data[0] = json.dumps(lc).encode()
+    state = MemVersionedDB()
+
+    class RedoBoomValidator(ToyValidator):
+        def preprocess(self, block):
+            out = super().preprocess(block)
+            n_parses = [n for n, _ in self.preprocess_order].count(2)
+            if block.header.number == 2 and n_parses == 2:
+                raise RuntimeError("redo boom")
+            return out
+
+    v = RedoBoomValidator(state)
+    committed = []
+
+    def commit_fn(res):
+        state.apply_updates(res.batch, (res.block.header.number, 0))
+        committed.append(res.block.header.number)
+
+    pipe = CommitPipeline(v, commit_fn, depth=2)
+    pipe.submit(blocks[0])
+    pipe.submit(blocks[1])
+    # submitting block 3 finishes the barrier (block 2's prefetch goes
+    # stale) and the post-barrier REDO of block 2 blows up
+    with pytest.raises(RuntimeError, match="redo boom"):
+        pipe.submit(blocks[2])
+        pipe.submit(blocks[3])
+        pipe.flush()
+    assert pipe.last_failure == (2, "prefetch")
+    with pytest.raises(RuntimeError, match="pipeline is closed"):
+        pipe.submit(blocks[3])
+    assert pipe.close(flush=False) is None
+    assert _no_live_pipeline_threads() == []
+    # everything BEFORE the quarantined block committed in order
+    assert committed == [0, 1]
+
+
+def test_stage_failure_metrics_and_resume_from_height():
+    """The containment contract end to end: an injected prefetch fault
+    fails the pipe closed with the stage counter bumped; a fresh pipe
+    resumes from the committed height and the stream completes with
+    serial-identical verdicts."""
+    from fabric_tpu import faults
+    from fabric_tpu.ops_metrics import global_registry
+
+    blocks = _stream(5, 4)
+    f_serial, s_serial, _ = _run(blocks, depth=1)
+    ctr = global_registry().counter(
+        "commit_pipeline_stage_failures_total"
+    )
+    before = ctr.value(channel="", stage="prefetch")
+    faults.configure("pipeline.prefetch:raise:n=1:after=2")
+    state = MemVersionedDB()
+    v = ToyValidator(state)
+    filters = {}
+    height = [0]
+
+    def commit_fn(res):
+        state.apply_updates(res.batch, (res.block.header.number, 0))
+        filters[res.block.header.number] = list(res.tx_filter)
+        height[0] = res.block.header.number + 1
+
+    try:
+        restarts = 0
+        pipe = CommitPipeline(v, commit_fn, depth=2)
+        while True:
+            try:
+                for b in blocks[height[0]:]:
+                    if b.header.number < height[0]:
+                        continue
+                    pipe.submit(b)
+                pipe.flush()
+                break
+            except Exception:
+                restarts += 1
+                assert restarts < 10
+                pipe.close(flush=False)
+                pipe = CommitPipeline(v, commit_fn, depth=2)
+        pipe.close()
+    finally:
+        faults.reset()
+    assert restarts == 1
+    assert ctr.value(channel="", stage="prefetch") == before + 1
+    assert sorted((n, f) for n, f in filters.items()) == f_serial
+    assert dict(state._data) == s_serial

@@ -74,6 +74,48 @@ def test_error_propagates():
             p.map(boom, range(6))
 
 
+def test_error_carries_stage_and_worker_labels():
+    """A raising worker task must not wedge the ordered map or drop a
+    shard silently: the FIRST error propagates with the failing
+    stage/worker attached (message suffix + attributes), type
+    preserved."""
+
+    def boom(x):
+        if x == 2:
+            raise ValueError("bad shard")
+        return x
+
+    with HostStagePool(2) as p:
+        with pytest.raises(ValueError, match=r"bad shard \[host pool "
+                           r"stage=recode worker=") as ei:
+            p.map(boom, range(6), stage="recode")
+        assert ei.value.fab_stage == "recode"
+        assert ei.value.fab_worker
+        # the pool still serves after the failure — nothing wedged
+        assert p.map(lambda x: x + 1, range(4), stage="recode") == [
+            1, 2, 3, 4
+        ]
+
+
+def test_injected_worker_fault_labeled_and_pool_survives():
+    """The ``hostpool.task`` chaos point: exactly one task dies, the
+    gather raises it (labeled), and the next map is clean once the
+    budget is spent."""
+    from fabric_tpu import faults
+
+    faults.configure("hostpool.task:raise:n=1")
+    try:
+        with HostStagePool(2) as p:
+            with pytest.raises(faults.InjectedFault) as ei:
+                p.map(lambda x: x, range(8), stage="parse")
+            assert ei.value.fab_stage == "parse"
+            assert p.map(lambda x: x * 2, range(4), stage="parse") == [
+                0, 2, 4, 6
+            ]
+    finally:
+        faults.reset()
+
+
 def test_telemetry_labels():
     from fabric_tpu.ops_metrics import global_registry
 

@@ -23,6 +23,7 @@ from fabric_tpu.analysis.rules.swallowed_exception import (
 from fabric_tpu.analysis.rules.kernel_dtype import KernelDtypeMismatchRule
 from fabric_tpu.analysis.rules.union_env import UnionEnvCoercionRule
 from fabric_tpu.analysis.rules.asyncio_task_leak import AsyncioTaskLeakRule
+from fabric_tpu.analysis.rules.blocking_wait import BlockingWaitRule
 
 
 def run_rule(tmp_path, rule, files: dict[str, str]):
@@ -1121,6 +1122,164 @@ def test_host_sync_roots_resolve():
     )
 
 
+# -- FT009 unbounded-blocking-wait ------------------------------------------
+
+BAD_WAITS = """\
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def feeder():
+    q = queue.Queue()
+    item = q.get()
+    return item
+
+
+def joiner():
+    t = threading.Thread(target=feeder)
+    t.start()
+    t.join()
+
+
+def eventer():
+    ev = threading.Event()
+    ev.wait()
+
+
+def futures():
+    ex = ThreadPoolExecutor(2)
+    f = ex.submit(feeder)
+    f.result()
+    ex.submit(feeder).result()
+"""
+
+SELF_ATTR_POP = """\
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Pipe:
+    def __init__(self):
+        self._ex = ThreadPoolExecutor(1)
+        self._fut = None
+
+    def push(self, fn):
+        self._fut = self._ex.submit(fn)
+
+    def drain(self):
+        fut, self._fut = self._fut, None
+        fut.result()
+"""
+
+CLEAN_WAITS = """\
+import asyncio
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def bounded():
+    q = queue.Queue()
+    q.get(True, 5)
+    q.get(timeout=1.0)
+    q.get_nowait()
+    q.get(False)        # non-blocking: raises Empty immediately
+    q.get(block=False)  # ditto
+    ev = threading.Event()
+    ev.wait(2.0)
+    ev.wait(timeout=0.1)
+    t = threading.Thread(target=bounded)
+    t.join(1)
+    ex = ThreadPoolExecutor(1)
+    ex.submit(bounded).result(timeout=5)
+
+
+def unknown(fut, q):
+    fut.result()
+    q.get()
+
+
+async def aio():
+    q = asyncio.Queue()
+    await q.get()
+    ev = asyncio.Event()
+    await ev.wait()
+"""
+
+
+class TestBlockingWait:
+    def test_flags_each_wait_kind(self, tmp_path):
+        got = run_rule(tmp_path, BlockingWaitRule(), {"mod.py": BAD_WAITS})
+        assert [(f.rule, f.line) for f in got] == [
+            ("FT009", 8),    # q.get()
+            ("FT009", 15),   # t.join()
+            ("FT009", 20),   # ev.wait()
+            ("FT009", 26),   # f.result() via ex.submit
+            ("FT009", 27),   # chained ex.submit(...).result()
+        ]
+        assert "Queue.get()" in got[0].message
+        assert "timeout=" in got[0].message
+
+    def test_flags_self_attr_pop_idiom(self, tmp_path):
+        # the `fut, self._fut = self._fut, None` pop before an
+        # unbounded wait — the exact pipeline committer idiom
+        got = run_rule(
+            tmp_path, BlockingWaitRule(), {"mod.py": SELF_ATTR_POP}
+        )
+        assert [(f.rule, f.line) for f in got] == [("FT009", 14)]
+
+    def test_flags_run_coroutine_threadsafe_bridge(self, tmp_path):
+        src = """\
+        import asyncio
+
+
+        def bridge(loop, coro):
+            fut = asyncio.run_coroutine_threadsafe(coro, loop)
+            return fut.result()
+        """
+        got = run_rule(tmp_path, BlockingWaitRule(), {"mod.py": src})
+        assert [(f.rule, f.line) for f in got] == [("FT009", 6)]
+
+    def test_flags_renamed_from_import(self, tmp_path):
+        src = """\
+        from threading import Event as Ev
+
+
+        def go():
+            e = Ev()
+            e.wait()
+        """
+        got = run_rule(tmp_path, BlockingWaitRule(), {"mod.py": src})
+        assert [(f.rule, f.line) for f in got] == [("FT009", 6)]
+
+    def test_clean_bounded_unknown_and_awaited(self, tmp_path):
+        got = run_rule(
+            tmp_path, BlockingWaitRule(), {"mod.py": CLEAN_WAITS}
+        )
+        assert got == []
+
+    def test_test_code_exempt(self, tmp_path):
+        got = run_rule(tmp_path, BlockingWaitRule(), {
+            "test_mod.py": BAD_WAITS,
+            "tests/helper.py": BAD_WAITS,
+            "conftest.py": BAD_WAITS,
+        })
+        assert got == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        src = "\n".join([
+            "import threading",
+            "",
+            "",
+            "def go():",
+            "    ev = threading.Event()",
+            "    ev.wait()  # fabtpu: noqa(FT009)",
+            "",
+        ])
+        got = run_rule(tmp_path, BlockingWaitRule(), {"mod.py": src})
+        assert got == []
+
+
 def test_rule_battery_registered():
     from fabric_tpu.analysis import all_rules
 
@@ -1134,4 +1293,5 @@ def test_rule_battery_registered():
         "FT006": "union-env-coercion",
         "FT007": "kernel-dtype-mismatch",
         "FT008": "asyncio-task-leak",
+        "FT009": "unbounded-blocking-wait",
     }
