@@ -8,6 +8,8 @@ Commands:
   configtxgen   genesis block from a JSON profile
   orderer       run an ordering node (JSON config)
   peer          run a peer node (JSON config)
+  sidecar-serve run a standalone validation sidecar (one device
+                fabric serving many peers' signature batches)
   osnadmin      orderer channel participation (join)
   invoke/query  gateway client round trips
   snapshot      request a ledger snapshot from a peer
@@ -153,6 +155,12 @@ async def _run_peer(cfg):
         device_recovery_s=cfg.device_recovery_s,
         verify_deadline_ms=cfg.verify_deadline_ms,
         faults=cfg.faults,
+        sidecar_endpoint=cfg.sidecar_endpoint,
+        sidecar_weight=cfg.sidecar_weight,
+        sidecar_recovery_s=cfg.sidecar_recovery_s,
+        sidecar_listen=cfg.sidecar_listen,
+        sidecar_queue_blocks=cfg.sidecar_queue_blocks,
+        sidecar_coalesce=cfg.sidecar_coalesce,
     )
     await node.start(operations_port=cfg.operations_port)
     print(f"peer {node.id} serving on :{node.port}", flush=True)
@@ -200,6 +208,54 @@ def _cmd_node(args, runner):
         asyncio.run(runner(cfg))
     except KeyboardInterrupt:
         pass
+
+
+async def _run_sidecar(args):
+    """Standalone validation sidecar: one device fabric serving many
+    peer processes (fabric_tpu/sidecar — the PAPER.md north-star
+    deployment shape).  Peers attach by setting ``sidecar_endpoint``
+    in their node config."""
+    from fabric_tpu.sidecar.server import SidecarServer
+    from fabric_tpu.sidecar.client import parse_endpoint
+
+    from fabric_tpu.utils.xla_env import enable_compile_cache
+
+    enable_compile_cache()
+
+    ssl_ctx = None
+    if args.tls_cert and args.tls_key:
+        from fabric_tpu.comm.rpc import make_server_tls
+
+        with open(args.tls_cert, "rb") as f:
+            cert = f.read()
+        with open(args.tls_key, "rb") as f:
+            key = f.read()
+        ca = None
+        if args.tls_ca:
+            with open(args.tls_ca, "rb") as f:
+                ca = f.read()
+        ssl_ctx = make_server_tls(cert, key, ca)
+    host, port = parse_endpoint(args.listen)
+    srv = SidecarServer(
+        host, port, mesh_devices=args.mesh_devices,
+        verify_chunk=args.verify_chunk,
+        recode_device=args.recode_device,
+        queue_blocks=args.queue_blocks, coalesce=args.coalesce,
+        ssl_ctx=ssl_ctx,
+    )
+    await srv.start()
+    print(f"validation sidecar serving on {srv.host}:{srv.port}",
+          flush=True)
+    if args.operations_port is not None:
+        from fabric_tpu.opsserver import HealthRegistry, OperationsServer
+
+        health = HealthRegistry()
+        health.register("sidecar", srv.health_check)
+        ops = await OperationsServer(
+            port=args.operations_port, health=health
+        ).start()
+        print(f"operations on :{ops.port}", flush=True)
+    await asyncio.Event().wait()
 
 
 async def _run_chaincode(args):
@@ -406,6 +462,20 @@ def main(argv=None):
     c = sub.add_parser("peer", help="run a peer node")
     c.add_argument("--config", required=True)
 
+    c = sub.add_parser("sidecar-serve",
+                       help="run a standalone validation sidecar")
+    c.add_argument("--listen", default="127.0.0.1:7054",
+                   help="host:port to serve the validate stream on")
+    c.add_argument("--mesh-devices", type=int, default=0,
+                   help="device-mesh sharding (-1 = all local devices)")
+    c.add_argument("--verify-chunk", type=int, default=0)
+    c.add_argument("--recode-device", action="store_true")
+    c.add_argument("--queue-blocks", type=int, default=8,
+                   help="per-tenant admission queue bound (BUSY past it)")
+    c.add_argument("--coalesce", type=int, default=4,
+                   help="max cross-tenant batches per device dispatch")
+    c.add_argument("--operations-port", type=int, default=None)
+
     c = sub.add_parser("chaincode", help="run a sample ccaas chaincode server")
     c.add_argument("--name", required=True)
     c.add_argument("--port", type=int, default=0)
@@ -499,6 +569,11 @@ def main(argv=None):
         _cmd_node(args, _run_orderer)
     elif args.cmd == "peer":
         _cmd_node(args, _run_peer)
+    elif args.cmd == "sidecar-serve":
+        try:
+            asyncio.run(_run_sidecar(args))
+        except KeyboardInterrupt:
+            pass
     elif args.cmd == "chaincode":
         try:
             asyncio.run(_run_chaincode(args))

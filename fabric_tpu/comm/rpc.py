@@ -25,6 +25,8 @@ import asyncio
 import ssl
 import struct
 
+from fabric_tpu import faults as _faults
+
 KIND_CALL = 1
 KIND_MSG = 2
 KIND_END = 3
@@ -38,7 +40,27 @@ class RpcError(Exception):
     pass
 
 
+class FrameTooLargeError(RpcError):
+    """A frame exceeding ``MAX_FRAME``, rejected on the SEND side.
+
+    The read path always bounded frames; without the send-side check a
+    caller handing an oversized payload (a runaway signature batch, a
+    snapshot that outgrew its cap) only learned about it when the
+    REMOTE tore the connection down — an unattributable disconnect
+    instead of a typed error at the call site."""
+
+
 async def _write_frame(writer, stream_id: int, kind: int, payload: bytes = b""):
+    if len(payload) > MAX_FRAME:
+        raise FrameTooLargeError(
+            f"frame too large to send: {len(payload)} bytes exceeds "
+            f"MAX_FRAME ({MAX_FRAME})"
+        )
+    # chaos hook: a FaultPlan can cut or delay any framed-RPC link
+    # (the sidecar stream included); afire so an armed latency fault
+    # slows THIS stream instead of freezing the whole event loop
+    if _faults.plan() is not None:
+        await _faults.afire("rpc.frame", kind=kind, stream=stream_id)
     writer.write(_HDR.pack(len(payload), stream_id, kind) + payload)
     await writer.drain()
 
@@ -53,11 +75,15 @@ async def _read_frame(reader):
 
 
 class _Stream:
-    """One logical RPC stream (either side)."""
+    """One logical RPC stream (either side).  ``method`` is the call
+    name the stream was opened with — ERR frames carry it so a
+    client-side stream failure names the RPC that died instead of an
+    anonymous error string."""
 
-    def __init__(self, conn: "_Conn", stream_id: int):
+    def __init__(self, conn: "_Conn", stream_id: int, method: str = ""):
         self.conn = conn
         self.id = stream_id
+        self.method = method
         self.inbox: asyncio.Queue = asyncio.Queue()
         self.closed = False
 
@@ -72,6 +98,8 @@ class _Stream:
     async def error(self, msg: str):
         if not self.closed:
             self.closed = True
+            if self.method and not msg.startswith(self.method):
+                msg = f"{self.method}: {msg}"
             await _write_frame(self.conn.writer, self.id, KIND_ERR, msg.encode())
 
     def dispose(self):
@@ -117,9 +145,9 @@ class _Conn:
                 if kind == KIND_CALL:
                     if dispatch is None:
                         continue
-                    st = _Stream(self, stream_id)
+                    st = _Stream(self, stream_id, method=payload.decode())
                     self.streams[stream_id] = st
-                    t = asyncio.ensure_future(dispatch(payload.decode(), st))
+                    t = asyncio.ensure_future(dispatch(st.method, st))
                     self._tasks.add(t)
                     t.add_done_callback(self._tasks.discard)
                 elif stream_id in self.streams:
@@ -249,7 +277,7 @@ class RpcClient:
         async with self.conn.lock:
             stream_id = self.conn.next_id
             self.conn.next_id += 1
-        st = _Stream(self.conn, stream_id)
+        st = _Stream(self.conn, stream_id, method=method)
         self.conn.streams[stream_id] = st
         await _write_frame(self.conn.writer, stream_id, KIND_CALL, method.encode())
         return st

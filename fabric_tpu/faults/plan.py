@@ -25,6 +25,12 @@ injection point                 fires
 ``ledger.fsync.before``         BlockStore, right before ``os.fsync``
 ``ledger.fsync.after``          BlockStore, right after ``os.fsync``
 ``deliver.read``                the deliver stream reader, per block
+``rpc.frame``                   comm.rpc frame SEND (every framed-RPC link,
+                                the sidecar stream included) — async-aware,
+                                so latency slows one stream, not the loop
+``sidecar.request``             sidecar server request admission, per batch
+``sidecar.dispatch``            the sidecar scheduler's coalesced device
+                                dispatch (cross-tenant batch group)
 ==============================  ============================================
 
 Fault kinds:

@@ -172,6 +172,29 @@ class PeerConfig:
     # Python — so this is a latch signal for future blocks, not a
     # per-block abort.  0 = no deadline.
     verify_deadline_ms: float = 0.0
+    # validation sidecar, client side (fabric_tpu/sidecar): with an
+    # endpoint set, every channel's validator ships its signature
+    # batches to the sidecar's shared device fabric instead of owning
+    # a local device lane (SidecarValidator); "" = in-process device
+    # lane, today's behavior.  Weight is this peer's fair-share claim
+    # in the sidecar's weighted-deficit-round-robin scheduler, and
+    # sidecar_recovery_s paces the degrade latch's re-attach probes
+    # after a sidecar loss (blocks ride the local CPU fallback while
+    # detached — latency degrades, liveness never does).
+    sidecar_endpoint: str = ""
+    sidecar_weight: float = 1.0
+    sidecar_recovery_s: float = 5.0
+    # validation sidecar, server side: a host:port makes THIS process
+    # also serve a validation sidecar from its device fabric (the
+    # many-peers-one-pod shape; `python -m fabric_tpu.cli
+    # sidecar-serve` runs it standalone).  queue_blocks bounds each
+    # tenant's admission queue (a full queue answers a typed BUSY
+    # frame — explicit backpressure, not unbounded buffering) and
+    # sidecar_coalesce caps how many cross-tenant batches merge into
+    # one padded device dispatch.
+    sidecar_listen: str = ""
+    sidecar_queue_blocks: int = 8
+    sidecar_coalesce: int = 4
     # chaos fault plan (fabric_tpu/faults): spec string arming named
     # injection points, e.g.
     # 'validator.verify_launch:raise:n=3;deliver.read:disconnect:n=1'.

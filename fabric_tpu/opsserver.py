@@ -159,6 +159,7 @@ class OperationsServer:
         "commit_pipeline_overlap_ratio",
         "validator_stage_seconds",
         "host_stage_pool_seconds",
+        "sidecar_request_seconds",
     )
 
     def _route_trace(self, path: str):

@@ -66,7 +66,11 @@ class PeerChannel:
                  device_fail_threshold: int = 0,
                  device_retries: int = 2,
                  device_recovery_s: float = 30.0,
-                 verify_deadline_ms: float = 0.0):
+                 verify_deadline_ms: float = 0.0,
+                 sidecar_endpoint: str = "",
+                 sidecar_weight: float = 1.0,
+                 sidecar_recovery_s: float = 5.0,
+                 sidecar_ssl=None):
         self.id = channel_id
         # block-commit span tracer knobs (nodeconfig trace_ring_blocks
         # / trace_slow_factor): configure the process-global tracer the
@@ -163,8 +167,7 @@ class PeerChannel:
                 "join without genesis_block/snapshot requires explicit "
                 "msp_manager and policy_provider"
             )
-        self.validator = BlockValidator(
-            msp_manager, policy_provider, self.ledger.state,
+        validator_kw = dict(
             block_store=self.ledger.blocks, config_processor=config_processor,
             verify_chunk=verify_chunk, mesh_devices=mesh_devices,
             host_stage_workers=host_stage_workers,
@@ -175,6 +178,28 @@ class PeerChannel:
             verify_deadline_ms=verify_deadline_ms,
             channel=channel_id,
         )
+        if sidecar_endpoint:
+            # nodeconfig ``sidecar_endpoint``: the channel's signature
+            # batches ship to a shared validation sidecar
+            # (fabric_tpu/sidecar) instead of owning a local device
+            # lane; sidecar loss latches the local CPU fallback and
+            # re-attaches via recovery probes, so the channel stays
+            # live through sidecar restarts
+            from fabric_tpu.sidecar.validator import SidecarValidator
+
+            self.validator = SidecarValidator(
+                msp_manager, policy_provider, self.ledger.state,
+                sidecar_endpoint=sidecar_endpoint,
+                sidecar_weight=sidecar_weight,
+                sidecar_recovery_s=sidecar_recovery_s,
+                sidecar_ssl=sidecar_ssl,
+                **validator_kw,
+            )
+        else:
+            self.validator = BlockValidator(
+                msp_manager, policy_provider, self.ledger.state,
+                **validator_kw,
+            )
         from fabric_tpu.peer.coordinator import PvtDataCoordinator
         from fabric_tpu.peer.transient import TransientStore
 
@@ -1000,7 +1025,13 @@ class PeerNode:
                  device_retries: int = 2,
                  device_recovery_s: float = 30.0,
                  verify_deadline_ms: float = 0.0,
-                 faults: str = ""):
+                 faults: str = "",
+                 sidecar_endpoint: str = "",
+                 sidecar_weight: float = 1.0,
+                 sidecar_recovery_s: float = 5.0,
+                 sidecar_listen: str = "",
+                 sidecar_queue_blocks: int = 8,
+                 sidecar_coalesce: int = 4):
         self.id = node_id
         self.dir = data_dir
         self.msp = msp_manager
@@ -1024,6 +1055,16 @@ class PeerNode:
         self.device_retries = int(device_retries)
         self.device_recovery_s = float(device_recovery_s)
         self.verify_deadline_ms = float(verify_deadline_ms)
+        # validation sidecar knobs (fabric_tpu/sidecar): endpoint =
+        # this peer's channels validate through a remote sidecar;
+        # listen = this process ALSO serves one from its device fabric
+        self.sidecar_endpoint = sidecar_endpoint
+        self.sidecar_weight = float(sidecar_weight)
+        self.sidecar_recovery_s = float(sidecar_recovery_s)
+        self.sidecar_listen = sidecar_listen
+        self.sidecar_queue_blocks = int(sidecar_queue_blocks)
+        self.sidecar_coalesce = int(sidecar_coalesce)
+        self.sidecar_server = None
         if faults:
             # chaos spec (nodeconfig ``faults`` / FABTPU_FAULTS): arm
             # the process-global fault plan — staging/soak rigs only
@@ -1214,6 +1255,10 @@ class PeerNode:
             device_retries=self.device_retries,
             device_recovery_s=self.device_recovery_s,
             verify_deadline_ms=self.verify_deadline_ms,
+            sidecar_endpoint=self.sidecar_endpoint,
+            sidecar_weight=self.sidecar_weight,
+            sidecar_recovery_s=self.sidecar_recovery_s,
+            sidecar_ssl=self.tls.client_ctx() if self.tls else None,
         )
         ch.client_ssl = self.tls.client_ctx() if self.tls else None
         ch.runtime = self.runtime  # resolved-binding invalidation hook
@@ -1242,6 +1287,24 @@ class PeerNode:
         self.gossip_service = GossipService(self).register()
         await self.server.start()
         self.port = self.server.port
+        if self.sidecar_listen:
+            # nodeconfig ``sidecar_listen``: this peer's device fabric
+            # ALSO serves a validation sidecar — other peers attach as
+            # tenants (the many-peers-one-pod shape without a separate
+            # sidecar process)
+            from fabric_tpu.sidecar.server import SidecarServer
+            from fabric_tpu.sidecar.client import parse_endpoint
+
+            sc_host, sc_port = parse_endpoint(self.sidecar_listen)
+            self.sidecar_server = await SidecarServer(
+                sc_host, sc_port,
+                mesh_devices=self.mesh_devices,
+                verify_chunk=self.verify_chunk,
+                recode_device=self.recode_device,
+                queue_blocks=self.sidecar_queue_blocks,
+                coalesce=self.sidecar_coalesce,
+                ssl_ctx=self.tls.server_ctx() if self.tls else None,
+            ).start()
         self.operations = None
         if operations_port is not None:
             from fabric_tpu.opsserver import HealthRegistry, OperationsServer
@@ -1265,14 +1328,23 @@ class PeerNode:
                 for cid, ch in self.channels.items():
                     g = getattr(ch.validator, "device_guard", None)
                     if g is not None and g.degraded:
+                        lane = (
+                            "sidecar link"
+                            if getattr(ch.validator, "link", None)
+                            is not None else "device verify lane"
+                        )
                         return (
-                            f"channel {cid}: device verify lane "
-                            "DEGRADED — committing via CPU fallback, "
-                            "recovery probe armed"
+                            f"channel {cid}: {lane} DEGRADED — "
+                            "committing via CPU fallback, recovery "
+                            "probe armed"
                         )
                 return None
 
             health.register("device_verify_lane", _device_lanes)
+            if self.sidecar_server is not None:
+                health.register(
+                    "sidecar_server", self.sidecar_server.health_check
+                )
             self.operations = await OperationsServer(
                 port=operations_port, health=health
             ).start()
@@ -1285,6 +1357,8 @@ class PeerNode:
             await self.gossip_service.stop()
         if getattr(self, "operations", None) is not None:
             await self.operations.stop()
+        if self.sidecar_server is not None:
+            await self.sidecar_server.stop()
         await self.server.stop()
 
     async def _on_endorse(self, req: bytes) -> bytes:

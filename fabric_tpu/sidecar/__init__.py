@@ -1,0 +1,21 @@
+"""fabric_tpu.sidecar — the multi-tenant validation sidecar: one
+device fabric serving many peer processes over ``comm.rpc``, with
+weighted-deficit-round-robin fairness and typed backpressure.
+
+Crypto-free surface (server, scheduler, client link, wire codec)
+imports eagerly; :class:`SidecarValidator` lives in
+``sidecar.validator`` and is imported lazily because it subclasses
+the real ``BlockValidator`` (which needs the ``cryptography``
+package).
+"""
+
+from fabric_tpu.sidecar.client import (  # noqa: F401
+    RemoteVerifyHandle,
+    SidecarLink,
+    SidecarUnavailable,
+)
+from fabric_tpu.sidecar.scheduler import (  # noqa: F401
+    Request,
+    WeightedScheduler,
+)
+from fabric_tpu.sidecar.server import SidecarServer  # noqa: F401

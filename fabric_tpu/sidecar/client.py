@@ -1,0 +1,301 @@
+"""Client side of the validation sidecar: the link a peer's validator
+rides.
+
+``SidecarLink`` owns ONE connection to a sidecar server per tenant
+(channel): a daemon thread runs a private asyncio loop hosting the
+``comm.rpc`` client, the ``validate`` bidi stream, and a reader task
+that correlates responses to in-flight requests by sequence number.
+The validator-facing surface is synchronous and thread-safe —
+``submit(tuples)`` returns a :class:`RemoteVerifyHandle` immediately
+(the async-dispatch shape ``BlockValidator`` already expects from a
+device launch) and the verdicts materialize at ``fetch()``.
+
+Contract with the degrade machinery (``peer/degrade.py``):
+
+* a BUSY frame (the server's typed backpressure) is retried
+  transparently with capped-exponential backoff
+  (``utils.backoff.Backoff``) up to ``busy_retries`` times — sustained
+  saturation then surfaces as :class:`SidecarUnavailable`;
+* connection loss, a typed ERROR frame, or a response timeout raise
+  :class:`SidecarUnavailable` from ``fetch()`` — the caller's
+  ``DeviceLaneGuard`` counts it toward the degraded latch and routes
+  the block through the local CPU fallback;
+* every ``submit`` while detached attempts a fresh connect, so the
+  guard's periodic recovery probe IS the re-attach path: when the
+  sidecar comes back, one probe block reconnects and re-arms the lane.
+
+The module is crypto-free and JAX-free on purpose: toy validators in
+tests and the real ``SidecarValidator`` share it unchanged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+
+from fabric_tpu.comm.rpc import RpcClient, RpcError
+from fabric_tpu.sidecar import wire
+from fabric_tpu.utils.backoff import Backoff
+
+_log = logging.getLogger("fabric_tpu.sidecar.client")
+
+#: seconds granted to connect + hello before a submit gives up
+CONNECT_TIMEOUT_S = 5.0
+
+
+class SidecarUnavailable(RuntimeError):
+    """The sidecar could not serve this batch (down, saturated past
+    the busy-retry budget, or errored) — verify locally."""
+
+
+def parse_endpoint(endpoint: str) -> tuple[str, int]:
+    """'host:port' (or ':port' / 'port') → (host, port)."""
+    host, _, port = str(endpoint).rpartition(":")
+    if not port.isdigit():
+        raise ValueError(
+            f"sidecar endpoint {endpoint!r}: expected 'host:port'"
+        )
+    return host or "127.0.0.1", int(port)
+
+
+class RemoteVerifyHandle:
+    """One in-flight batch's verdict future, quacking like a device
+    VerifyHandle: no ``device_out`` (sidecar blocks take the host MVCC
+    path, verdict-identical), ``fetch()``/``__call__`` block until the
+    response frame lands or raise :class:`SidecarUnavailable`."""
+
+    __slots__ = ("_fut", "_timeout", "n_real")
+
+    def __init__(self, fut, timeout_s: float, n_real: int = 0):
+        self._fut = fut
+        self._timeout = timeout_s
+        self.n_real = n_real
+
+    def fetch(self) -> list:
+        try:
+            return self._fut.result(timeout=self._timeout)
+        except SidecarUnavailable:
+            raise
+        except Exception as e:  # timeout, cancelled, loop torn down
+            raise SidecarUnavailable(f"sidecar fetch failed: {e}") from e
+
+    def __call__(self) -> list:
+        return self.fetch()
+
+
+class SidecarLink:
+    """See module docstring."""
+
+    def __init__(self, host: str, port: int, tenant: str,
+                 weight: float = 1.0, ssl_ctx=None,
+                 timeout_s: float = 30.0, busy_retries: int = 6,
+                 backoff: Backoff | None = None, registry=None):
+        self.host, self.port = host, int(port)
+        self.tenant = tenant
+        self.weight = float(weight)
+        self.ssl_ctx = ssl_ctx
+        self.timeout_s = float(timeout_s)
+        self.busy_retries = int(busy_retries)
+        self._backoff_proto = backoff
+        self._client: RpcClient | None = None
+        self._stream = None
+        self._reader_task: asyncio.Task | None = None
+        self._conn_lock: asyncio.Lock | None = None  # created on-loop
+        self._pending: dict[int, asyncio.Future] = {}
+        self._seq = 0
+        self._closed = False
+        if registry is None:
+            from fabric_tpu.ops_metrics import global_registry
+
+            registry = global_registry()
+        self._busy_ctr = registry.counter(
+            "sidecar_client_busy_total",
+            "BUSY backpressure frames absorbed by client backoff",
+        )
+        self._reattach_ctr = registry.counter(
+            "sidecar_client_attach_total",
+            "sidecar stream (re)attachments by tenant",
+        )
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._run_loop, name=f"fabtpu-sidecar-{tenant}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _run_loop(self) -> None:
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.close()
+
+    # -- sync surface (validator threads) ----------------------------------
+
+    @property
+    def attached(self) -> bool:
+        return self._stream is not None
+
+    def submit(self, tuples) -> RemoteVerifyHandle:
+        """Queue one signature batch; raises
+        :class:`SidecarUnavailable` only when the link is closed —
+        connect/transport errors surface at ``fetch()`` so the launch
+        keeps its async-dispatch shape."""
+        if self._closed or not self._thread.is_alive():
+            raise SidecarUnavailable("sidecar link is closed")
+        tuples = list(tuples)
+        fut = asyncio.run_coroutine_threadsafe(
+            self._asubmit(tuples), self._loop
+        )
+        # worst case: every attempt burns its own response timeout plus
+        # the busy backoff between — bound the caller's wait to that
+        bound = (self.busy_retries + 1) * self.timeout_s + 10.0
+        return RemoteVerifyHandle(fut, bound, n_real=len(tuples))
+
+    def submit_many(self, tuple_sets) -> list:
+        """One handle per batch; the server's scheduler coalesces them
+        (cross-tenant included) into shared device dispatches."""
+        return [self.submit(t) for t in tuple_sets]
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread.is_alive():
+            asyncio.run_coroutine_threadsafe(
+                self._aclose(), self._loop
+            ).result(timeout=5.0)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5.0)
+
+    # -- async internals (link loop only) ----------------------------------
+
+    async def _asubmit(self, tuples: list) -> list:
+        bo = self._backoff_proto or Backoff(base=0.02, cap=0.5, jitter=0.5)
+        busy = 0
+        while True:
+            st = await self._ensure_attached()
+            self._seq += 1
+            seq = self._seq
+            fut = self._loop.create_future()
+            self._pending[seq] = fut
+            try:
+                await st.send(wire.encode_request(seq, tuples))
+                resp = await asyncio.wait_for(fut, self.timeout_s)
+            except (RpcError, ConnectionError, OSError,
+                    asyncio.TimeoutError,
+                    asyncio.IncompleteReadError) as e:
+                # drop OUR future before detaching: _detach fails every
+                # remaining pending future, and failing this one (whose
+                # error is about to be raised here) would leave an
+                # unretrieved exception for the loop to log
+                self._pending.pop(seq, None)
+                self._detach()
+                raise SidecarUnavailable(
+                    f"sidecar {self.host}:{self.port}: {e}"
+                ) from e
+            finally:
+                self._pending.pop(seq, None)
+            hdr, verdicts = resp
+            status = hdr.get("status")
+            if status == "BUSY":
+                busy += 1
+                self._busy_ctr.add(1, tenant=self.tenant)
+                if busy > self.busy_retries:
+                    raise SidecarUnavailable(
+                        f"sidecar still BUSY after {busy} attempts — "
+                        "tenant queue saturated"
+                    )
+                await asyncio.sleep(bo.next())
+                continue
+            if status is not None:  # typed ERROR: dispatch failed
+                raise SidecarUnavailable(
+                    f"sidecar dispatch error: {hdr.get('error', status)}"
+                )
+            if len(verdicts) != len(tuples):
+                # the sidecar is a remote trust boundary: a short (or
+                # long) verdict vector must trigger the local
+                # re-verify, not index past the end in validate_finish
+                raise SidecarUnavailable(
+                    f"sidecar answered {len(verdicts)} verdicts for a "
+                    f"{len(tuples)}-signature batch"
+                )
+            return verdicts
+
+    async def _ensure_attached(self):
+        if self._conn_lock is None:
+            self._conn_lock = asyncio.Lock()
+        async with self._conn_lock:
+            if self._stream is not None:
+                return self._stream
+            cli = RpcClient(self.host, self.port, ssl_ctx=self.ssl_ctx)
+            try:
+                await asyncio.wait_for(cli.connect(), CONNECT_TIMEOUT_S)
+                st = await cli.open_stream("validate")
+                await st.send(json.dumps(
+                    {"tenant": self.tenant, "weight": self.weight}
+                ).encode())
+                welcome = json.loads(await asyncio.wait_for(
+                    st.__anext__(), CONNECT_TIMEOUT_S
+                ))
+            except (RpcError, ConnectionError, OSError,
+                    asyncio.TimeoutError, StopAsyncIteration,
+                    asyncio.IncompleteReadError, ValueError) as e:
+                await self._close_client(cli)
+                raise SidecarUnavailable(
+                    f"sidecar {self.host}:{self.port} unreachable: {e}"
+                ) from e
+            if not welcome.get("ok"):
+                await self._close_client(cli)
+                raise SidecarUnavailable(f"sidecar refused hello: {welcome}")
+            self._client, self._stream = cli, st
+            # strong ref; detached (and awaited) on connection loss
+            self._reader_task = asyncio.ensure_future(self._reader(st))
+            self._reattach_ctr.add(1, tenant=self.tenant)
+            _log.info("tenant %s attached to sidecar %s:%d",
+                      self.tenant, self.host, self.port)
+            return st
+
+    async def _reader(self, st) -> None:
+        try:
+            async for payload in st:
+                hdr, verdicts = wire.decode_response(payload)
+                fut = self._pending.pop(int(hdr.get("seq", -1)), None)
+                if fut is not None and not fut.done():
+                    fut.set_result((hdr, verdicts))
+        except (RpcError, ConnectionError, OSError,
+                asyncio.IncompleteReadError) as e:
+            _log.debug("sidecar reader for %s ended: %s", self.tenant, e)
+        finally:
+            if self._stream is st:
+                self._detach()
+
+    def _detach(self) -> None:
+        """Drop the dead connection and fail everything in flight —
+        callers fall back locally and the NEXT submit reconnects."""
+        cli, self._client = self._client, None
+        self._stream = None
+        task, self._reader_task = self._reader_task, None
+        if task is not None and not task.done():
+            task.cancel()
+        pending, self._pending = dict(self._pending), {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(
+                    SidecarUnavailable("sidecar connection lost")
+                )
+        if cli is not None:
+            t = asyncio.ensure_future(self._close_client(cli))
+            t.add_done_callback(lambda _t: None)  # close is best-effort
+
+    @staticmethod
+    async def _close_client(cli) -> None:
+        try:
+            await cli.close()
+        except (OSError, RuntimeError):
+            pass  # transport already gone
+
+    async def _aclose(self) -> None:
+        self._detach()

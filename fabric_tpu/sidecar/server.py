@@ -1,0 +1,337 @@
+"""The validation sidecar service: one device fabric, many peers.
+
+PAPER.md's north-star deployment shape — the TPU commit path behind a
+pluggable-validation boundary, "a new BCCSP-style provider shipping
+signature batches over gRPC" — realized over the repo's framed-RPC
+transport (``comm.rpc``, the gRPC analog, mTLS included).  Before
+this module every ``PeerChannel`` owned its own validator device
+lane, so N channels × M peers meant N×M lanes contending for one
+chip; the sidecar inverts that: ONE process owns the mesh-resolved
+device machinery and serves ``validate`` bidi-streams to any number
+of peer processes.
+
+Flow per connection:
+
+* the client's first frame registers a **tenant** (channel id +
+  weight); the server answers a welcome frame;
+* every subsequent frame is one block's signature batch
+  (``sidecar/wire.py``), admitted to the tenant's BOUNDED queue in
+  the weighted-deficit-round-robin scheduler
+  (``sidecar/scheduler.py``) — a full queue answers a typed BUSY
+  frame, never a dropped request or an unbounded buffer;
+* a single dispatcher task drains cross-tenant batches of up to
+  ``coalesce`` requests and launches them as ONE padded device
+  dispatch through ``ops.p256.verify_launch_many`` — the first time
+  the coalescing path merges genuinely concurrent traffic — then
+  streams each batch's verdict vector back on its tenant's stream.
+
+A dispatch failure answers each affected request with a typed ERROR
+frame (the peer re-verifies those blocks locally and latches its
+degrade machinery); it never tears the stream down.  ``verify_fn``
+is injectable so crypto-free tests and toy fabrics reuse the whole
+service unchanged.
+
+Observability: ``sidecar_queue_depth{tenant}`` /
+``sidecar_tenant_share{tenant}`` gauges (scheduler),
+``sidecar_request_seconds{tenant,stage}`` histograms (queue_wait /
+dispatch / total), ``sidecar_requests_total{tenant,status}``, tracer
+span trees per request (queue_wait + dispatch children, served at
+``/trace`` when the sidecar process runs an operations server), and
+``health_check`` for ``/healthz``.
+
+Chaos hooks: ``sidecar.request`` fires at admission,
+``sidecar.dispatch`` inside the coalesced device dispatch, and every
+frame send passes ``rpc.frame`` (comm.rpc) — a seeded FaultPlan can
+cut, delay or fail the link end to end.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from fabric_tpu import faults as _faults
+from fabric_tpu.comm.rpc import RpcServer
+from fabric_tpu.sidecar import wire
+from fabric_tpu.sidecar.scheduler import Request, WeightedScheduler
+
+_log = logging.getLogger("fabric_tpu.sidecar")
+
+#: suggested client backoff base when BUSY (advisory; the client's
+#: utils.backoff.Backoff owns the actual cadence)
+BUSY_RETRY_MS = 20.0
+
+
+class SidecarServer:
+    """See module docstring.
+
+    ``verify_fn(itemsets) -> list[list[bool]]`` runs on the device
+    executor thread; the default routes through the mesh-resolved
+    ``ops.p256`` production dispatch (``mesh_devices`` /
+    ``verify_chunk`` / ``recode_device`` mean exactly what they mean
+    on ``BlockValidator``)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 mesh_devices: int = 0, verify_chunk: int = 0,
+                 recode_device: bool = False, queue_blocks: int = 8,
+                 coalesce: int = 4, quantum: int | None = None,
+                 ssl_ctx=None, verify_fn=None, registry=None,
+                 tracer=None):
+        self.host, self.port = host, port
+        self.mesh_devices = int(mesh_devices)
+        self.verify_chunk = int(verify_chunk)
+        self.recode_device = bool(recode_device)
+        self.coalesce = max(1, int(coalesce))
+        self.mesh = None
+        self._verify_fn = verify_fn
+        self._rpc = RpcServer(host, port, ssl_ctx=ssl_ctx)
+        kw = {} if quantum is None else {"quantum": int(quantum)}
+        self.scheduler = WeightedScheduler(
+            queue_limit=queue_blocks, registry=registry, **kw
+        )
+        if registry is None:
+            from fabric_tpu.ops_metrics import global_registry
+
+            registry = global_registry()
+        self._req_hist = registry.histogram(
+            "sidecar_request_seconds",
+            "per-request sidecar time (s) by tenant and stage",
+        )
+        self._req_ctr = registry.counter(
+            "sidecar_requests_total",
+            "sidecar validate requests by tenant and outcome",
+        )
+        self._tenants_gauge = registry.gauge(
+            "sidecar_tenants", "tenant connections currently attached"
+        )
+        if tracer is None:
+            from fabric_tpu.observe import global_tracer
+
+            tracer = global_tracer()
+        self.tracer = tracer
+        # ONE device lane: the chip serializes dispatches anyway, and a
+        # single executor thread keeps verify_launch_many calls ordered
+        self._device = ThreadPoolExecutor(
+            1, thread_name_prefix="fabtpu-sidecar-dev"
+        )
+        self._work = asyncio.Event()
+        self._dispatcher: asyncio.Task | None = None
+        self._conns = 0
+        self._req_counter = 0  # tracer "block" numbers for requests
+        self._stopped = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> "SidecarServer":
+        if self.mesh_devices and self._verify_fn is None:
+            from fabric_tpu.parallel.mesh import resolve_mesh
+
+            self.mesh = resolve_mesh(self.mesh_devices)
+        self._rpc.register("validate", self._on_validate)
+        await self._rpc.start()
+        self.port = self._rpc.port
+        self._stopped = False
+        # strong ref + cancelled on stop (FT008 discipline)
+        self._dispatcher = asyncio.ensure_future(self._dispatch_loop())
+        _log.info("validation sidecar serving on %s:%d (coalesce=%d, "
+                  "queue_blocks=%d)", self.host, self.port,
+                  self.coalesce, self.scheduler.queue_limit)
+        return self
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._dispatcher is not None:
+            self._dispatcher.cancel()
+            await asyncio.gather(self._dispatcher, return_exceptions=True)
+            self._dispatcher = None
+        await self._rpc.stop()
+        self._device.shutdown(wait=False)
+
+    def health_check(self):
+        """/healthz checker: None while serving, a reason otherwise.
+        ANY tenant pinned at its queue bound is reported — that tenant
+        is riding BUSY→CPU-fallback right now, and one idle neighbor
+        must not mask a wedged fabric."""
+        if self._stopped or self._rpc._server is None:
+            return "sidecar rpc server down"
+        limit = self.scheduler.queue_limit
+        pinned = [
+            name for name, s in self.scheduler.stats().items()
+            if s["depth"] >= limit
+        ]
+        if pinned:
+            return (
+                f"tenant queue(s) full ({', '.join(pinned)}) — device "
+                "fabric saturated or wedged; affected tenants are "
+                "being pushed back (BUSY)"
+            )
+        return None
+
+    # -- the validate stream ----------------------------------------------
+
+    async def _on_validate(self, stream) -> None:
+        try:
+            hello_raw = await stream.__anext__()
+        except StopAsyncIteration:
+            return  # opened and closed without a hello
+        try:
+            hello = json.loads(hello_raw)
+            tenant = str(hello["tenant"])
+            weight = float(hello.get("weight", 1.0))
+        except (ValueError, KeyError, TypeError) as e:
+            await stream.error(f"bad hello: {e}")
+            return
+        try:
+            self.scheduler.register(tenant, weight)  # raises on w <= 0
+        except ValueError as e:
+            await stream.error(f"bad hello: {e}")
+            return
+        self._conns += 1
+        self._tenants_gauge.set(self._conns)
+        # everything past registration runs under the unregister
+        # finally — a welcome send that dies (client gone, injected
+        # rpc.frame fault) must not leak the tenant ref
+        try:
+            await stream.send(json.dumps(
+                {"ok": True, "tenant": tenant, "coalesce": self.coalesce}
+            ).encode())
+            async for payload in stream:
+                if _faults.plan() is not None:
+                    await _faults.afire("sidecar.request", tenant=tenant)
+                try:
+                    hdr, items = wire.decode_request(payload)
+                except (ValueError, KeyError) as e:
+                    await stream.error(f"bad request: {e}")
+                    return
+                seq = int(hdr["seq"])
+                root = self.tracer.begin_block(
+                    self._next_req_id(), channel=f"sidecar:{tenant}",
+                    seq=seq,
+                )
+                req = Request(tenant=tenant, seq=seq, items=items,
+                              stream=stream, root=root,
+                              t_enqueue=time.perf_counter())
+                if not self.scheduler.submit(req):
+                    self._req_ctr.add(1, tenant=tenant, status="busy")
+                    self.tracer.set_attrs(root, busy=True)
+                    self.tracer.finish_block(root)
+                    await stream.send(wire.encode_busy(seq, BUSY_RETRY_MS))
+                    continue
+                self._work.set()
+        finally:
+            self._conns -= 1
+            self._tenants_gauge.set(self._conns)
+            orphans = self.scheduler.unregister(tenant)
+            for req in orphans:
+                # their reply stream is gone; account them so a storm
+                # of disappearing tenants is visible
+                self._req_ctr.add(1, tenant=req.tenant, status="dropped")
+                self.tracer.finish_block(req.root)
+
+    def _next_req_id(self) -> int:
+        self._req_counter += 1
+        return self._req_counter
+
+    # -- the dispatcher ----------------------------------------------------
+
+    async def _dispatch_loop(self) -> None:
+        loop = asyncio.get_event_loop()
+        while True:
+            await self._work.wait()
+            self._work.clear()
+            while True:
+                batch = self.scheduler.next_batch(self.coalesce)
+                if not batch:
+                    break
+                t0 = time.perf_counter()
+                try:
+                    verdicts = await loop.run_in_executor(
+                        self._device, self._verify_batch,
+                        [r.items for r in batch],
+                    )
+                    t1 = time.perf_counter()
+                    await self._answer(batch, verdicts, t0, t1)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    # a dispatch failure answers typed errors (clients
+                    # re-verify locally); anything unexpected escaping
+                    # the ANSWER path must not kill this task either —
+                    # a dead dispatcher would silently halt every
+                    # tenant until process restart
+                    _log.warning(
+                        "sidecar dispatch of %d batch(es) failed: %s — "
+                        "answering typed errors (clients re-verify "
+                        "locally)", len(batch), e,
+                    )
+                    try:
+                        await self._answer_error(batch, e)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e2:
+                        _log.warning(
+                            "sidecar error-answer path failed too (%s) "
+                            "— dropping %d response(s); affected "
+                            "clients time out and fall back locally",
+                            e2, len(batch),
+                        )
+                        for req in batch:
+                            self._req_ctr.add(1, tenant=req.tenant,
+                                              status="dropped")
+                            self.tracer.finish_block(req.root)
+
+    def _verify_batch(self, itemsets: list) -> list:
+        _faults.fire("sidecar.dispatch", n=len(itemsets))
+        if self._verify_fn is not None:
+            return self._verify_fn(itemsets)
+        return self._device_verify(itemsets)
+
+    def _device_verify(self, itemsets: list) -> list:
+        """The production path: ONE coalesced padded dispatch over the
+        mesh for the whole cross-tenant group, then per-batch fetches."""
+        from fabric_tpu.ops import p256
+
+        handles = p256.verify_launch_many(
+            itemsets, chunk=self.verify_chunk or None, mesh=self.mesh,
+            recode_device=self.recode_device,
+        )
+        return [[bool(v) for v in h()] for h in handles]
+
+    async def _answer(self, batch: list, verdicts: list,
+                      t0: float, t1: float) -> None:
+        for req, ok in zip(batch, verdicts):
+            self._req_hist.observe(t0 - req.t_enqueue, tenant=req.tenant,
+                                  stage="queue_wait")
+            self._req_hist.observe(t1 - t0, tenant=req.tenant,
+                                  stage="dispatch")
+            self._req_hist.observe(t1 - req.t_enqueue, tenant=req.tenant,
+                                  stage="total")
+            self.tracer.add("queue_wait", req.t_enqueue, t0,
+                            parent=req.root)
+            self.tracer.add("dispatch", t0, t1, parent=req.root,
+                            coalesced=len(batch), n_sigs=req.cost)
+            sent = await self._send(req, wire.encode_response(req.seq, ok))
+            self._req_ctr.add(1, tenant=req.tenant,
+                              status="ok" if sent else "dropped")
+            self.tracer.finish_block(req.root)
+
+    async def _answer_error(self, batch: list, err: Exception) -> None:
+        msg = f"{type(err).__name__}: {err}"
+        for req in batch:
+            await self._send(req, wire.encode_error(req.seq, msg))
+            self._req_ctr.add(1, tenant=req.tenant, status="error")
+            self.tracer.set_attrs(req.root, error=msg[:120])
+            self.tracer.finish_block(req.root)
+
+    @staticmethod
+    async def _send(req: Request, payload: bytes) -> bool:
+        try:
+            await req.stream.send(payload)
+            return True
+        except (ConnectionError, OSError, RuntimeError, EOFError) as e:
+            _log.debug("tenant %s went away before its response (%s)",
+                       req.tenant, e)
+            return False

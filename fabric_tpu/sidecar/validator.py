@@ -1,0 +1,124 @@
+"""``SidecarValidator``: the ``BlockValidator`` whose device lane
+lives in a remote validation sidecar.
+
+Drop-in for ``BlockValidator`` on the verify surface —
+``preprocess`` / ``preprocess_many`` / ``validate_launch`` /
+``validate_finish`` are inherited untouched, so ``PeerChannel`` and
+``CommitPipeline`` need NO pipeline changes.  Only the two dispatch
+hooks are overridden: instead of launching the local device kernel,
+the block's signature batch ships over the tenant's
+:class:`~fabric_tpu.sidecar.client.SidecarLink` and ``preprocess``
+returns a handle whose verdicts arrive over the stream.  The handle
+exposes no ``device_out``, so sidecar-validated blocks take the host
+MVCC path — verdict-identical to the fused stage-2
+(the ``_HostVerifyHandle`` equivalence tests/test_faults.py pins).
+
+Failure semantics reuse ``peer/degrade.py`` wholesale: the sidecar
+lane runs under a :class:`DeviceLaneGuard` (aliased onto
+``self.device_guard`` so ``/healthz`` and ``validator_degraded``
+surface it), so sidecar loss latches the CPU/local fallback after
+``sidecar_fail_threshold`` consecutive failures and the periodic
+recovery probe re-attaches the stream when the sidecar returns — a
+sidecar restart degrades latency, never liveness.
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.peer.degrade import DeviceLaneGuard
+from fabric_tpu.peer.validator import (
+    BlockValidator,
+    _GuardedHandle,
+    _HostVerifyHandle,
+)
+from fabric_tpu.sidecar.client import (  # noqa: F401  (re-export)
+    SidecarLink,
+    parse_endpoint,
+)
+
+
+class SidecarValidator(BlockValidator):
+    """See module docstring.  Extra knobs over ``BlockValidator``:
+
+    * ``sidecar_endpoint`` — 'host:port' of the validation sidecar;
+    * ``sidecar_weight`` — this tenant's fair-share weight;
+    * ``sidecar_fail_threshold`` / ``sidecar_retries`` /
+      ``sidecar_recovery_s`` — the degrade latch (same semantics as
+      the ``device_*`` knobs, applied to the remote lane; threshold
+      is forced ≥ 1 because a sidecar client without a fallback latch
+      would turn every sidecar restart into a dead channel);
+    * ``sidecar_timeout_s`` — per-batch response deadline;
+    * ``sidecar_ssl`` — client TLS context (mTLS when the peer has
+      node TLS material);
+    * ``link`` — an injected :class:`SidecarLink` (tests).
+
+    ``mesh_devices`` is forced to 0 (the SERVER owns the device fabric
+    and its sharding knobs — a tenant must not grab the accelerator a
+    co-located sidecar serves from); the host-staging knobs keep their
+    meaning, since parse/policy staging stays on the peer."""
+
+    def __init__(self, msp_manager, policy_provider, state_db,
+                 sidecar_endpoint: str = "", sidecar_weight: float = 1.0,
+                 sidecar_fail_threshold: int = 2, sidecar_retries: int = 0,
+                 sidecar_recovery_s: float = 5.0,
+                 sidecar_timeout_s: float = 30.0, sidecar_ssl=None,
+                 link: SidecarLink | None = None, **kw):
+        # the LOCAL device guard stays off: the sidecar guard below is
+        # the one latch, and double-wrapping would double-count
+        kw["device_fail_threshold"] = 0
+        # never resolve a local device mesh: both dispatch hooks are
+        # overridden, so a tenant peer grabbing the accelerator its
+        # co-located sidecar owns would be pure contention
+        kw["mesh_devices"] = 0
+        super().__init__(msp_manager, policy_provider, state_db, **kw)
+        if link is None:
+            host, port = parse_endpoint(sidecar_endpoint)
+            link = SidecarLink(
+                host, port, tenant=self.channel or "chan",
+                weight=sidecar_weight, ssl_ctx=sidecar_ssl,
+                timeout_s=sidecar_timeout_s,
+            )
+        self.link = link
+        self.sidecar_guard = DeviceLaneGuard(
+            retries=sidecar_retries,
+            fail_threshold=max(1, int(sidecar_fail_threshold)),
+            recovery_s=sidecar_recovery_s,
+            # verify_deadline_ms keeps its meaning on the remote lane:
+            # a sidecar that answers successfully but consistently
+            # slower than the deadline counts toward the latch
+            deadline_ms=float(kw.get("verify_deadline_ms", 0.0)),
+            channel=self.channel,
+        )
+        # /healthz's device_verify_lane check and the bench's degraded
+        # accounting read this attribute
+        self.device_guard = self.sidecar_guard
+
+    @staticmethod
+    def _tuples(items) -> list:
+        return items.tuples() if hasattr(items, "tuples") else list(items)
+
+    def _verify_launch_guarded(self, items):
+        tuples = self._tuples(items)
+        out = self.sidecar_guard.run_launch(
+            lambda: self.link.submit(tuples),
+            lambda: self._host_verify_handle(items),
+        )
+        if isinstance(out, _HostVerifyHandle):
+            return out
+        return _GuardedHandle(out, self.sidecar_guard, self, items)
+
+    def _verify_launch_many_guarded(self, itemsets, pool=None):
+        tuple_sets = [self._tuples(it) for it in itemsets]
+        out = self.sidecar_guard.run_launch(
+            lambda: self.link.submit_many(tuple_sets),
+            lambda: [self._host_verify_handle(it) for it in itemsets],
+            fallback_count=len(itemsets),
+        )
+        return [
+            h if isinstance(h, _HostVerifyHandle)
+            else _GuardedHandle(h, self.sidecar_guard, self, it)
+            for h, it in zip(out, itemsets)
+        ]
+
+    def close(self) -> None:
+        super().close()
+        self.link.close()

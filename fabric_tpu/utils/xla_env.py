@@ -52,3 +52,35 @@ def ensure_host_device_count(n: int) -> None:
         os.environ["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={n}"
         ).strip()
+
+
+def enable_compile_cache(root: str | None = None) -> bool:
+    """Point jax's persistent compile cache at the repo's shared
+    ``.jax_cache`` so every process that validates (bench rounds, the
+    sidecar server, CLI daemons) reuses one set of compiled verify
+    graphs — a sidecar restart must re-attach in seconds, not
+    re-compile for minutes while every tenant rides its CPU fallback.
+    Returns False (after logging) when jax is absent or the config
+    knobs are unavailable; the cache is an optimization, serving works
+    without it."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(root, ".jax_cache")
+        )
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 2.0
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return True
+    except Exception as e:
+        import logging
+
+        logging.getLogger("fabric_tpu.xla_env").warning(
+            "persistent compile cache unavailable (%s)", e
+        )
+        return False
