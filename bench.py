@@ -999,7 +999,8 @@ def _bench_block_commit_sidecar(n_tx: int = 200, n_blocks: int = 12):
 
         try:
             t0 = time.perf_counter()
-            with CommitPipeline(v, commit_fn, depth=2) as pipe:
+            with CommitPipeline(v, commit_fn, depth=2,
+                                channel=name) as pipe:
                 for b in stream:
                     submit_t[b.header.number] = time.perf_counter()
                     pipe.submit(b)
@@ -1020,6 +1021,23 @@ def _bench_block_commit_sidecar(n_tx: int = 200, n_blocks: int = 12):
             lg.close()
             shutil.rmtree(tmp, ignore_errors=True)
 
+    # end-of-run SLO burn snapshot (ISSUE 9): a local engine rides the
+    # global tracer's finished-block stream for the run's duration —
+    # per-tenant block-commit latency burn + sidecar BUSY burn become
+    # tracked numbers, so a fairness regression that starves one
+    # tenant shows up as that tenant's burn rate, not just a Jain dip
+    from fabric_tpu import observe as _observe
+    from fabric_tpu.observe import slo as _slo
+    from fabric_tpu.ops_metrics import Registry as _Registry
+
+    slo_engine = _slo.SloEngine(
+        _slo.parse_slos(
+            "block_commit:latency:ms=2000:target=0.95:windows=1200;"
+            "sidecar_busy:busy:pct=20:windows=1200"
+        ),
+        registry=_Registry(),
+    )
+    _observe.global_tracer().add_listener(slo_engine.on_block)
     # cold compiles land on the first dispatches; like the sustained
     # bench, the first 2 blocks are excluded from the percentiles and
     # the persistent .jax_cache covers repeat rounds
@@ -1038,6 +1056,7 @@ def _bench_block_commit_sidecar(n_tx: int = 200, n_blocks: int = 12):
         sched_stats = host.server.scheduler.stats()
         host.stop_server()
     finally:
+        _observe.global_tracer().remove_listener(slo_engine.on_block)
         host.close()
     assert not hung, f"tenant drive thread(s) timed out: {hung}"
     assert not errors, errors
@@ -1081,11 +1100,20 @@ def _bench_block_commit_sidecar(n_tx: int = 200, n_blocks: int = 12):
                     "tx_per_sec": round(
                         n_tx * n_blocks / results[name]["dt"], 1
                     ),
+                    # per-tenant fairness signals off the scheduler:
+                    # time-in-queue percentiles + BUSY pushback rate
+                    "queue_age_ms": sched_stats.get(name, {}).get(
+                        "queue_age_ms"
+                    ),
+                    "busy_rate": sched_stats.get(name, {}).get(
+                        "busy_rate"
+                    ),
                 }
                 for name, w in tenants
             },
             "fairness_jain_weighted": jain,
             "scheduler": sched_stats,
+            "slo": slo_engine.report(),
             "coalesce": 4,
             "queue_blocks": 8,
             "knobs": knobs,

@@ -12,6 +12,7 @@ them); slugs are the human-facing names:
     FT007 kernel-dtype-mismatch  int64 host arrays into int32 kernel lanes
     FT008 asyncio-task-leak      dropped ensure_future/create_task results
     FT009 unbounded-blocking-wait  no-timeout Future/Queue/Event/Thread waits
+    FT010 unfinished-span        begin_block roots with no reachable finish
 """
 
 from fabric_tpu.analysis.rules import (  # noqa: F401
@@ -23,5 +24,6 @@ from fabric_tpu.analysis.rules import (  # noqa: F401
     lock_discipline,
     retrace_hazard,
     swallowed_exception,
+    unfinished_span,
     union_env,
 )

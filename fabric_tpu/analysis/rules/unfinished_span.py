@@ -1,0 +1,193 @@
+"""FT010 unfinished-span: tracer block roots that never finalize.
+
+``Tracer.begin_block`` returns a root span that only reaches the
+flight recorder (and the watchdog, the /trace endpoint, the SLO
+engine's finished-block stream) when ``finish_block`` runs on it —
+and the tracer is deliberately fire-and-forget, so a dropped root
+fails SILENTLY: the block commits fine, its trace just never exists.
+The PR-7 sidecar server needed three separate ``finish_block`` call
+sites (answer, error-answer, orphan teardown) to get this right; this
+rule catches the shape where none is reachable at all.
+
+Mechanics (strictly under-approximating, per the FT003..FT009
+contract — a finding is always real):
+
+1. **Creation sites** — calls whose attribute is ``begin_block``
+   (``tracer.begin_block(...)``, ``self.tracer.begin_block(...)``,
+   chained receivers included).  The name is unique to the tracer in
+   this tree; a bare local ``def begin_block`` never produces an
+   attribute call, so the FT003 same-name hazard does not arise.
+2. **Leak test** — a creation site leaks when its root is
+
+   * discarded outright (an expression statement — the tree can never
+     finalize), or
+   * bound to a plain local name whose every later Load is NEUTRAL:
+     an argument to another span-family tracer call (``span``,
+     ``add``, ``event``, ``set_attrs``, ``start``, ``end``,
+     ``attach``, ``detach``) or a bare truth-test (``if root:``,
+     ``root is None``).  Using a root only as a *parent* for child
+     spans is exactly the silent-leak shape — children are recorded
+     into a tree nothing will ever surface.
+
+   Everything else is clean by under-approximation: a Load inside a
+   ``finish_block(...)`` call finishes it; a Load in ANY other
+   position — passed to a non-tracer call (``Request(root=root)``,
+   ``executor.submit(fn, root)``, ``roots.append(root)``), returned,
+   yielded, stored on an attribute/container, aliased — escapes,
+   and the finish is assumed to happen wherever it went.
+3. **Test code is exempt** (``tests/``, ``test_*.py``,
+   ``conftest.py``) — fixtures construct half-open spans on purpose.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import (
+    Finding,
+    ModuleCtx,
+    Rule,
+    call_name,
+    register,
+    walk_functions,
+)
+
+_BEGIN = "begin_block"
+_FINISH = {"finish_block"}
+#: tracer calls a root may feed WITHOUT counting as finished or
+#: escaped — parenting children, annotating, thread adoption
+_NEUTRAL = {"span", "add", "event", "set_attrs", "start", "end",
+            "attach", "detach", _BEGIN}
+
+
+def _is_begin_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == _BEGIN)
+
+
+def _walk_own(scope: ast.AST):
+    """A scope's OWN statements (nested defs are their own scopes via
+    walk_functions — descending would double-count)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _call_attr(call: ast.Call) -> str:
+    """The last attribute/name segment of a call's func ('' if
+    unresolvable)."""
+    name = call_name(call)
+    if name is not None:
+        return name.rsplit(".", 1)[-1]
+    f = call.func
+    return f.attr if isinstance(f, ast.Attribute) else ""
+
+
+def _classify_loads(scope: ast.AST, name: str) -> tuple[bool, bool]:
+    """(finished, escaped) over every Load of ``name`` in the scope's
+    subtree (nested closures included — a closure that finishes the
+    span counts, same as FT008's use test)."""
+    parents: dict[int, ast.AST] = {}
+    for node in ast.walk(scope):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    finished = escaped = False
+    for node in ast.walk(scope):
+        if not (isinstance(node, ast.Name) and node.id == name
+                and isinstance(node.ctx, ast.Load)):
+            continue
+        # walk up to the nearest Call that takes this Load as an
+        # argument (directly or nested inside one of its arguments)
+        cur: ast.AST = node
+        call = None
+        while True:
+            parent = parents.get(id(cur))
+            if parent is None or isinstance(parent, ast.stmt):
+                break
+            if isinstance(parent, ast.Call) and cur is not parent.func:
+                call = parent
+                break
+            if isinstance(parent, ast.keyword):
+                grand = parents.get(id(parent))
+                if isinstance(grand, ast.Call):
+                    call = grand
+                break
+            cur = parent
+        if call is not None:
+            attr = _call_attr(call)
+            if attr in _FINISH:
+                finished = True
+            elif attr not in _NEUTRAL:
+                escaped = True  # handed to non-tracer code
+            continue
+        # not a call argument: bare truth-tests are neutral, anything
+        # else (return/yield/assign/container/attribute store rhs)
+        # escapes — under-approximation keeps false positives at zero
+        parent = parents.get(id(node))
+        if isinstance(parent, (ast.Compare, ast.BoolOp, ast.UnaryOp,
+                               ast.If, ast.While, ast.IfExp,
+                               ast.Assert)):
+            continue
+        escaped = True
+    return finished, escaped
+
+
+@register
+class UnfinishedSpanRule(Rule):
+    id = "FT010"
+    name = "unfinished-span"
+    severity = "error"
+    description = (
+        "flags Tracer.begin_block roots that are discarded or only "
+        "ever used as span parents — without a reachable finish_block "
+        "the tree never hits the flight recorder, the watchdog, or "
+        "the SLO engine's finished-block stream, and the loss is "
+        "silent"
+    )
+
+    def check_module(self, ctx: ModuleCtx) -> list[Finding]:
+        rel = ctx.relpath
+        base = rel.rsplit("/", 1)[-1]
+        if ("tests/" in rel or rel.startswith("tests")
+                or base.startswith("test_") or base == "conftest.py"):
+            return []
+        out: list[Finding] = []
+        scopes = [ctx.tree] + list(walk_functions(ctx.tree))
+        for scope in scopes:
+            for node in _walk_own(scope):
+                if isinstance(node, ast.Expr) and _is_begin_call(
+                        node.value):
+                    out.append(self.finding(
+                        ctx, node.lineno, node.col_offset,
+                        "the root span returned by begin_block is "
+                        "discarded — the block's tree can never be "
+                        "finish_block'd into the flight recorder; "
+                        "bind it and finish it on every path (or pass "
+                        "it to the code that will)",
+                    ))
+                elif (isinstance(node, ast.Assign)
+                      and len(node.targets) == 1
+                      and isinstance(node.targets[0], ast.Name)
+                      and _is_begin_call(node.value)):
+                    tgt = node.targets[0].id
+                    finished, escaped = _classify_loads(scope, tgt)
+                    if not finished and not escaped:
+                        out.append(self.finding(
+                            ctx, node.lineno, node.col_offset,
+                            f"the root span bound to '{tgt}' is never "
+                            "passed to finish_block and never escapes "
+                            "this function — child spans recorded "
+                            "under it land in a tree that will never "
+                            "reach the flight recorder, the slow-block "
+                            "watchdog, or the SLO stream; call "
+                            "finish_block on every path (the sidecar "
+                            "server needs it on the answer, "
+                            "error-answer, AND orphan paths)",
+                        ))
+        return out

@@ -150,6 +150,7 @@ async def _run_peer(cfg):
         host_stage_mode=cfg.host_stage_mode,
         trace_ring_blocks=cfg.trace_ring_blocks,
         trace_slow_factor=cfg.trace_slow_factor,
+        slos=cfg.slos,
         device_fail_threshold=cfg.device_fail_threshold,
         device_retries=cfg.device_retries,
         device_recovery_s=cfg.device_recovery_s,
@@ -222,6 +223,10 @@ async def _run_sidecar(args):
 
     enable_compile_cache()
 
+    if args.slos:
+        from fabric_tpu.observe import slo as slo_mod
+
+        slo_mod.configure(args.slos)
     ssl_ctx = None
     if args.tls_cert and args.tls_key:
         from fabric_tpu.comm.rpc import make_server_tls
@@ -475,6 +480,10 @@ def main(argv=None):
     c.add_argument("--coalesce", type=int, default=4,
                    help="max cross-tenant batches per device dispatch")
     c.add_argument("--operations-port", type=int, default=None)
+    c.add_argument("--slos", default="",
+                   help="SLO spec string (observe/slo.py), e.g. "
+                        "'req:latency:ms=50;busy:busy:pct=5' — served "
+                        "at /slo on the operations port")
 
     c = sub.add_parser("chaincode", help="run a sample ccaas chaincode server")
     c.add_argument("--name", required=True)

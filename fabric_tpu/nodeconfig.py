@@ -151,6 +151,15 @@ class PeerConfig:
     # median (armed after 8 committed blocks); 0 disables the watchdog
     # while keeping the flight recorder.
     trace_slow_factor: float = 5.0
+    # declarative latency/error SLOs (fabric_tpu/observe/slo.py):
+    # faults-style spec string, e.g.
+    # 'commit:latency:ms=250;busy:busy:pct=5' — per-channel rolling
+    # burn rates over the tracer's finished-block stream, served at
+    # /slo on the operations server with slo_burn_rate{slo,window,
+    # channel} gauges and a fast-burn WARN.  Empty = no objectives.
+    # The engine rides the tracer, so trace_ring_blocks=0 silences
+    # SLOs too.  FABTPU_SLOS overrides like any scalar.
+    slos: str = ""
     # device-lane degradation (peer/degrade.py DeviceLaneGuard): after
     # device_fail_threshold CONSECUTIVE device-verify failures the
     # validator latches a degraded CPU mode (ops/p256.verify_host +
@@ -435,6 +444,15 @@ def _load(cls, source, environ=None):
             f"key 'host_stage_mode': must be 'thread' or 'process', "
             f"got {cfg.host_stage_mode!r}"
         )
+    if isinstance(cfg, PeerConfig) and cfg.slos:
+        # validate the SLO spec HERE so a typo surfaces as an
+        # operator-grade config error, not an exception mid-start
+        from fabric_tpu.observe.slo import SloError, parse_slos
+
+        try:
+            parse_slos(cfg.slos)
+        except SloError as e:
+            raise ConfigError(f"key 'slos': {e}") from None
     if isinstance(cfg, OrdererConfig) and cfg.consensus not in (
             "raft", "bft"):
         raise ConfigError(

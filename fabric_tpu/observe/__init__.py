@@ -1,4 +1,5 @@
-"""fabric_tpu.observe — block-commit span tracing (see tracer.py)."""
+"""fabric_tpu.observe — block-commit span tracing (tracer.py) and the
+latency/error SLO burn-rate engine (slo.py)."""
 
 from fabric_tpu.observe.tracer import (  # noqa: F401
     DEFAULT_RING_BLOCKS,
@@ -9,4 +10,5 @@ from fabric_tpu.observe.tracer import (  # noqa: F401
     device_annotation,
     format_block,
     global_tracer,
+    span_from_dict,
 )

@@ -65,10 +65,15 @@ class Span:
     ``thread`` is the name of the thread that STARTED the span (the
     Chrome row it renders on).  ``children`` appends are GIL-atomic, so
     concurrent pool workers may add children to a shared parent without
-    a lock."""
+    a lock.  ``root`` points at the block root the span hangs under
+    (set by the tracer at creation — how a leaf instrumentation site,
+    e.g. the sidecar client, finds the block it is part of without a
+    parent chain), and ``proc`` names the PROCESS a stitched remote
+    span ran in (None = this process; the Chrome export renders one
+    pid row per proc)."""
 
     __slots__ = ("name", "t0", "t1", "thread", "attrs", "children",
-                 "events")
+                 "events", "root", "proc")
 
     def __init__(self, name: str, t0: float, thread: str, attrs: dict):
         self.name = name
@@ -78,6 +83,8 @@ class Span:
         self.attrs = attrs
         self.children: list[Span] = []
         self.events: list[tuple] = []  # (name, t, attrs)
+        self.root: Span | None = None
+        self.proc: str | None = None
 
     @property
     def dur(self) -> float:
@@ -91,6 +98,8 @@ class Span:
             "dur_ms": round(self.dur * 1000.0, 3),
             "thread": self.thread,
         }
+        if self.proc:
+            d["proc"] = self.proc
         if self.attrs:
             d["attrs"] = self.attrs
         if self.events:
@@ -144,6 +153,7 @@ class Tracer:
         self.clock = clock
         self._local = threading.local()
         self._lock = threading.Lock()
+        self._listeners: list = []
         self.configure(ring_blocks=ring_blocks, slow_factor=slow_factor)
 
     def configure(self, ring_blocks: int | None = None,
@@ -153,17 +163,49 @@ class Tracer:
         with self._lock:
             if ring_blocks is not None:
                 self.ring_blocks = int(ring_blocks)
-                old = list(getattr(self, "_ring", ()))
                 cap = max(1, self.ring_blocks)
-                self._ring: deque = deque(old[-cap:], maxlen=cap)
+                # one ring PER NAMESPACE: peer block trees live in the
+                # default "" ring, a colocated sidecar's request trees
+                # in "sidecar" — a request storm can no longer evict
+                # real block trees, and /trace?block=N cannot collide
+                old = getattr(self, "_rings", None) or {"": deque()}
+                self._rings: dict[str, deque] = {
+                    ns: deque(list(ring)[-cap:], maxlen=cap)
+                    for ns, ring in old.items()
+                }
+                self._rings.setdefault("", deque(maxlen=cap))
                 self._slow: deque = deque(
                     list(getattr(self, "_slow", ())), maxlen=16
                 )
-                self._durs: deque = deque(
-                    list(getattr(self, "_durs", ())), maxlen=128
-                )
+                # watchdog medians are per-namespace too: sidecar
+                # requests (~ms) and block commits (~100ms) are
+                # different populations, and mixing them would poison
+                # the trailing median both ways
+                if not hasattr(self, "_durs"):
+                    self._durs: dict[str, deque] = {}
             if slow_factor is not None:
                 self.slow_factor = float(slow_factor)
+
+    @property
+    def _ring(self) -> deque:
+        """The default-namespace ring (peer block trees)."""
+        return self._rings[""]
+
+    # -- finished-block listeners (the SLO engine subscribes) --------------
+
+    def add_listener(self, fn) -> None:
+        """``fn(root_span)`` runs after every :meth:`finish_block`
+        (outside the tracer lock, on the finishing thread).  Exceptions
+        are contained — a broken listener cannot take down the commit
+        path."""
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass  # already removed — detach is idempotent
 
     @property
     def enabled(self) -> bool:
@@ -171,15 +213,22 @@ class Tracer:
 
     # -- recording (hot path: no locks) ------------------------------------
 
-    def begin_block(self, number: int, **attrs):
+    def begin_block(self, number: int, ns: str = "", **attrs):
         """Root span for one block's trip through the commit pipeline
-        (submit → commit complete).  Returns None when disabled — every
-        other method tolerates a None span/parent as a no-op."""
+        (submit → commit complete).  ``ns`` names the flight-recorder
+        ring the tree finalizes into ("" = peer blocks; the sidecar
+        server uses "sidecar" so request trees never evict or collide
+        with block trees).  Returns None when disabled — every other
+        method tolerates a None span/parent as a no-op."""
         if not self.enabled:
             return None
         attrs["block"] = int(number)
-        return Span("block", self.clock(),
-                    threading.current_thread().name, attrs)
+        if ns:
+            attrs["ns"] = str(ns)
+        sp = Span("block", self.clock(),
+                  threading.current_thread().name, attrs)
+        sp.root = sp
+        return sp
 
     def start(self, name: str, parent, **attrs):
         """Explicit span start under ``parent`` (a handle passed across
@@ -188,6 +237,7 @@ class Tracer:
             return None
         sp = Span(name, self.clock(), threading.current_thread().name,
                   attrs)
+        sp.root = parent.root if parent.root is not None else parent
         parent.children.append(sp)
         return sp
 
@@ -214,6 +264,7 @@ class Tracer:
             return
         sp = Span(name, t0, threading.current_thread().name, attrs)
         sp.t1 = t1
+        sp.root = parent.root if parent.root is not None else parent
         parent.children.append(sp)
 
     def event(self, name: str, parent=_USE_CURRENT, **attrs) -> None:
@@ -254,10 +305,18 @@ class Tracer:
         if root.t1 is None:
             root.t1 = self.clock()
         dur = root.dur
+        ns = root.attrs.get("ns", "")
         slow = False
         with self._lock:
-            self._ring.append(root)
-            durs = self._durs
+            ring = self._rings.get(ns)
+            if ring is None:
+                ring = self._rings[ns] = deque(
+                    maxlen=max(1, self.ring_blocks)
+                )
+            ring.append(root)
+            durs = self._durs.get(ns)
+            if durs is None:
+                durs = self._durs[ns] = deque(maxlen=128)
             if (len(durs) >= _WATCHDOG_MIN_SAMPLES
                     and self.slow_factor > 0):
                 med = sorted(durs)[len(durs) // 2]
@@ -279,24 +338,34 @@ class Tracer:
                 root.attrs.get("block"), dur * 1000.0, self.slow_factor,
                 med * 1000.0, format_block(root),
             )
+        for fn in list(self._listeners):
+            try:
+                fn(root)
+            except Exception as e:  # a listener must never kill commit
+                _log.debug("tracer listener %r failed: %s", fn, e)
 
     # -- readers (flight recorder) -----------------------------------------
 
-    def blocks(self, n: int | None = None) -> list[dict]:
+    def blocks(self, n: int | None = None, ns: str = "") -> list[dict]:
         """Most recent block trees (oldest first), as JSON-able dicts."""
         with self._lock:
-            roots = list(self._ring)
+            roots = list(self._rings.get(ns, ()))
         if n is not None:
             roots = roots[-n:]
         return [self._root_dict(r) for r in roots]
 
-    def block(self, number: int) -> dict | None:
+    def block(self, number: int, ns: str = "") -> dict | None:
         with self._lock:
-            roots = list(self._ring)
+            roots = list(self._rings.get(ns, ()))
         for r in reversed(roots):
             if r.attrs.get("block") == number:
                 return self._root_dict(r)
         return None
+
+    def namespaces(self) -> dict[str, int]:
+        """{ns: trees currently held} for every non-empty ring."""
+        with self._lock:
+            return {ns: len(r) for ns, r in self._rings.items() if r}
 
     def slow_blocks(self) -> list[dict]:
         with self._lock:
@@ -313,31 +382,51 @@ class Tracer:
 
     def chrome_events(self) -> list[dict]:
         """Flight recorder → Chrome trace-event list ("X" complete
-        events + "i" instants + thread_name metadata), one tid per
-        thread/worker name so Perfetto renders one row each."""
+        events + "i" instants + thread_name/process_name metadata),
+        one tid per thread/worker name so Perfetto renders one row
+        each.  Stitched remote spans (``Span.proc`` set — the sidecar
+        subtree the client merged in) get their own pid, so the
+        cross-process waterfall renders on distinct process rows.
+        Every namespace's ring is exported (peer blocks + sidecar
+        request trees in a colocated process)."""
         with self._lock:
-            roots = list(self._ring)
-        tids: dict[str, int] = {}
+            roots = [r for ring in self._rings.values() for r in ring]
+        roots.sort(key=lambda r: r.t0)
+        pids: dict[str, int] = {"local": 0}
+        tids: dict[tuple, int] = {}
         events: list[dict] = []
 
-        def tid(name: str) -> int:
-            t = tids.get(name)
+        def pid(proc: str) -> int:
+            p = pids.get(proc)
+            if p is None:
+                p = pids[proc] = len(pids)
+            return p
+
+        def tid(p: int, name: str) -> int:
+            t = tids.get((p, name))
             if t is None:
-                t = tids[name] = len(tids) + 1
+                t = tids[(p, name)] = sum(
+                    1 for k in tids if k[0] == p
+                ) + 1
             return t
 
         def walk(sp: Span, block: int) -> None:
+            p = pid(sp.proc or "local")
+            row = tid(p, sp.thread)
+            # the root's block number is the grouping key and always
+            # wins — a stitched remote subtree's own ids must not
+            # shadow it (its request id rides as args["req"])
             events.append({
                 "name": sp.name, "cat": "fabtpu", "ph": "X",
                 "ts": sp.t0 * 1e6,
                 "dur": max(0.0, sp.dur) * 1e6,
-                "pid": 0, "tid": tid(sp.thread),
-                "args": {"block": block, **sp.attrs},
+                "pid": p, "tid": row,
+                "args": {**sp.attrs, "block": block},
             })
             for n, t, a in sp.events:
                 events.append({
                     "name": n, "cat": "fabtpu", "ph": "i", "s": "t",
-                    "ts": t * 1e6, "pid": 0, "tid": tid(sp.thread),
+                    "ts": t * 1e6, "pid": p, "tid": row,
                     "args": {"block": block, **a},
                 })
             for c in sp.children:
@@ -346,9 +435,14 @@ class Tracer:
         for root in roots:
             walk(root, int(root.attrs.get("block", -1)))
         meta = [
-            {"name": "thread_name", "ph": "M", "pid": 0, "tid": t,
+            {"name": "process_name", "ph": "M", "pid": p, "tid": 0,
+             "args": {"name": proc}}
+            for proc, p in pids.items()
+        ]
+        meta += [
+            {"name": "thread_name", "ph": "M", "pid": p, "tid": t,
              "args": {"name": n}}
-            for n, t in tids.items()
+            for (p, n), t in tids.items()
         ]
         return meta + events
 
@@ -365,10 +459,11 @@ def format_block(root) -> str:
     lines: list[str] = []
 
     def walk(sp: Span, depth: int) -> None:
+        row = f"{sp.proc}:{sp.thread}" if sp.proc else sp.thread
         lines.append(
             "%s%-24s %8.2f ms @ %7.2f ms  [%s]" % (
                 "  " * depth, sp.name, sp.dur * 1000.0,
-                (sp.t0 - base) * 1000.0, sp.thread,
+                (sp.t0 - base) * 1000.0, row,
             )
         )
         for n, t, _a in sp.events:
@@ -380,6 +475,36 @@ def format_block(root) -> str:
 
     walk(root, 0)
     return "\n".join(lines)
+
+
+def span_from_dict(d: dict, offset_s: float = 0.0,
+                   proc: str | None = None) -> Span:
+    """Reconstruct a :class:`Span` tree from ``Span.to_dict(0.0)``
+    output — the wire form a sidecar ships its finished request
+    subtree back in.  Times in the dict are absolute ms on the REMOTE
+    process's clock; ``offset_s`` (remote − local, the NTP-style
+    estimate from the request/response timestamp midpoints) is
+    subtracted so the tree lands on the local timeline.  ``proc``
+    labels every reconstructed span's process row."""
+    sp = Span(
+        str(d.get("name", "?")),
+        float(d.get("start_ms", 0.0)) / 1000.0 - offset_s,
+        str(d.get("thread", "?")),
+        dict(d.get("attrs") or {}),
+    )
+    sp.t1 = sp.t0 + max(0.0, float(d.get("dur_ms", 0.0))) / 1000.0
+    sp.proc = proc
+    for ev in d.get("events", ()):
+        sp.events.append((
+            str(ev.get("name", "?")),
+            float(ev.get("at_ms", 0.0)) / 1000.0 - offset_s,
+            dict(ev.get("attrs") or {}),
+        ))
+    for c in d.get("children", ()):
+        child = span_from_dict(c, offset_s, proc)
+        child.root = sp
+        sp.children.append(child)
+    return sp
 
 
 _global = Tracer()

@@ -47,7 +47,7 @@ class OperationsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  registry: Registry | None = None,
                  health: HealthRegistry | None = None,
-                 tracer=None):
+                 tracer=None, slo=None):
         self.host, self.port = host, port
         self.registry = registry or global_registry()
         self.health = health or HealthRegistry()
@@ -56,6 +56,11 @@ class OperationsServer:
 
             tracer = global_tracer()
         self.tracer = tracer  # /trace: the block-commit flight recorder
+        if slo is None:
+            from fabric_tpu.observe.slo import global_engine
+
+            slo = global_engine()
+        self.slo = slo        # /slo: the burn-rate engine
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self):
@@ -148,6 +153,10 @@ class OperationsServer:
                     ).encode()
         if path == "/trace" or path.startswith("/trace?"):
             return self._route_trace(path)
+        if path == "/slo" or path.startswith("/slo?"):
+            return 200, "application/json", json.dumps(
+                self.slo.report()
+            ).encode()
         if path.startswith("/debug/"):
             return self._route_debug(path)
         return 404, "application/json", b'{"error": "not found"}'
@@ -160,25 +169,31 @@ class OperationsServer:
         "validator_stage_seconds",
         "host_stage_pool_seconds",
         "sidecar_request_seconds",
+        "sidecar_queue_age_seconds",
     )
 
     def _route_trace(self, path: str):
         """Flight-recorder surface (fabric_tpu.observe): ``/trace``
         serves recent slow blocks (plus the most recent trees and an
         aggregate-stage summary); ``/trace?block=N`` serves one block's
-        full span tree."""
+        full span tree.  ``ns=`` selects a non-default ring — a
+        colocated sidecar's request trees live under ``ns=sidecar``
+        (``/trace?ns=sidecar&block=7`` is request 7), so they never
+        shadow peer block numbers."""
         from urllib.parse import parse_qs, urlparse
 
         q = parse_qs(urlparse(path).query)
+        ns = q.get("ns", [""])[0]
         if "block" in q:
             try:
                 num = int(q["block"][0])
             except ValueError:
                 return 400, "application/json", b'{"error": "bad block"}'
-            tree = self.tracer.block(num)
+            tree = self.tracer.block(num, ns=ns)
             if tree is None:
                 return 404, "application/json", json.dumps(
-                    {"error": f"block {num} not in the flight recorder"}
+                    {"error": f"block {num} not in the flight recorder"
+                              + (f" (ns={ns})" if ns else "")}
                 ).encode()
             return 200, "application/json", json.dumps(tree).encode()
 
@@ -194,7 +209,7 @@ class OperationsServer:
                 }
                 for key, s in sorted(m.snapshot().items())
             }
-        ring = self.tracer.blocks()
+        ring = self.tracer.blocks(ns=ns)
         payload = {
             "enabled": self.tracer.enabled,
             "ring_blocks": self.tracer.ring_blocks,
@@ -202,8 +217,11 @@ class OperationsServer:
             "slow_blocks": self.tracer.slow_blocks(),
             "recent_blocks": ring[-4:],
             "blocks_in_ring": [b.get("block") for b in ring],
+            "namespaces": self.tracer.namespaces(),
             "summary": summary,
         }
+        if ns:
+            payload["ns"] = ns
         return 200, "application/json", json.dumps(payload).encode()
 
     def _route_debug(self, path: str):

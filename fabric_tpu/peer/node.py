@@ -1021,6 +1021,7 @@ class PeerNode:
                  host_stage_mode: str = "thread",
                  trace_ring_blocks: int | None = None,
                  trace_slow_factor: float | None = None,
+                 slos: str = "",
                  device_fail_threshold: int = 0,
                  device_retries: int = 2,
                  device_recovery_s: float = 30.0,
@@ -1049,6 +1050,10 @@ class PeerNode:
         # span-tracer knobs (None = leave the global tracer as-is)
         self.trace_ring_blocks = trace_ring_blocks
         self.trace_slow_factor = trace_slow_factor
+        # SLO spec (nodeconfig ``slos``): armed at start(), like the
+        # tracer knobs — a constructor side effect would let a second
+        # node silently wipe the first's engine state
+        self.slos = slos
         # device-lane degradation knobs (peer/degrade.py): threshold 0
         # keeps the guard off — the safe default everywhere
         self.device_fail_threshold = int(device_fail_threshold)
@@ -1287,6 +1292,14 @@ class PeerNode:
         self.gossip_service = GossipService(self).register()
         await self.server.start()
         self.port = self.server.port
+        if self.slos:
+            # arm the process-global burn-rate engine on the global
+            # tracer's finished-block stream; /slo (operations server
+            # below) serves its report.  Spec validity was checked at
+            # config load (nodeconfig), so this cannot raise mid-start.
+            from fabric_tpu.observe import slo as _slo
+
+            _slo.configure(self.slos)
         if self.sidecar_listen:
             # nodeconfig ``sidecar_listen``: this peer's device fabric
             # ALSO serves a validation sidecar — other peers attach as

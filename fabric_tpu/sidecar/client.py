@@ -91,7 +91,8 @@ class SidecarLink:
     def __init__(self, host: str, port: int, tenant: str,
                  weight: float = 1.0, ssl_ctx=None,
                  timeout_s: float = 30.0, busy_retries: int = 6,
-                 backoff: Backoff | None = None, registry=None):
+                 backoff: Backoff | None = None, registry=None,
+                 tracer=None):
         self.host, self.port = host, int(port)
         self.tenant = tenant
         self.weight = float(weight)
@@ -99,6 +100,15 @@ class SidecarLink:
         self.timeout_s = float(timeout_s)
         self.busy_retries = int(busy_retries)
         self._backoff_proto = backoff
+        if tracer is None:
+            from fabric_tpu.observe import global_tracer
+
+            tracer = global_tracer()
+        # trace stitching: submit() reads the CALLER thread's current
+        # span off this tracer, ships its block context on the wire,
+        # and hangs the sidecar's returned subtree under the block
+        # root with NTP-style clock-offset alignment
+        self.tracer = tracer
         self._client: RpcClient | None = None
         self._stream = None
         self._reader_task: asyncio.Task | None = None
@@ -146,8 +156,21 @@ class SidecarLink:
         if self._closed or not self._thread.is_alive():
             raise SidecarUnavailable("sidecar link is closed")
         tuples = list(tuples)
+        # capture the caller thread's trace context HERE — the async
+        # internals run on the link loop thread, whose thread-local
+        # current span is never ours
+        cur = self.tracer.current()
+        stitch_root = None
+        trace = None
+        if cur is not None:
+            stitch_root = cur.root if cur.root is not None else cur
+            trace = {
+                "block": stitch_root.attrs.get("block"),
+                "root": id(stitch_root) & 0xFFFFFFFF,
+                "tenant": self.tenant,
+            }
         fut = asyncio.run_coroutine_threadsafe(
-            self._asubmit(tuples), self._loop
+            self._asubmit(tuples, trace, stitch_root), self._loop
         )
         # worst case: every attempt burns its own response timeout plus
         # the busy backoff between — bound the caller's wait to that
@@ -172,7 +195,8 @@ class SidecarLink:
 
     # -- async internals (link loop only) ----------------------------------
 
-    async def _asubmit(self, tuples: list) -> list:
+    async def _asubmit(self, tuples: list, trace: dict | None = None,
+                       stitch_root=None) -> list:
         bo = self._backoff_proto or Backoff(base=0.02, cap=0.5, jitter=0.5)
         busy = 0
         while True:
@@ -182,8 +206,11 @@ class SidecarLink:
             fut = self._loop.create_future()
             self._pending[seq] = fut
             try:
-                await st.send(wire.encode_request(seq, tuples))
+                t_send = self.tracer.clock()
+                await st.send(wire.encode_request(seq, tuples,
+                                                  trace=trace))
                 resp = await asyncio.wait_for(fut, self.timeout_s)
+                t_recv = self.tracer.clock()
             except (RpcError, ConnectionError, OSError,
                     asyncio.TimeoutError,
                     asyncio.IncompleteReadError) as e:
@@ -222,7 +249,49 @@ class SidecarLink:
                     f"sidecar answered {len(verdicts)} verdicts for a "
                     f"{len(tuples)}-signature batch"
                 )
+            if stitch_root is not None:
+                self._stitch(stitch_root, hdr.get("remote"),
+                             t_send, t_recv)
             return verdicts
+
+    def _stitch(self, root, remote, t_send: float, t_recv: float) -> None:
+        """Hang the sidecar's finished request subtree under the
+        peer's block root, aligned onto the local timeline.
+
+        The offset estimate is NTP's: the server's receive/send
+        timestamps should straddle the same midpoint as our
+        send/receive pair, so offset = ((t_rx−t_send)+(t_tx−t_recv))/2
+        (server clock − local clock).  The residual error is bounded
+        by half the round-trip asymmetry — recorded on the stitched
+        root (``clock_offset_ms``/``rtt_ms``) so readers know the
+        alignment tolerance."""
+        if not isinstance(remote, dict) or "spans" not in remote:
+            return
+        try:
+            t_rx = float(remote["t_rx"]) / 1000.0
+            t_tx = float(remote["t_tx"]) / 1000.0
+            offset = ((t_rx - t_send) + (t_tx - t_recv)) / 2.0
+            from fabric_tpu.observe import span_from_dict
+
+            sp = span_from_dict(remote["spans"], offset_s=offset,
+                                proc="sidecar")
+            sp.name = "sidecar_request"  # "block" would read wrong here
+            # the sidecar's request id must not shadow the PEER block
+            # number this subtree now belongs to
+            if "block" in sp.attrs:
+                sp.attrs["req"] = sp.attrs.pop("block")
+            sp.attrs["clock_offset_ms"] = round(offset * 1000.0, 3)
+            sp.attrs["rtt_ms"] = round(
+                max(0.0, (t_recv - t_send) - (t_tx - t_rx)) * 1000.0, 3
+            )
+            sp.root = root
+            root.children.append(sp)  # GIL-atomic; root may be live
+        except (TypeError, ValueError, KeyError, AttributeError) as e:
+            # the remote payload is trust-boundary metadata: a
+            # malformed tree (non-dict spans/children from a skewed
+            # sidecar) must never fail the verify path or feed the
+            # caller's degrade latch — verdicts already validated
+            _log.debug("sidecar trace stitch failed: %s", e)
 
     async def _ensure_attached(self):
         if self._conn_lock is None:

@@ -25,6 +25,7 @@ event loop drives it and tests drive it deterministically.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import deque
@@ -46,6 +47,7 @@ class Request:
     items: list
     stream: object = None
     root: object = None          # tracer span root (server-side)
+    trace: dict | None = None    # propagated peer trace context
     t_enqueue: float = 0.0
     cost: int = field(default=0)
 
@@ -56,7 +58,7 @@ class Request:
 
 class _Tenant:
     __slots__ = ("name", "weight", "queue", "deficit", "served_cost",
-                 "enqueued", "rejected", "refs")
+                 "enqueued", "rejected", "refs", "ages")
 
     def __init__(self, name: str, weight: float):
         self.name = name
@@ -67,6 +69,19 @@ class _Tenant:
         self.enqueued = 0
         self.rejected = 0
         self.refs = 1  # connections sharing this tenant entry
+        # trailing queue ages (seconds spent waiting before dispatch):
+        # stats() turns these into the p50/p99 the bench tracks
+        self.ages: deque = deque(maxlen=256)
+
+
+def _pct(sorted_vals: list, q: float) -> float:
+    """Nearest-rank percentile of a pre-sorted list (0 < q <= 100):
+    rank = ceil(q/100 * n).  (round(x + 0.5) is NOT ceil — banker's
+    rounding sends exact .5 midpoints to the even rank.)"""
+    if not sorted_vals:
+        return 0.0
+    rank = math.ceil(q / 100.0 * len(sorted_vals))
+    return sorted_vals[max(0, min(len(sorted_vals) - 1, rank - 1))]
 
 
 class WeightedScheduler:
@@ -75,7 +90,7 @@ class WeightedScheduler:
     the device work)."""
 
     def __init__(self, queue_limit: int = 8, quantum: int = DEFAULT_QUANTUM,
-                 registry=None):
+                 registry=None, clock=time.perf_counter):
         if queue_limit < 1:
             raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
         if quantum < 1:
@@ -84,6 +99,10 @@ class WeightedScheduler:
             raise ValueError(f"quantum must be >= 1, got {quantum}")
         self.queue_limit = int(queue_limit)
         self.quantum = int(quantum)
+        # queue ages subtract Request.t_enqueue stamps, so the clock
+        # must be the SAME one the server stamps with (the tracer's —
+        # injectable for skew tests)
+        self.clock = clock
         self._lock = threading.Lock()
         self._tenants: dict[str, _Tenant] = {}
         self._order: list[str] = []   # registration order = DRR rotation
@@ -106,6 +125,21 @@ class WeightedScheduler:
             "sidecar_tenant_share",
             "tenant's fraction of signatures served by the sidecar",
         )
+        self._age_hist = registry.histogram(
+            "sidecar_queue_age_seconds",
+            "time a request waited in its tenant's admission queue "
+            "before the DRR drain picked it",
+        )
+        self._deficit_gauge = registry.gauge(
+            "sidecar_tenant_deficit",
+            "tenant's current deficit credit (signatures) in the "
+            "weighted-deficit-round-robin rotation",
+        )
+        self._busy_ctr = registry.counter(
+            "sidecar_busy_total",
+            "requests rejected at a full tenant admission queue "
+            "(answered with a typed BUSY frame)",
+        )
 
     # -- tenant lifecycle --------------------------------------------------
 
@@ -126,6 +160,7 @@ class WeightedScheduler:
                 t.served_cost = old["served_cost"]
                 t.enqueued = old["enqueued"]
                 t.rejected = old["rejected"]
+                t.ages.extend(old.get("_ages", ()))
             self._tenants[name] = t
             self._order.append(name)
 
@@ -150,6 +185,7 @@ class WeightedScheduler:
                 "served_cost": t.served_cost,
                 "enqueued": t.enqueued,
                 "rejected": t.rejected,
+                "_ages": list(t.ages),
             }
             orphans = list(t.queue)
             t.queue.clear()
@@ -167,12 +203,18 @@ class WeightedScheduler:
                 raise KeyError(f"tenant {req.tenant!r} is not registered")
             if len(t.queue) >= self.queue_limit:
                 t.rejected += 1
-                return False
-            if not req.t_enqueue:
-                req.t_enqueue = time.perf_counter()
-            t.queue.append(req)
-            t.enqueued += 1
-            depth = len(t.queue)
+                depth = None
+            else:
+                if not req.t_enqueue:
+                    req.t_enqueue = self.clock()
+                t.queue.append(req)
+                t.enqueued += 1
+                depth = len(t.queue)
+        # metric bumps outside the scheduler lock (lock discipline:
+        # never nest the registry lock under it)
+        if depth is None:
+            self._busy_ctr.add(1, tenant=req.tenant)
+            return False
         self._depth_gauge.set(depth, tenant=req.tenant)
         return True
 
@@ -186,6 +228,7 @@ class WeightedScheduler:
         takes extra rounds, it is never starved)."""
         out: list = []
         touched: set = set()
+        now = self.clock()
         with self._lock:
             # incremental DRR: the rotation cursor walks tenant by
             # tenant, each BACKLOGGED visit credits weight×quantum and
@@ -219,6 +262,8 @@ class WeightedScheduler:
                     req = t.queue.popleft()
                     t.deficit -= req.cost
                     t.served_cost += req.cost
+                    if req.t_enqueue:
+                        t.ages.append(max(0.0, now - req.t_enqueue))
                     out.append(req)
                     touched.add(t.name)
                 if not t.queue:
@@ -244,9 +289,16 @@ class WeightedScheduler:
             }
             depths = {name: len(self._tenants[name].queue)
                       for name in touched}
+            deficits = {name: self._tenants[name].deficit
+                        for name in touched}
         for name in touched:
             self._depth_gauge.set(depths[name], tenant=name)
             self._share_gauge.set(round(shares[name], 4), tenant=name)
+            self._deficit_gauge.set(round(deficits[name], 1), tenant=name)
+        for req in out:
+            if req.t_enqueue:
+                self._age_hist.observe(max(0.0, now - req.t_enqueue),
+                                       tenant=req.tenant)
         return out
 
     # -- introspection -----------------------------------------------------
@@ -262,26 +314,44 @@ class WeightedScheduler:
 
     def stats(self) -> dict:
         """{tenant: {weight, depth, served_cost, share, enqueued,
-        rejected}} — bench extras and /healthz read this.  Retired
-        (fully-disconnected) tenants keep their totals at depth 0, so
-        the fairness picture survives the stream teardown."""
+        rejected, busy_rate, deficit, queue_age_ms}} — bench extras
+        and /healthz read this.  Retired (fully-disconnected) tenants
+        keep their totals at depth 0, so the fairness picture survives
+        the stream teardown.  ``queue_age_ms`` carries the trailing
+        p50/p99 time-in-queue; ``busy_rate`` is the fraction of
+        arrivals pushed back BUSY."""
         with self._lock:
-            rows = {
-                name: {
+            rows = {}
+            ages = {}
+            for name, t in self._tenants.items():
+                rows[name] = {
                     "weight": t.weight,
                     "depth": len(t.queue),
                     "served_cost": t.served_cost,
                     "enqueued": t.enqueued,
                     "rejected": t.rejected,
+                    "deficit": round(t.deficit, 1),
                 }
-                for name, t in self._tenants.items()
-            }
+                ages[name] = list(t.ages)
             for name, old in self._retired.items():
                 if name not in rows:
-                    rows[name] = {"depth": 0, **old}
+                    row = {k: v for k, v in old.items()
+                           if not k.startswith("_")}
+                    rows[name] = {"depth": 0, "deficit": 0.0, **row}
+                    ages[name] = list(old.get("_ages", ()))
             total = sum(r["served_cost"] for r in rows.values())
-            for r in rows.values():
-                r["share"] = (
-                    round(r["served_cost"] / total, 4) if total else 0.0
-                )
-            return dict(sorted(rows.items()))
+        for name, r in rows.items():
+            r["share"] = (
+                round(r["served_cost"] / total, 4) if total else 0.0
+            )
+            arrivals = r["enqueued"] + r["rejected"]
+            r["busy_rate"] = (
+                round(r["rejected"] / arrivals, 4) if arrivals else 0.0
+            )
+            a = sorted(ages.get(name, ()))
+            r["queue_age_ms"] = {
+                "p50": round(_pct(a, 50) * 1000.0, 3),
+                "p99": round(_pct(a, 99) * 1000.0, 3),
+                "n": len(a),
+            }
+        return dict(sorted(rows.items()))

@@ -32,12 +32,20 @@ is injectable so crypto-free tests and toy fabrics reuse the whole
 service unchanged.
 
 Observability: ``sidecar_queue_depth{tenant}`` /
-``sidecar_tenant_share{tenant}`` gauges (scheduler),
+``sidecar_tenant_share{tenant}`` / ``sidecar_tenant_deficit{tenant}``
+gauges and ``sidecar_queue_age_seconds{tenant}`` /
+``sidecar_busy_total{tenant}`` (scheduler),
 ``sidecar_request_seconds{tenant,stage}`` histograms (queue_wait /
-dispatch / total), ``sidecar_requests_total{tenant,status}``, tracer
-span trees per request (queue_wait + dispatch children, served at
-``/trace`` when the sidecar process runs an operations server), and
-``health_check`` for ``/healthz``.
+dispatch / total), ``sidecar_requests_total{tenant,status}``,
+``sidecar_coalesce_occupancy{unit}``, tracer span trees per request
+(queue_wait + dispatch children) in the ``sidecar`` flight-recorder
+NAMESPACE — their own ring, so request numbering never collides with
+peer block numbers in a colocated process
+(``/trace?ns=sidecar&block=N``) — and ``health_check`` for
+``/healthz``.  When a request carries a ``trace`` context
+(``wire.py``), the finished subtree ships back in the response
+header and the client stitches it under the peer's block root with
+clock-offset alignment — one block's waterfall spans both processes.
 
 Chaos hooks: ``sidecar.request`` fires at admission,
 ``sidecar.dispatch`` inside the coalesced device dispatch, and every
@@ -50,7 +58,6 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
-import time
 from concurrent.futures import ThreadPoolExecutor
 
 from fabric_tpu import faults as _faults
@@ -88,9 +95,15 @@ class SidecarServer:
         self.mesh = None
         self._verify_fn = verify_fn
         self._rpc = RpcServer(host, port, ssl_ctx=ssl_ctx)
+        if tracer is None:
+            from fabric_tpu.observe import global_tracer
+
+            tracer = global_tracer()
+        self.tracer = tracer
         kw = {} if quantum is None else {"quantum": int(quantum)}
         self.scheduler = WeightedScheduler(
-            queue_limit=queue_blocks, registry=registry, **kw
+            queue_limit=queue_blocks, registry=registry,
+            clock=tracer.clock, **kw
         )
         if registry is None:
             from fabric_tpu.ops_metrics import global_registry
@@ -107,11 +120,13 @@ class SidecarServer:
         self._tenants_gauge = registry.gauge(
             "sidecar_tenants", "tenant connections currently attached"
         )
-        if tracer is None:
-            from fabric_tpu.observe import global_tracer
-
-            tracer = global_tracer()
-        self.tracer = tracer
+        self._coalesce_hist = registry.histogram(
+            "sidecar_coalesce_occupancy",
+            "cross-tenant batches merged per device dispatch "
+            "(unit=requests) and their total cost (unit=signatures)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 256, 1024, 4096,
+                     float("inf")),
+        )
         # ONE device lane: the chip serializes dispatches anyway, and a
         # single executor thread keeps verify_launch_many calls ordered
         self._device = ThreadPoolExecutor(
@@ -207,13 +222,29 @@ class SidecarServer:
                     await stream.error(f"bad request: {e}")
                     return
                 seq = int(hdr["seq"])
+                trace = hdr.get("trace")
+                extra = {}
+                if isinstance(trace, dict):
+                    # propagated peer trace context: root this
+                    # request's queue_wait/dispatch story under it so
+                    # the finished subtree ships back stitchable
+                    extra = {
+                        "peer_block": trace.get("block"),
+                        "peer_root": trace.get("root"),
+                    }
+                # ns="sidecar": request trees live in their own
+                # flight-recorder ring, so a colocated deployment's
+                # request numbering can neither evict real block trees
+                # nor collide with them at /trace?block=N
                 root = self.tracer.begin_block(
-                    self._next_req_id(), channel=f"sidecar:{tenant}",
-                    seq=seq,
+                    self._next_req_id(), ns="sidecar",
+                    channel=f"sidecar:{tenant}", seq=seq, **extra,
                 )
                 req = Request(tenant=tenant, seq=seq, items=items,
                               stream=stream, root=root,
-                              t_enqueue=time.perf_counter())
+                              trace=trace if isinstance(trace, dict)
+                              else None,
+                              t_enqueue=self.tracer.clock())
                 if not self.scheduler.submit(req):
                     self._req_ctr.add(1, tenant=tenant, status="busy")
                     self.tracer.set_attrs(root, busy=True)
@@ -246,13 +277,17 @@ class SidecarServer:
                 batch = self.scheduler.next_batch(self.coalesce)
                 if not batch:
                     break
-                t0 = time.perf_counter()
+                self._coalesce_hist.observe(len(batch), unit="requests")
+                self._coalesce_hist.observe(
+                    sum(r.cost for r in batch), unit="signatures"
+                )
+                t0 = self.tracer.clock()
                 try:
                     verdicts = await loop.run_in_executor(
                         self._device, self._verify_batch,
                         [r.items for r in batch],
                     )
-                    t1 = time.perf_counter()
+                    t1 = self.tracer.clock()
                     await self._answer(batch, verdicts, t0, t1)
                 except asyncio.CancelledError:
                     raise
@@ -313,10 +348,29 @@ class SidecarServer:
                             parent=req.root)
             self.tracer.add("dispatch", t0, t1, parent=req.root,
                             coalesced=len(batch), n_sigs=req.cost)
-            sent = await self._send(req, wire.encode_response(req.seq, ok))
+            sent = await self._send(
+                req, wire.encode_response(req.seq, ok,
+                                          remote=self._remote(req))
+            )
             self._req_ctr.add(1, tenant=req.tenant,
                               status="ok" if sent else "dropped")
             self.tracer.finish_block(req.root)
+
+    def _remote(self, req: Request) -> dict | None:
+        """The finished request subtree + send/receive timestamps the
+        client stitches from — only built when the request carried a
+        trace context (the peer asked) and tracing is on here."""
+        if req.trace is None or req.root is None:
+            return None
+        # close the root NOW so the shipped tree has a complete
+        # window; finish_block tolerates a pre-set t1 (ring append
+        # and watchdog run there as usual)
+        self.tracer.end(req.root)
+        return {
+            "spans": req.root.to_dict(0.0),
+            "t_rx": round(req.t_enqueue * 1000.0, 3),
+            "t_tx": round(self.tracer.clock() * 1000.0, 3),
+        }
 
     async def _answer_error(self, batch: list, err: Exception) -> None:
         msg = f"{type(err).__name__}: {err}"

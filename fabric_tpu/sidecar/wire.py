@@ -13,9 +13,21 @@ Frames ride ``comm.rpc`` MSG payloads:
 
     hello    := JSON {"tenant": str, "weight": float}
     welcome  := JSON {"ok": true, "coalesce": int}
-    request  := u32 hdr_len | JSON {"seq": int, "n": int} | items
+    request  := u32 hdr_len | JSON {"seq": int, "n": int
+                [, "trace": {"block", "root", "tenant"}]} | items
     response := u32 hdr_len | JSON {"seq": int [, "status", "error",
-                "retry_ms"]} | verdict bytes (one 0/1 byte per item)
+                "retry_ms"] [, "remote": {"spans", "t_rx", "t_tx"}]}
+                | verdict bytes (one 0/1 byte per item)
+
+The optional ``trace`` request field propagates the peer's trace
+context (its block number, root span id and tenant) so the sidecar
+roots its queue_wait/dispatch spans under it; the optional ``remote``
+response field ships the finished remote subtree back — ``spans`` is
+the ``Span.to_dict(0.0)`` tree with ABSOLUTE times on the sidecar's
+clock, and ``t_rx``/``t_tx`` (request receive / response send, same
+clock) let the client estimate the clock offset NTP-style from the
+request/response timestamp midpoints and stitch the subtree onto its
+own timeline.
 
 ``items`` packs each tuple as five 32-byte big-endian integers — the
 natural width of P-256 scalars/field elements.  A component that does
@@ -86,8 +98,11 @@ def _unframe(payload: bytes) -> tuple[dict, bytes]:
     return hdr, payload[_LEN.size + n:]
 
 
-def encode_request(seq: int, tuples) -> bytes:
-    return _frame({"seq": int(seq), "n": len(tuples)}, pack_items(tuples))
+def encode_request(seq: int, tuples, trace: dict | None = None) -> bytes:
+    hdr = {"seq": int(seq), "n": len(tuples)}
+    if trace:
+        hdr["trace"] = trace
+    return _frame(hdr, pack_items(tuples))
 
 
 def decode_request(payload: bytes) -> tuple[dict, list]:
@@ -101,9 +116,11 @@ def decode_request(payload: bytes) -> tuple[dict, list]:
     return hdr, items
 
 
-def encode_response(seq: int, verdicts) -> bytes:
-    return _frame({"seq": int(seq)},
-                  bytes(1 if v else 0 for v in verdicts))
+def encode_response(seq: int, verdicts, remote: dict | None = None) -> bytes:
+    hdr = {"seq": int(seq)}
+    if remote:
+        hdr["remote"] = remote
+    return _frame(hdr, bytes(1 if v else 0 for v in verdicts))
 
 
 def encode_busy(seq: int, retry_ms: float) -> bytes:

@@ -17,6 +17,14 @@ where the dump carries the tree), start/duration in ms, and the
 thread/worker that ran it — the overlap question ("did prefetch(k+1)
 run while commit(k) fsynced?") is answered by bars on different
 thread rows sharing a time range across consecutive blocks.
+
+Merged MULTI-PROCESS dumps (a peer tree with the sidecar's stitched
+request subtree, or a Chrome export with several process_name rows)
+render with per-process labels — ``[sidecar:fabtpu-sidecar-dev_0]``
+vs ``[MainThread]`` — and a ``~ clock offset`` annotation under each
+stitched subtree stating the estimated remote-clock offset and the
+round-trip bound on its error, so a browserless host can read the
+cross-process waterfall AND how far to trust its alignment.
 """
 
 from __future__ import annotations
@@ -58,10 +66,19 @@ def render_tree(block: dict, width: int = 48) -> str:
     out = ["block %s  total %.2f ms%s" % (block.get("block"), total, extra)]
 
     def walk(span: dict, depth: int) -> None:
+        row = span.get("thread", "?")
+        if span.get("proc"):
+            row = f"{span['proc']}:{row}"
         out.append(_line(depth, span.get("name", "?"),
                          float(span.get("start_ms", 0.0)),
                          float(span.get("dur_ms", 0.0)),
-                         total, span.get("thread", "?"), width))
+                         total, row, width))
+        off = (span.get("attrs") or {}).get("clock_offset_ms")
+        if off is not None:
+            out.append("  %s ~ clock offset %.3f ms (rtt %.3f ms)" % (
+                " " * width, float(off),
+                float((span.get("attrs") or {}).get("rtt_ms", 0.0)),
+            ))
         for ev in span.get("events", ()):
             out.append("  %s ! %s" % (
                 " " * width,
@@ -97,8 +114,15 @@ def render_trace_dump(data: dict, width: int = 48,
 def render_chrome(data: dict, width: int = 48,
                   block: int | None = None) -> str:
     events = data.get("traceEvents", data if isinstance(data, list) else [])
+    # thread rows are keyed (pid, tid) — tids repeat across processes
+    # in a multi-process export; process_name rows label the pids
+    procs = {
+        e.get("pid", 0): e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
     threads = {
-        e["tid"]: e["args"]["name"]
+        (e.get("pid", 0), e["tid"]): e["args"]["name"]
         for e in events
         if e.get("ph") == "M" and e.get("name") == "thread_name"
     }
@@ -121,7 +145,12 @@ def render_chrome(data: dict, width: int = 48,
         base, total = roots[0]["ts"], roots[0].get("dur", 0.0) / 1000.0
         lines = ["block %d  total %.2f ms" % (b, total)]
         for e in evs:
-            thread = threads.get(e.get("tid"), str(e.get("tid")))
+            pid = e.get("pid", 0)
+            thread = threads.get((pid, e.get("tid")),
+                                 str(e.get("tid")))
+            proc = procs.get(pid, "")
+            if proc and proc != "local":
+                thread = f"{proc}:{thread}"
             start = (e["ts"] - base) / 1000.0
             if e["ph"] == "i":
                 lines.append("  %s ! %s @ %.2f ms" % (
@@ -131,6 +160,14 @@ def render_chrome(data: dict, width: int = 48,
             lines.append(_line(0, e.get("name", "?"), start,
                                e.get("dur", 0.0) / 1000.0, total, thread,
                                width))
+            off = e.get("args", {}).get("clock_offset_ms")
+            if off is not None:
+                lines.append(
+                    "  %s ~ clock offset %.3f ms (rtt %.3f ms)" % (
+                        " " * width, float(off),
+                        float(e.get("args", {}).get("rtt_ms", 0.0)),
+                    )
+                )
         out.append("\n".join(lines))
     return "\n\n".join(out) or "no block events in trace"
 

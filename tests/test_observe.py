@@ -422,3 +422,123 @@ def test_metrics_concurrent_read_write_smoke():
         for t in threads:
             t.join()
     assert not errors, errors
+
+
+# ---------------------------------------------------------------------------
+# namespaced rings + multi-process export (ISSUE 9)
+
+
+def test_namespaced_rings_are_independent():
+    tr = Tracer(ring_blocks=2, slow_factor=0)
+    for n in range(3):
+        tr.finish_block(tr.begin_block(n, channel="c"))
+    for n in range(5):
+        tr.finish_block(tr.begin_block(n, ns="sidecar", channel="s"))
+    # the sidecar storm evicted only its own ring
+    assert [b["block"] for b in tr.blocks()] == [1, 2]
+    assert [b["block"] for b in tr.blocks(ns="sidecar")] == [3, 4]
+    assert tr.block(1)["attrs"]["channel"] == "c"
+    assert tr.block(4, ns="sidecar")["attrs"]["ns"] == "sidecar"
+    assert tr.block(4) is None  # no cross-namespace shadowing
+    assert tr.namespaces() == {"": 2, "sidecar": 2}
+    # a resize keeps both rings (truncated)
+    tr.configure(ring_blocks=1)
+    assert tr.namespaces() == {"": 1, "sidecar": 1}
+
+
+def test_watchdog_medians_are_per_namespace(caplog):
+    """Sub-ms sidecar requests must not drag the block-commit median
+    down (which would flag every normal block as slow), and vice
+    versa."""
+    clk = _Clock()
+    tr = Tracer(ring_blocks=64, slow_factor=3.0, clock=clk)
+    for n in range(10):  # blocks at a steady 100 ms
+        root = tr.begin_block(n)
+        clk.advance(0.100)
+        tr.finish_block(root)
+    for n in range(20):  # requests at a steady 1 ms, separate ns
+        root = tr.begin_block(n, ns="sidecar")
+        clk.advance(0.001)
+        tr.finish_block(root)
+    with caplog.at_level(logging.WARNING, logger="fabric_tpu.observe"):
+        root = tr.begin_block(99)  # another normal 100 ms block
+        clk.advance(0.100)
+        tr.finish_block(root)
+    # against the BLOCK median (100 ms) this is not slow; against a
+    # polluted mixed median (~1 ms) it would have been 100x
+    assert tr.slow_blocks() == []
+
+
+def test_root_propagates_to_leaf_spans():
+    tr = Tracer(ring_blocks=4, slow_factor=0)
+    root = tr.begin_block(5)
+    assert root.root is root
+    with tr.span("launch", parent=root) as launch:
+        assert launch.root is root
+        with tr.span("inner") as inner:
+            assert inner.root is root
+        tr.add("retro", 0.0, 0.001)
+    assert root.children[0].children[0].root is root
+    tr.finish_block(root)
+
+
+def test_span_from_dict_roundtrip_with_offset():
+    from fabric_tpu.observe import span_from_dict
+
+    tr = Tracer(ring_blocks=4, slow_factor=0)
+    root = tr.begin_block(3, channel="x")
+    with tr.span("dispatch", parent=root, n=2):
+        pass
+    tr.event("note", parent=root)
+    tr.finish_block(root)
+    d = root.to_dict(0.0)  # absolute times, the wire form
+    sp = span_from_dict(d, offset_s=10.0, proc="sidecar")
+    assert sp.proc == "sidecar" and sp.children[0].proc == "sidecar"
+    assert sp.t0 == pytest.approx(root.t0 - 10.0, abs=1e-3)
+    assert sp.children[0].name == "dispatch"
+    assert sp.children[0].attrs == {"n": 2}
+    assert sp.events[0][0] == "note"
+    assert sp.children[0].t0 == pytest.approx(
+        root.children[0].t0 - 10.0, abs=1e-3
+    )
+
+
+def test_traceview_renders_multiprocess_dump():
+    """Satellite: merged peer+sidecar trees render with per-process
+    labels and the clock-offset annotation, both input forms."""
+    import traceview
+    from fabric_tpu.observe import span_from_dict
+
+    tr = Tracer(ring_blocks=4, slow_factor=0)
+    root = tr.begin_block(11, channel="chanA")
+    with tr.span("sig_prepare_launch", parent=root):
+        pass
+    # a stitched remote subtree, the client shape
+    remote_src = Tracer(ring_blocks=4, slow_factor=0)
+    rroot = remote_src.begin_block(1, ns="sidecar",
+                                   channel="sidecar:chanA")
+    remote_src.add("queue_wait", rroot.t0, rroot.t0 + 0.001,
+                   parent=rroot)
+    remote_src.add("dispatch", rroot.t0 + 0.001, rroot.t0 + 0.003,
+                   parent=rroot)
+    remote_src.end(rroot)
+    sp = span_from_dict(rroot.to_dict(0.0), offset_s=-0.002,
+                        proc="sidecar")
+    sp.name = "sidecar_request"
+    sp.attrs["clock_offset_ms"] = -2.0
+    sp.attrs["rtt_ms"] = 0.4
+    root.children.append(sp)
+    tr.finish_block(root)
+
+    # /trace-dump form
+    text = traceview.render(tr.block(11))
+    assert "sidecar:" in text            # per-process row label
+    assert "clock offset -2.000 ms" in text
+    assert "queue_wait" in text and "dispatch" in text
+
+    # Chrome form: distinct pid + process_name metadata
+    data = {"traceEvents": tr.chrome_events()}
+    text = traceview.render(data, block=11)
+    assert "sidecar:" in text
+    assert "clock offset -2.000 ms" in text
+    assert "sig_prepare_launch" in text

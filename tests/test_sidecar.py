@@ -719,3 +719,306 @@ def test_sidecar_kill_restart_recovers_through_probe(loop_thread):
     assert "local" in v.lanes[3:8]          # rode the latch while down
     assert v.lanes[-1] == "sidecar"         # re-attached at the end
     assert not guard.degraded               # probe re-armed the lane
+
+
+# -- cross-process trace propagation (ISSUE 9 tentpole) ----------------------
+
+
+class _SkewClock:
+    """perf_counter shifted by a constant — a 'different process
+    clock' for offset-estimation tests."""
+
+    def __init__(self, skew_s: float):
+        self.skew = float(skew_s)
+
+    def __call__(self) -> float:
+        return time.perf_counter() + self.skew
+
+
+def _stitched(root):
+    return [c for c in root.children if c.name == "sidecar_request"]
+
+
+def test_trace_stitches_across_the_wire_under_clock_skew(loop_thread):
+    """THE tentpole shape: the peer's block root gains the sidecar's
+    queue_wait/dispatch children on sidecar-labelled process rows,
+    with the remote clock's +123s skew estimated away by the
+    request/response midpoints."""
+    from fabric_tpu.observe import Tracer
+
+    SKEW = 123.0
+    server_tr = Tracer(ring_blocks=8, slow_factor=0,
+                       clock=_SkewClock(SKEW))
+    srv = make_server(loop_thread, tracer=server_tr)
+    client_tr = Tracer(ring_blocks=8, slow_factor=0)
+    link = make_link(srv, tenant="chanA", tracer=client_tr)
+    try:
+        root = client_tr.begin_block(7, channel="chanA")
+        tok = client_tr.attach(root)
+        try:
+            # submit from UNDER a child span, the validator shape —
+            # the stitch must still target the block ROOT
+            with client_tr.span("sig_prepare_launch", parent=root):
+                h = link.submit([(1, 1, 0, 0, 0), (2, 0, 0, 0, 0)])
+            assert h.fetch() == [True, False]
+        finally:
+            client_tr.detach(tok)
+        client_tr.finish_block(root)
+
+        (remote,) = _stitched(root)
+        assert remote.proc == "sidecar"
+        names = [c.name for c in remote.children]
+        assert "queue_wait" in names and "dispatch" in names
+        assert all(c.proc == "sidecar" for c in remote.children)
+        # the server rooted its tree under the propagated context
+        assert remote.attrs.get("peer_block") == 7
+        assert remote.attrs.get("ns") == "sidecar"
+        # offset estimation: the +123s skew is recovered to within
+        # loopback round-trip slack
+        off_ms = remote.attrs["clock_offset_ms"]
+        assert abs(off_ms - SKEW * 1000.0) < 100.0
+        assert remote.attrs["rtt_ms"] >= 0.0
+        # timestamps aligned: the stitched subtree lands inside the
+        # local block window (the acceptance 'offsets sane' criterion)
+        eps = 0.1
+        assert root.t0 - eps <= remote.t0 <= root.t1 + eps
+        assert remote.t1 <= root.t1 + eps
+        for c in remote.children:
+            assert root.t0 - eps <= c.t0 and c.t1 <= root.t1 + eps
+
+        # the whole waterfall survives the JSON tree and the Chrome
+        # export with a DISTINCT process row
+        tree = client_tr.block(7)
+        procs = {
+            ch.get("proc") for ch in tree["children"]
+        }
+        assert "sidecar" in procs
+        events = client_tr.chrome_events()
+        pnames = {
+            e["pid"]: e["args"]["name"] for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        assert "sidecar" in pnames.values() and "local" in pnames.values()
+        sidecar_pid = next(p for p, n in pnames.items() if n == "sidecar")
+        remote_evs = [e for e in events if e.get("ph") == "X"
+                      and e.get("pid") == sidecar_pid]
+        assert {e["name"] for e in remote_evs} >= {
+            "sidecar_request", "queue_wait", "dispatch"
+        }
+        # remote events carry the PEER block number, so Perfetto (and
+        # traceview) group the full cross-process waterfall per block
+        assert all(e["args"]["block"] == 7 for e in remote_evs)
+    finally:
+        link.close()
+        loop_thread.run(srv.stop())
+
+
+def test_no_trace_context_no_remote_payload(loop_thread):
+    """A submit with no current span (no block in flight) must not
+    grow response frames — the remote field is opt-in per request."""
+    from fabric_tpu.observe import Tracer
+
+    srv = make_server(loop_thread, tracer=Tracer(ring_blocks=8,
+                                                 slow_factor=0))
+    link = make_link(srv, tracer=Tracer(ring_blocks=8, slow_factor=0))
+    try:
+        assert link.submit([(1, 1, 0, 0, 0)]).fetch() == [True]
+    finally:
+        link.close()
+        loop_thread.run(srv.stop())
+
+
+def test_wire_trace_header_roundtrip():
+    t = [(1, 1, 0, 0, 0)]
+    trace = {"block": 9, "root": 42, "tenant": "chanX"}
+    hdr, items = wire.decode_request(wire.encode_request(3, t, trace))
+    assert hdr["trace"] == trace and items == t
+    hdr, _ = wire.decode_request(wire.encode_request(4, t))
+    assert "trace" not in hdr
+    remote = {"spans": {"name": "block"}, "t_rx": 1.0, "t_tx": 2.0}
+    hdr, v = wire.decode_response(
+        wire.encode_response(3, [True], remote=remote)
+    )
+    assert hdr["remote"] == remote and v == [True]
+
+
+def test_sidecar_requests_get_their_own_ring(loop_thread):
+    """The satellite collision fix: a colocated server sharing the
+    peer's tracer must neither evict peer block trees with its
+    request trees nor shadow block numbers at block()/trace?block=N."""
+    from fabric_tpu.observe import Tracer
+
+    tr = Tracer(ring_blocks=4, slow_factor=0)
+    # peer blocks 0..3 fill the default ring
+    for n in range(4):
+        tr.finish_block(tr.begin_block(n, channel="chanA"))
+    srv = make_server(loop_thread, tracer=tr)
+    link = make_link(srv, tenant="chanA", tracer=tr)
+    try:
+        # a storm of MORE requests than the ring holds
+        for i in range(8):
+            assert link.submit([(i, 1, 0, 0, 0)]).fetch() == [True]
+    finally:
+        link.close()
+        loop_thread.run(srv.stop())
+    # peer trees all survived the request storm
+    assert [b["block"] for b in tr.blocks()] == [0, 1, 2, 3]
+    # request trees live in their own namespace, ids never colliding
+    # with peer block numbers
+    reqs = tr.blocks(ns="sidecar")
+    assert len(reqs) == 4  # ring-bounded, evicting only each other
+    assert [b["block"] for b in reqs] == [5, 6, 7, 8]
+    # block 2 resolves to the PEER tree; request 2 was evicted from
+    # its own ring without touching it
+    assert tr.block(2)["attrs"]["channel"] == "chanA"
+    assert tr.block(2, ns="sidecar") is None
+    assert tr.block(6, ns="sidecar")["attrs"]["channel"] == "sidecar:chanA"
+    assert tr.namespaces() == {"": 4, "sidecar": 4}
+
+
+def test_scheduler_telemetry_queue_age_deficit_busy():
+    reg = Registry()
+    s = WeightedScheduler(queue_limit=2, quantum=4, registry=reg)
+    s.register("a", 1.0)
+    s.submit(Request("a", 0, [0]))
+    s.submit(Request("a", 1, [0]))
+    assert not s.submit(Request("a", 2, [0]))  # BUSY
+    assert reg.counter("sidecar_busy_total").value(tenant="a") == 1
+    time.sleep(0.01)
+    batch = s.next_batch(4)
+    assert len(batch) == 2
+    age = reg.metric("sidecar_queue_age_seconds").value(tenant="a")
+    assert age["count"] == 2 and age["sum"] > 0.0
+    st = s.stats()["a"]
+    assert st["queue_age_ms"]["n"] == 2
+    assert st["queue_age_ms"]["p99"] >= st["queue_age_ms"]["p50"] > 0.0
+    assert st["busy_rate"] == pytest.approx(1 / 3, abs=1e-4)
+    assert "deficit" in st
+    # ages survive a disconnect + re-register like the other totals
+    s.unregister("a")
+    s.register("a", 1.0)
+    assert s.stats()["a"]["queue_age_ms"]["n"] == 2
+
+
+# -- SLO fast burn under an injected latency fault ---------------------------
+
+
+class _StepClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+def test_slo_burn_trips_under_latency_fault_and_recovers(loop_thread):
+    """The acceptance criterion: a 5x latency fault on
+    sidecar.dispatch drives the request-latency SLO burn ≥ 1; after
+    the fault clears (and the window rolls), burn returns < 1."""
+    from fabric_tpu.observe import Tracer
+    from fabric_tpu.observe.slo import SloEngine, parse_slos
+
+    tr = Tracer(ring_blocks=16, slow_factor=0)
+    clk = _StepClock()
+    eng = SloEngine(
+        parse_slos("req:latency:ms=50:target=0.8:windows=60:fast=0"),
+        clock=clk, registry=Registry(),
+    )
+    tr.add_listener(eng.on_block)
+    srv = make_server(loop_thread, tracer=tr)
+    link = make_link(srv, tenant="chan", tracer=tr)
+
+    from fabric_tpu.opsserver import HealthRegistry, OperationsServer
+
+    ops = loop_thread.run(OperationsServer(
+        port=0, registry=Registry(), health=HealthRegistry(),
+        tracer=tr, slo=eng,
+    ).start())
+
+    def slo_burn():
+        """The operator's view: burn off a live GET /slo."""
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{ops.port}/slo", timeout=10
+        ) as r:
+            rep = json.loads(r.read())
+        (obj,) = rep["objectives"]
+        return obj["channels"]["sidecar:chan"]["burn"]["60s"]
+
+    try:
+        for i in range(5):  # healthy baseline: ~ms round trips
+            assert link.submit([(i, 1, 0, 0, 0)]).fetch() == [True]
+            clk.advance(1.0)
+        assert eng.burn("req", "sidecar:chan") == 0.0
+
+        # 5x the threshold: every dispatch sleeps 250ms > 50ms budget
+        faults.configure("sidecar.dispatch:latency:ms=250")
+        for i in range(4):
+            assert link.submit([(i, 1, 0, 0, 0)]).fetch() == [True]
+            clk.advance(1.0)
+        assert slo_burn() >= 1.0  # /slo reports the burn
+
+        faults.reset()
+        clk.advance(120.0)  # the storm ages out of the window
+        for i in range(5):
+            assert link.submit([(i, 1, 0, 0, 0)]).fetch() == [True]
+            clk.advance(1.0)
+        assert slo_burn() < 1.0  # recovered
+    finally:
+        tr.remove_listener(eng.on_block)
+        link.close()
+        loop_thread.run(ops.stop())
+        loop_thread.run(srv.stop())
+
+
+def test_pct_is_nearest_rank():
+    # round(x + .5) is NOT ceil: banker's rounding sends exact .5
+    # midpoints to the even rank (p50 of 2 samples returned rank 2)
+    from fabric_tpu.sidecar.scheduler import _pct
+
+    assert _pct([1.0, 2.0], 50) == 1.0
+    assert _pct([1.0, 2.0, 3.0, 4.0, 5.0, 6.0], 50) == 3.0
+    assert _pct([1.0, 2.0, 3.0], 99) == 3.0
+    assert _pct([], 50) == 0.0
+
+
+def test_stitch_tolerates_malformed_remote_payload():
+    """The remote tree is trust-boundary metadata: a skewed sidecar
+    shipping garbage must not fail the verify path (which would feed
+    the caller's degrade latch)."""
+    from fabric_tpu.observe import Tracer
+    from fabric_tpu.sidecar.client import SidecarLink
+
+    tr = Tracer(ring_blocks=4, slow_factor=0)
+    link = SidecarLink.__new__(SidecarLink)  # no connection needed
+    link.tracer = tr
+    root = tr.begin_block(1)
+    for bad in (
+        {"spans": "not a tree", "t_rx": 1.0, "t_tx": 2.0},
+        {"spans": {"children": ["not a span"]}, "t_rx": 1.0, "t_tx": 2.0},
+        {"spans": {"name": "x"}, "t_rx": "nan?", "t_tx": None},
+        {"t_rx": 1.0, "t_tx": 2.0},
+        "not a dict",
+    ):
+        link._stitch(root, bad, 0.0, 0.0)  # must not raise
+    # nothing half-stitched leaked into the tree
+    assert [c.name for c in root.children] == []
+
+
+def test_nodeconfig_rejects_bad_slo_spec():
+    from fabric_tpu.nodeconfig import ConfigError, load_peer_config
+
+    base = {"id": "p0", "data_dir": "/tmp/x", "msp_id": "Org1MSP",
+            "msp_dir": "/tmp/msp"}
+    with pytest.raises(ConfigError, match="slos"):
+        load_peer_config({**base, "slos": "req:frobnicate:ms=5"},
+                         environ={})
+    cfg = load_peer_config(
+        {**base, "slos": "req:latency:ms=50;busy:busy:pct=5"},
+        environ={},
+    )
+    assert cfg.slos.startswith("req:")

@@ -1,0 +1,349 @@
+"""SLO burn-rate engine battery (fabric_tpu.observe.slo) — crypto-free:
+spec parsing, rolling-window burn math under an injected clock
+(burn-up under violations, decay back under recovery), the fast-burn
+WARN with its cooldown, the tracer finished-block feed (latency +
+busy kinds, channel scoping), and the /slo endpoint over a live
+OperationsServer."""
+
+import asyncio
+import json
+import logging
+import urllib.request
+
+import pytest
+
+from fabric_tpu.observe import Tracer
+from fabric_tpu.observe.slo import (
+    SloEngine,
+    SloError,
+    parse_slos,
+)
+from fabric_tpu.ops_metrics import Registry
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+def _engine(spec, clock=None, registry=None):
+    return SloEngine(
+        parse_slos(spec), clock=clock or _Clock(),
+        registry=registry or Registry(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# spec parsing
+
+
+class TestParse:
+    def test_latency_and_busy_round_trip(self):
+        objs = parse_slos(
+            "commit:latency:ms=250:target=0.95:windows=30,120:fast=6;"
+            "busy:busy:pct=5"
+        )
+        commit, busy = objs
+        assert commit.name == "commit" and commit.kind == "latency"
+        assert commit.ms == 250.0 and commit.target == 0.95
+        assert commit.windows == (30.0, 120.0) and commit.fast == 6.0
+        assert abs(commit.budget - 0.05) < 1e-9
+        assert busy.kind == "busy"
+        assert abs(busy.target - 0.95) < 1e-9  # 1 - pct/100
+        assert busy.windows == (60.0, 300.0)   # defaults
+
+    def test_empty_spec_is_empty(self):
+        assert parse_slos("") == []
+        assert parse_slos(" ; ") == []
+
+    def test_channel_filter(self):
+        (o,) = parse_slos("t:latency:ms=10:channel=chanA")
+        assert o.channel == "chanA"
+
+    @pytest.mark.parametrize("bad", [
+        "nokind",                        # no kind field
+        "x:frobnicate:ms=5",             # unknown kind
+        "x:latency",                     # latency without ms
+        "x:latency:ms=0",                # non-positive threshold
+        "x:busy",                        # busy without pct
+        "x:busy:pct=0",                  # out-of-range budget
+        "x:busy:pct=100",
+        "x:latency:ms=5:target=1.5",     # target outside (0,1)
+        "x:latency:ms=5:bogus=1",        # unknown key
+        "x:latency:ms=five",             # unparsable value
+        "x:latency:ms=5;x:busy:pct=1",   # duplicate objective name
+        "x:latency:ms=5:windows=0",      # dead window: burn always None
+        "x:latency:ms=5:windows=-5,60",  # negative window
+    ])
+    def test_malformed_specs_raise(self, bad):
+        with pytest.raises(SloError):
+            parse_slos(bad)
+
+
+# ---------------------------------------------------------------------------
+# burn math
+
+
+class TestBurn:
+    def test_burn_rises_above_one_and_recovers(self):
+        """The acceptance shape: clean traffic sits < 1, a violation
+        storm drives burn ≥ 1, and after recovery (good traffic +
+        window rolloff) it returns < 1."""
+        clk = _Clock()
+        eng = _engine("commit:latency:ms=100:target=0.9:windows=60",
+                      clock=clk)
+        (o,) = eng.objectives
+        for _ in range(20):          # healthy baseline: all good
+            eng.record(o, "chan", good=True)
+            clk.advance(1.0)
+        assert eng.burn("commit", "chan") == 0.0
+        for _ in range(10):          # 5x-latency storm: all bad
+            eng.record(o, "chan", good=False)
+            clk.advance(1.0)
+        burning = eng.burn("commit", "chan")
+        # 10 bad / 30 events in window = 0.33 bad frac / 0.1 budget
+        assert burning >= 1.0
+        for _ in range(55):          # recovery: good traffic returns
+            eng.record(o, "chan", good=True)
+            clk.advance(1.0)
+        # the storm has rolled out of the 60s window entirely
+        assert eng.burn("commit", "chan") < 1.0
+
+    def test_burn_decays_without_new_traffic(self):
+        """Recovery must not require fresh events: burn() recomputes
+        at call time, so a quiet channel's violations age out."""
+        clk = _Clock()
+        eng = _engine("q:latency:ms=1:windows=10", clock=clk)
+        (o,) = eng.objectives
+        eng.record(o, "c", good=False)
+        assert eng.burn("q", "c") > 1.0
+        clk.advance(11.0)
+        assert eng.burn("q", "c") is None  # window empty again
+
+    def test_no_traffic_is_not_a_violation(self):
+        eng = _engine("q:latency:ms=1")
+        assert eng.burn("q", "nochan") is None
+        rep = eng.report()
+        assert rep["objectives"][0]["channels"] == {}
+
+    def test_windows_are_independent(self):
+        clk = _Clock()
+        eng = _engine("q:latency:ms=1:target=0.9:windows=10,100",
+                      clock=clk)
+        (o,) = eng.objectives
+        eng.record(o, "c", good=False)
+        clk.advance(20.0)            # past the fast window only
+        for _ in range(9):
+            eng.record(o, "c", good=True)
+        assert eng.burn("q", "c", window=10) == 0.0
+        assert eng.burn("q", "c", window=100) == pytest.approx(1.0)
+
+    def test_burn_gauge_exported(self):
+        reg = Registry()
+        clk = _Clock()
+        eng = SloEngine(parse_slos("q:latency:ms=1:windows=60"),
+                        clock=clk, registry=reg)
+        (o,) = eng.objectives
+        eng.record(o, "c", good=False)
+        g = reg.gauge("slo_burn_rate")
+        assert g.value(slo="q", window="60s", channel="c") > 1.0
+
+    def test_burn_gauge_decays_on_report_without_traffic(self):
+        """The scrape path must not freeze a burning gauge after a
+        channel's traffic stops — report() refreshes it as the window
+        rolls."""
+        reg = Registry()
+        clk = _Clock()
+        eng = SloEngine(parse_slos("q:latency:ms=1:windows=60"),
+                        clock=clk, registry=reg)
+        (o,) = eng.objectives
+        eng.record(o, "c", good=False)
+        g = reg.gauge("slo_burn_rate")
+        assert g.value(slo="q", window="60s", channel="c") > 1.0
+        clk.advance(120.0)  # the incident ages out; NO new events
+        eng.report()        # what /slo (and a scraper hook) drives
+        assert g.value(slo="q", window="60s", channel="c") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# fast burn
+
+
+class TestFastBurn:
+    def test_warn_fires_once_per_window(self, caplog):
+        clk = _Clock()
+        reg = Registry()
+        eng = SloEngine(
+            parse_slos("q:latency:ms=1:target=0.9:windows=30:fast=2"),
+            clock=clk, registry=reg,
+        )
+        (o,) = eng.objectives
+        with caplog.at_level(logging.WARNING,
+                             logger="fabric_tpu.observe.slo"):
+            for _ in range(10):
+                eng.record(o, "c", good=False)
+                clk.advance(0.5)
+        warns = [r for r in caplog.records if "fast burn" in r.getMessage()]
+        assert len(warns) == 1  # cooldown: one WARN per window
+        assert "q" in warns[0].getMessage()
+        assert reg.counter("slo_fast_burn_total").value(
+            slo="q", channel="c"
+        ) == 1
+        # the cooldown expires with the window
+        clk.advance(31.0)
+        with caplog.at_level(logging.WARNING,
+                             logger="fabric_tpu.observe.slo"):
+            eng.record(o, "c", good=False)
+        assert reg.counter("slo_fast_burn_total").value(
+            slo="q", channel="c"
+        ) == 2
+
+    def test_fast_zero_disables_warn(self, caplog):
+        clk = _Clock()
+        eng = _engine("q:latency:ms=1:fast=0", clock=clk)
+        (o,) = eng.objectives
+        with caplog.at_level(logging.WARNING,
+                             logger="fabric_tpu.observe.slo"):
+            for _ in range(20):
+                eng.record(o, "c", good=False)
+        assert not [r for r in caplog.records
+                    if "fast burn" in r.getMessage()]
+
+
+# ---------------------------------------------------------------------------
+# the tracer feed
+
+
+def _finish(tr, number, dur_s, ns="", **attrs):
+    root = tr.begin_block(number, ns=ns, **attrs)
+    root.t1 = root.t0 + dur_s
+    tr.finish_block(root)
+    return root
+
+
+class TestTracerFeed:
+    def test_latency_kind_classifies_block_durations(self):
+        tr = Tracer(ring_blocks=8, slow_factor=0)
+        eng = _engine("commit:latency:ms=50:target=0.5:windows=60")
+        tr.add_listener(eng.on_block)
+        _finish(tr, 0, 0.010, channel="chanA")   # good
+        _finish(tr, 1, 0.200, channel="chanA")   # bad
+        _finish(tr, 2, 0.300, channel="chanB")   # bad, other channel
+        rep = eng.report()
+        chans = rep["objectives"][0]["channels"]
+        assert chans["chanA"]["events"] == 2
+        assert chans["chanA"]["bad"] == 1
+        assert chans["chanB"]["events"] == 1
+        assert chans["chanB"]["bad"] == 1
+
+    def test_busy_kind_counts_only_sidecar_roots(self):
+        tr = Tracer(ring_blocks=8, slow_factor=0)
+        eng = _engine("busy:busy:pct=50:windows=60")
+        tr.add_listener(eng.on_block)
+        _finish(tr, 0, 0.01, channel="chanA")  # peer block: not counted
+        _finish(tr, 1, 0.0, ns="sidecar", channel="sidecar:t0",
+                busy=True)
+        _finish(tr, 2, 0.01, ns="sidecar", channel="sidecar:t0")
+        chans = eng.report()["objectives"][0]["channels"]
+        assert list(chans) == ["sidecar:t0"]
+        assert chans["sidecar:t0"]["events"] == 2
+        assert chans["sidecar:t0"]["bad"] == 1
+
+    def test_busy_roots_are_not_latency_samples(self):
+        tr = Tracer(ring_blocks=8, slow_factor=0)
+        eng = _engine("lat:latency:ms=1000:windows=60")
+        tr.add_listener(eng.on_block)
+        _finish(tr, 1, 0.0, ns="sidecar", channel="sidecar:t0",
+                busy=True)
+        assert eng.report()["objectives"][0]["channels"] == {}
+
+    def test_channel_filter_scopes_the_objective(self):
+        tr = Tracer(ring_blocks=8, slow_factor=0)
+        eng = _engine("a_only:latency:ms=50:channel=chanA:windows=60")
+        tr.add_listener(eng.on_block)
+        _finish(tr, 0, 0.2, channel="chanA")
+        _finish(tr, 1, 0.2, channel="chanB")
+        chans = eng.report()["objectives"][0]["channels"]
+        assert list(chans) == ["chanA"]
+
+    def test_listener_failure_is_contained(self):
+        tr = Tracer(ring_blocks=8, slow_factor=0)
+
+        def broken(root):
+            raise RuntimeError("listener bug")
+
+        tr.add_listener(broken)
+        _finish(tr, 0, 0.01)  # must not raise
+        assert [b["block"] for b in tr.blocks()] == [0]
+        tr.remove_listener(broken)
+        tr.remove_listener(broken)  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# /slo endpoint
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_slo_endpoint_over_live_opsserver():
+    from fabric_tpu.opsserver import HealthRegistry, OperationsServer
+
+    clk = _Clock()
+    eng = _engine("commit:latency:ms=100:target=0.9:windows=60",
+                  clock=clk)
+    (o,) = eng.objectives
+    for i in range(10):
+        eng.record(o, "chanA", good=i % 2 == 0)  # 50% bad → burn 5.0
+
+    async def scenario():
+        srv = await OperationsServer(
+            port=0, registry=Registry(), health=HealthRegistry(),
+            tracer=Tracer(ring_blocks=4, slow_factor=0), slo=eng,
+        ).start()
+        try:
+            loop = asyncio.get_event_loop()
+            st, rep = await loop.run_in_executor(
+                None, _get, srv.port, "/slo"
+            )
+            assert st == 200
+            (obj,) = rep["objectives"]
+            assert obj["name"] == "commit" and obj["ms"] == 100.0
+            ch = obj["channels"]["chanA"]
+            assert ch["events"] == 10 and ch["bad"] == 5
+            assert ch["burn"]["60s"] == pytest.approx(5.0)
+            assert ch["status"] == "burning"
+        finally:
+            await srv.stop()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(asyncio.wait_for(scenario(), 30))
+    finally:
+        loop.close()
+
+
+def test_global_configure_attaches_once():
+    from fabric_tpu.observe import slo as slo_mod
+    from fabric_tpu.observe.tracer import global_tracer
+
+    eng = slo_mod.configure("g:latency:ms=999999:windows=60")
+    try:
+        assert eng is slo_mod.global_engine()
+        assert eng.objectives[0].name == "g"
+        n = global_tracer()._listeners.count(eng.on_block)
+        assert n == 1
+        slo_mod.configure("g:latency:ms=999999:windows=60")
+        assert global_tracer()._listeners.count(eng.on_block) == 1
+    finally:
+        slo_mod.configure("")  # disarm for other tests

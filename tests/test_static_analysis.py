@@ -1280,6 +1280,122 @@ class TestBlockingWait:
         assert got == []
 
 
+# -- FT010 unfinished-span ---------------------------------------------------
+
+BAD_SPANS = """\
+def discarded(tracer, block):
+    tracer.begin_block(block.number)
+
+
+def parent_only(self, tracer, block):
+    root = tracer.begin_block(block.number, channel="c")
+    with tracer.span("launch", parent=root):
+        pass
+    tracer.add("state_fill", 0.0, 0.001, parent=root)
+"""
+
+CLEAN_SPANS = """\
+def finished(tracer, block):
+    root = tracer.begin_block(block.number)
+    try:
+        with tracer.span("launch", parent=root):
+            pass
+    finally:
+        tracer.finish_block(root)
+
+
+def escapes_to_call(tracer, scheduler, block):
+    root = tracer.begin_block(block.number)
+    scheduler.submit(Request(root=root))
+
+
+def escapes_to_container(tracer, blocks):
+    roots = []
+    for b in blocks:
+        r = tracer.begin_block(b.number)
+        roots.append(r)
+    return roots
+
+
+def escapes_via_return(tracer, block):
+    root = tracer.begin_block(block.number)
+    return root
+
+
+def truth_test_then_finished(tracer, block):
+    root = tracer.begin_block(block.number)
+    if root is not None:
+        tracer.set_attrs(root, tail=True)
+    tracer.finish_block(root)
+
+
+def finished_in_closure(tracer, executor, block):
+    root = tracer.begin_block(block.number)
+
+    def done():
+        tracer.finish_block(root)
+
+    executor.submit(done)
+
+
+def local_def_never_matches(block):
+    def begin_block(n):
+        return n
+
+    begin_block(block.number)
+"""
+
+
+class TestUnfinishedSpan:
+    def test_flags_discard_and_parent_only(self, tmp_path):
+        from fabric_tpu.analysis.rules.unfinished_span import (
+            UnfinishedSpanRule,
+        )
+
+        got = run_rule(tmp_path, UnfinishedSpanRule(),
+                       {"mod.py": BAD_SPANS})
+        assert [(f.rule, f.line) for f in got] == [
+            ("FT010", 2),   # discarded expression statement
+            ("FT010", 6),   # root only ever a span parent
+        ]
+        assert "flight recorder" in got[0].message
+        assert "finish_block" in got[1].message
+
+    def test_clean_finish_escape_and_shadow(self, tmp_path):
+        from fabric_tpu.analysis.rules.unfinished_span import (
+            UnfinishedSpanRule,
+        )
+
+        got = run_rule(tmp_path, UnfinishedSpanRule(),
+                       {"mod.py": CLEAN_SPANS})
+        assert got == []
+
+    def test_test_code_exempt(self, tmp_path):
+        from fabric_tpu.analysis.rules.unfinished_span import (
+            UnfinishedSpanRule,
+        )
+
+        got = run_rule(tmp_path, UnfinishedSpanRule(), {
+            "test_mod.py": BAD_SPANS,
+            "tests/helper.py": BAD_SPANS,
+            "conftest.py": BAD_SPANS,
+        })
+        assert got == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        from fabric_tpu.analysis.rules.unfinished_span import (
+            UnfinishedSpanRule,
+        )
+
+        src = "\n".join([
+            "def keep(tracer, n):",
+            "    tracer.begin_block(n)  # fabtpu: noqa(FT010)",
+            "",
+        ])
+        got = run_rule(tmp_path, UnfinishedSpanRule(), {"mod.py": src})
+        assert got == []
+
+
 def test_rule_battery_registered():
     from fabric_tpu.analysis import all_rules
 
@@ -1294,4 +1410,5 @@ def test_rule_battery_registered():
         "FT007": "kernel-dtype-mismatch",
         "FT008": "asyncio-task-leak",
         "FT009": "unbounded-blocking-wait",
+        "FT010": "unfinished-span",
     }
