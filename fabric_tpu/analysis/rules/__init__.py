@@ -13,11 +13,13 @@ them); slugs are the human-facing names:
     FT008 asyncio-task-leak      dropped ensure_future/create_task results
     FT009 unbounded-blocking-wait  no-timeout Future/Queue/Event/Thread waits
     FT010 unfinished-span        begin_block roots with no reachable finish
+    FT011 device-buffer-lifetime  packed uploads pinned past their fetch
 """
 
 from fabric_tpu.analysis.rules import (  # noqa: F401
     asyncio_task_leak,
     blocking_wait,
+    device_buffer_lifetime,
     host_sync,
     jit_purity,
     kernel_dtype,

@@ -1,0 +1,289 @@
+"""FT011 device-buffer-lifetime: packed uploads pinned past their fetch.
+
+A packed device upload — ``jax.device_put``, ``parallel.mesh.
+shard_batch``, or an ``ops.p256v3`` packed launch frame
+(``pack_cols`` / ``pack_cols_limbs`` / ``prepare_cols_packed``) — is
+multi-MB per block at production batch sizes.  Binding it to a local
+and leaving that local alive after the consuming fetch/sync pins the
+buffer (device memory for sharded uploads; the host-side H2D source
+either way) until scope exit, which at 3072-lane frames means a whole
+extra frame resident per in-flight block — exactly the ROADMAP's
+"device-memory lifetime (packed uploads outliving their fetch)"
+lever.  The fix is a ``del``, a narrower scope, or handing the buffer
+off instead of keeping it.
+
+Mechanics (strictly under-approximating, per the FT003..FT010
+contract — a finding is always real):
+
+1. **Upload sites** — calls resolved IMPORT-AWARE (the FT003 lesson: a
+   same-named local helper never matches): ``<jax alias>.device_put``
+   or a bare ``device_put`` from-imported from jax;
+   ``shard_batch`` bare-imported from (or attribute-called on an alias
+   of) ``fabric_tpu.parallel.mesh``; ``pack_cols`` /
+   ``pack_cols_limbs`` / ``prepare_cols_packed`` likewise from
+   ``fabric_tpu.ops.p256v3``.
+2. **Lifetime test** — a site is flagged only when ALL of:
+
+   * the result binds a plain local name assigned exactly ONCE in the
+     scope, outside any loop (loop bodies reorder textually — skipped
+     outright), never ``del``-ed;
+   * every Load of the name is a plain consumption (an argument to a
+     call, an expression operand).  A Load inside a ``return`` /
+     ``yield``, stored onto an attribute / subscript / container
+     literal, or aliased to another name ESCAPES — the lifetime is
+     someone else's by design, so the site is skipped;
+   * a sync-family call — an attribute call named ``fetch`` /
+     ``block_until_ready``, or ``jax.device_get`` — appears in the
+     scope lexically AFTER the name's last Load.  From that point the
+     buffer is provably no longer needed, yet the local pins it until
+     scope exit regardless of which path the sync ran on.
+
+3. **Test code is exempt** (``tests/``, ``test_*.py``,
+   ``conftest.py``) — fixtures hold buffers on purpose to compare
+   against.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import (
+    Finding,
+    ModuleCtx,
+    Rule,
+    call_name,
+    register,
+    walk_functions,
+)
+
+#: bare names by source module (from-imports, renames tracked)
+_UPLOADS_BY_MODULE = {
+    "jax": {"device_put"},
+    "fabric_tpu.parallel.mesh": {"shard_batch"},
+    "fabric_tpu.ops.p256v3": {
+        "pack_cols", "pack_cols_limbs", "prepare_cols_packed"
+    },
+}
+#: attribute names valid on an alias of the keyed module
+_UPLOAD_ATTRS = {
+    "jax": {"device_put"},
+    "fabric_tpu.parallel.mesh": {"shard_batch"},
+    "fabric_tpu.ops.p256v3": {
+        "pack_cols", "pack_cols_limbs", "prepare_cols_packed"
+    },
+}
+_SYNC_ATTRS = {"fetch", "block_until_ready"}
+
+
+def _bindings(tree: ast.Module) -> tuple[dict, set, set]:
+    """(module_alias → canonical module, bare upload names,
+    bare/aliased device_get names) over the whole module — imports are
+    commonly function-local in this tree, so the walk is global."""
+    aliases: dict[str, str] = {}
+    bare: set[str] = set()
+    get_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                for mod in _UPLOAD_ATTRS:
+                    if a.name == mod or (
+                        mod == "jax" and a.name.startswith("jax.")
+                    ):
+                        aliases[a.asname or a.name.split(".")[0]] = (
+                            "jax" if a.name.startswith("jax") else mod
+                        )
+                # plain `import fabric_tpu.ops.p256v3 as v3` etc.
+                if a.name in _UPLOAD_ATTRS:
+                    aliases[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for canon, names in _UPLOADS_BY_MODULE.items():
+                # suffix match covers relative/abbreviated forms
+                # (`from fabric_tpu.ops import p256v3`, `from ..ops
+                # import p256v3` import the MODULE — handled via
+                # aliases below; `from ...p256v3 import pack_cols`
+                # binds the bare name)
+                if mod == canon or canon.endswith("." + mod) or (
+                    mod and canon.split(".")[-1] == mod.split(".")[-1]
+                ):
+                    for a in node.names:
+                        if a.name in names:
+                            bare.add(a.asname or a.name)
+            if mod.split(".")[0] == "jax":
+                for a in node.names:
+                    if a.name == "device_get":
+                        get_names.add(a.asname or a.name)
+            # `from fabric_tpu.ops import p256v3 [as v3]` — module
+            # object bound as a name: record as an alias
+            for a in node.names:
+                for canon in _UPLOAD_ATTRS:
+                    if canon == (f"{mod}.{a.name}" if mod else a.name) \
+                            or canon.endswith("." + a.name) and (
+                                not mod or canon.startswith(mod)):
+                        aliases[a.asname or a.name] = canon
+    return aliases, bare, get_names
+
+
+def _is_upload_call(node: ast.AST, aliases: dict, bare: set) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    if name is None:
+        return False
+    parts = name.split(".")
+    if len(parts) == 1:
+        return parts[0] in bare
+    if len(parts) == 2 and parts[0] in aliases:
+        return parts[1] in _UPLOAD_ATTRS[aliases[parts[0]]]
+    return False
+
+
+def _is_sync_call(node: ast.Call, aliases: dict, get_names: set) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _SYNC_ATTRS:
+        return True
+    name = call_name(node)
+    if name is None:
+        return False
+    parts = name.split(".")
+    if len(parts) == 1:
+        return parts[0] in get_names
+    return (len(parts) == 2 and aliases.get(parts[0]) == "jax"
+            and parts[1] == "device_get")
+
+
+def _walk_own(scope: ast.AST, *, skip_loops: bool = False):
+    """A scope's OWN nodes; nested defs are their own scopes.  With
+    ``skip_loops``, loop bodies are not descended into (textual order
+    is meaningless across iterations)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if skip_loops and isinstance(node, (ast.For, ast.AsyncFor,
+                                            ast.While)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _load_profile(scope: ast.AST, name: str) -> tuple[int, bool, int]:
+    """(last_load_line, escaped, n_stores) over the scope's subtree
+    (closures included — a closure keeping the buffer is an escape)."""
+    parents: dict[int, ast.AST] = {}
+    for node in ast.walk(scope):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    last = -1
+    escaped = False
+    stores = 0
+    for node in ast.walk(scope):
+        if not (isinstance(node, ast.Name) and node.id == name):
+            continue
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            stores += 1
+            continue
+        last = max(last, node.lineno)
+        # walk up: a Load under Return/Yield escapes; rhs of an
+        # aliasing Assign / element of a container literal / value of
+        # an attribute-or-subscript store escapes; a call ARGUMENT is
+        # plain consumption
+        cur: ast.AST = node
+        while True:
+            parent = parents.get(id(cur))
+            if parent is None:
+                break
+            if isinstance(parent, (ast.Return, ast.Yield,
+                                   ast.YieldFrom)):
+                escaped = True
+                break
+            if isinstance(parent, (ast.List, ast.Tuple, ast.Set,
+                                   ast.Dict)):
+                escaped = True
+                break
+            if isinstance(parent, ast.Assign) and cur is parent.value:
+                escaped = True  # aliased or stored somewhere durable
+                break
+            if isinstance(parent, ast.Call) and cur is not parent.func:
+                # an argument to a METHOD call (frames.append(v),
+                # scheduler.submit(v)) may be retained by the receiver
+                # — escape; a plain-name call (kern(v), fn(v)) is the
+                # dispatch-consumption shape
+                if isinstance(parent.func, ast.Attribute):
+                    escaped = True
+                break
+            if isinstance(parent, ast.stmt):
+                break
+            cur = parent
+    return last, escaped, stores
+
+
+@register
+class DeviceBufferLifetimeRule(Rule):
+    id = "FT011"
+    name = "device-buffer-lifetime"
+    severity = "warning"
+    description = (
+        "flags packed device uploads (device_put / shard_batch / "
+        "pack_cols-family frames) bound to locals that stay alive "
+        "past the consuming fetch/sync — the local pins a multi-MB "
+        "buffer until scope exit; del it or narrow the scope"
+    )
+
+    def check_module(self, ctx: ModuleCtx) -> list[Finding]:
+        rel = ctx.relpath
+        base = rel.rsplit("/", 1)[-1]
+        if ("tests/" in rel or rel.startswith("tests")
+                or base.startswith("test_") or base == "conftest.py"):
+            return []
+        aliases, bare, get_names = _bindings(ctx.tree)
+        if not aliases and not bare:
+            return []
+        out: list[Finding] = []
+        scopes = [ctx.tree] + list(walk_functions(ctx.tree))
+        for scope in scopes:
+            # locally-defined names shadow the imports (the FT003
+            # lesson: a nested `def pack_cols` must never match)
+            shadowed = {
+                n.name for n in ast.walk(scope)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not scope
+            }
+            my_bare = bare - shadowed
+            # sync sites anywhere in the scope's own statements
+            sync_lines = [
+                n.lineno for n in _walk_own(scope)
+                if isinstance(n, ast.Call)
+                and _is_sync_call(n, aliases, get_names)
+            ]
+            if not sync_lines:
+                continue
+            last_sync = max(sync_lines)
+            for node in _walk_own(scope, skip_loops=True):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and _is_upload_call(node.value, aliases,
+                                            my_bare)):
+                    continue
+                tgt = node.targets[0].id
+                last_load, escaped, stores = _load_profile(scope, tgt)
+                # stores == 1: exactly the binding itself — a rebind
+                # or del elsewhere manages the lifetime already
+                if escaped or stores != 1 or last_load < 0:
+                    continue
+                if last_sync > last_load:
+                    out.append(self.finding(
+                        ctx, node.lineno, node.col_offset,
+                        f"'{tgt}' binds a packed device upload whose "
+                        f"last use is on line {last_load}, but the "
+                        f"scope syncs results afterwards (line "
+                        f"{last_sync}) and '{tgt}' stays alive to "
+                        "scope exit — at production batch sizes that "
+                        "pins a multi-MB frame (and its device copy) "
+                        "a whole extra block; del it after its "
+                        "dispatch or narrow its scope",
+                    ))
+        return out

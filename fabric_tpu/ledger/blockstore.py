@@ -49,6 +49,7 @@ class BlockStore:
         self.group_max_lag_s = group_max_lag_s
         self._unsynced = 0
         self._oldest_unsynced: float | None = None
+        self._fsync_ctr = None  # lazy blockstore_fsync_total counter
         os.makedirs(dirpath, exist_ok=True)
         self._idx = sqlite3.connect(
             os.path.join(dirpath, "index.db"), check_same_thread=False
@@ -190,6 +191,28 @@ class BlockStore:
     # -- public API --------------------------------------------------------
 
     @property
+    def unsynced(self) -> int:
+        """Blocks appended since the last fsync — the open group-commit
+        window's depth (0 = everything durable)."""
+        return self._unsynced
+
+    def _count_fsync(self, trigger: str) -> None:
+        """``blockstore_fsync_total{trigger}``: how each fsync window
+        closed — ``group`` (window filled), ``lag`` (max-lag bound),
+        ``forced`` (explicit sync(): barrier/tail/ack/close).  Under
+        the deep-pipelined committer's deferred syncs this is the
+        number that shows the fsync batching actually engaging."""
+        ctr = self._fsync_ctr
+        if ctr is None:
+            from fabric_tpu.ops_metrics import global_registry
+
+            ctr = self._fsync_ctr = global_registry().counter(
+                "blockstore_fsync_total",
+                "segment fsyncs by closing trigger",
+            )
+        ctr.add(1, trigger=trigger)
+
+    @property
     def height(self) -> int:
         row = self._idx.execute("SELECT MAX(num) FROM blocks").fetchone()
         if row[0] is not None:
@@ -301,6 +324,10 @@ class BlockStore:
             # and _recover must truncate the torn tail; after = the
             # window is durable) and assert replay to a consistent
             # height on reopen
+            self._count_fsync(
+                "group" if self._unsynced >= self.group_commit
+                else "lag"
+            )
             _faults.fire("ledger.fsync.before")
             os.fsync(self._fh.fileno())
             _faults.fire("ledger.fsync.after")
@@ -356,6 +383,7 @@ class BlockStore:
     def sync(self) -> None:
         """Force-fsync any group-commit window still open."""
         if self._unsynced:
+            self._count_fsync("forced")
             self._fh.flush()
             _faults.fire("ledger.fsync.before")
             os.fsync(self._fh.fileno())

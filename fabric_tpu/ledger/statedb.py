@@ -54,6 +54,34 @@ class UpdateBatch:
     def items(self):
         return self.updates.items()
 
+    @classmethod
+    def merged(cls, batches):
+        """One overlay view over a CHAIN of in-flight predecessor
+        batches, oldest first — the depth-N commit pipeline's launch
+        overlay (peer/pipeline.py).  Key resolution is newest-wins
+        (``dict.update`` in chain order: exactly the value the LAST
+        in-flight apply will land, so an overridden read equals a
+        serialized read), ``has_meta`` is the union (a key-metadata
+        write anywhere in the window must keep the successor's SBE
+        machinery engaged), and iteration covers every key any
+        predecessor touched (the lifecycle-write veto and range
+        re-execution walk the whole window).
+
+        Returns None for an empty chain and the batch ITSELF for a
+        singleton — the depth-2 fast path stays pointer-identical to
+        the single-overlay behavior every existing test pins."""
+        batches = [b for b in batches if b is not None]
+        if not batches:
+            return None
+        if len(batches) == 1:
+            return batches[0]
+        out = cls()
+        for b in batches:
+            out.updates.update(b.updates)
+            if b.has_meta:
+                out.has_meta = True
+        return out
+
 
 class VersionedDB:
     """SPI (statedb.go:36-76)."""
@@ -83,6 +111,28 @@ class VersionedDB:
             if v is not None:
                 out[(ns, key)] = v
         return out
+
+    def get_versions_cols(self, keys: list[tuple[str, str]]):
+        """Column form of :meth:`get_versions_bulk` for the validator's
+        ``state_fill`` hot path: → ``(present [U] bool, vers [U, 2]
+        uint32)`` numpy arrays positionally aligned with ``keys``.  The
+        dict round-trip of ``get_versions_bulk`` (build a dict, then
+        re-walk every key to probe it) cost a second Python pass over
+        every unique read key per block; backends override this with a
+        single fused pass."""
+        import numpy as np
+
+        U = len(keys)
+        present = np.zeros(U, bool)
+        vers = np.zeros((U, 2), np.uint32)
+        got = self.get_versions_bulk(keys)
+        if got:
+            for i, k in enumerate(keys):
+                v = got.get(k)
+                if v is not None:
+                    present[i] = True
+                    vers[i] = v
+        return present, vers
 
     def iter_all(self):
         """Yield ((ns, key), VersionedValue) over the WHOLE state in
@@ -131,6 +181,23 @@ class MemVersionedDB(VersionedDB):
 
     def get_state(self, ns, key):
         return self._data.get((ns, key))  # dict.get is atomic under the GIL
+
+    def get_versions_cols(self, keys):
+        """Single fused pass (no intermediate dict): each lookup is one
+        GIL-atomic ``dict.get`` — same concurrent-apply semantics as
+        ``get_state``, the validator's overlay handles read ordering."""
+        import numpy as np
+
+        U = len(keys)
+        present = np.zeros(U, bool)
+        vers = np.zeros((U, 2), np.uint32)
+        get = self._data.get
+        for i, k in enumerate(keys):
+            vv = get(k)
+            if vv is not None:
+                present[i] = True
+                vers[i] = vv.version
+        return present, vers
 
     def _sorted_keys(self, ns):
         keys = self._sorted_cache.get(ns)
@@ -252,6 +319,25 @@ class SqliteVersionedDB(VersionedDB):
             if row:
                 out[(ns, key)] = (row[0], row[1])
         return out
+
+    def get_versions_cols(self, keys):
+        """Fused column gather: one cursor, arrays filled in place —
+        no per-key dict churn on the state_fill hot path."""
+        import numpy as np
+
+        U = len(keys)
+        present = np.zeros(U, bool)
+        vers = np.zeros((U, 2), np.uint32)
+        cur = self._conn.cursor()
+        for i, (ns, key) in enumerate(keys):
+            row = cur.execute(
+                "SELECT block, txnum FROM state WHERE ns=? AND key=?",
+                (ns, key),
+            ).fetchone()
+            if row:
+                present[i] = True
+                vers[i] = row
+        return present, vers
 
     def iter_all(self):
         q = ("SELECT ns, key, value, metadata, block, txnum FROM state "

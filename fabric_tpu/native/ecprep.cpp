@@ -161,6 +161,27 @@ static void windows_of(const U256& v, int32_t* out) {
   }
 }
 
+// int16 window digits for the packed launch frame (same layout)
+static void windows16_of(const U256& v, int16_t* out) {
+  for (int i = 0; i < 32; i++) {
+    int byte = 31 - i;
+    uint64_t b = (v.w[byte / 8] >> (8 * (byte % 8))) & 0xff;
+    out[2 * i] = (int16_t)(b >> 4);
+    out[2 * i + 1] = (int16_t)(b & 0xf);
+  }
+}
+
+// 16 BIG-endian 16-bit limbs (matches p256v3._limbs16 /
+// windows_to_limbs: limb j carries window digits 4j..4j+3 MSB-first)
+static void limbs16_of(const U256& v, int16_t* out) {
+  for (int i = 0; i < 16; i++) {
+    int byte_hi = 31 - 2 * i;  // big-endian byte pair
+    uint64_t hi = (v.w[byte_hi / 8] >> (8 * (byte_hi % 8))) & 0xff;
+    uint64_t lo = (v.w[(byte_hi - 1) / 8] >> (8 * ((byte_hi - 1) % 8))) & 0xff;
+    out[i] = (int16_t)((hi << 8) | lo);
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -212,6 +233,67 @@ void ec_prepare(const uint8_t* e_b, const uint8_t* r_b, const uint8_t* s_b,
     U256 u2 = M.mul(r, sinv_m);
     windows_of(u1, w1 + 64 * i);
     windows_of(u2, w2 + 64 * i);
+  }
+  delete[] s_hat;
+  delete[] pref;
+}
+
+// Strided int16 variant for the single-pass packed staging path
+// (ops/p256v3.prepare_cols_packed): the window planes land DIRECTLY in
+// the caller's int16 launch frame — row i of w1/w2 is written at
+// w1 + i*stride (stride in int16 ELEMENTS, i.e. the frame's full row
+// width), so no intermediate int32 digit arrays and no second
+// pack-copy exist at all.  ``limb_mode`` != 0 emits 16 big-endian
+// 16-bit limbs per row (the recode-on-device wire form, identical to
+// windows_to_limbs(host windows)); 0 emits the 64 int16 window
+// digits.  Admission/rpn flags are byte-identical to ec_prepare.
+void ec_prepare_pack(const uint8_t* e_b, const uint8_t* r_b,
+                     const uint8_t* s_b, int64_t B, int16_t* w1,
+                     int16_t* w2, int64_t stride, int32_t limb_mode,
+                     uint8_t* flags) {
+  if (B <= 0) return;
+  static const Mont M = [] { Mont m; m.init(ORDER_N); return m; }();
+
+  U256 half_n;
+  for (int i = 0; i < 4; i++)
+    half_n.w[i] = (ORDER_N.w[i] >> 1) |
+                  (i < 3 ? (ORDER_N.w[i + 1] << 63) : 0);
+  U256 p_minus_n;
+  sub(p_minus_n, PRIME_P, ORDER_N);
+
+  U256* s_hat = new U256[B];
+  U256* pref = new U256[B + 1];
+  U256 one_m = M.to_mont(U256{{1, 0, 0, 0}});
+
+  for (int64_t i = 0; i < B; i++) {
+    U256 r = load_be(r_b + 32 * i);
+    U256 s = load_be(s_b + 32 * i);
+    bool r_ok = !is_zero(r) && cmp(r, ORDER_N) < 0;
+    bool s_ok = !is_zero(s) && cmp(s, half_n) <= 0;
+    bool s_invertible = !is_zero(s) && cmp(s, ORDER_N) < 0;
+    uint8_t f = (r_ok && s_ok) ? 1 : 0;
+    if (cmp(r, p_minus_n) < 0) f |= 2;
+    flags[i] = f;
+    s_hat[i] = M.to_mont(s_invertible ? s : U256{{1, 0, 0, 0}});
+  }
+
+  pref[0] = one_m;
+  for (int64_t i = 0; i < B; i++) pref[i + 1] = M.mul(pref[i], s_hat[i]);
+  U256 inv_all = M.inv_mont(pref[B]);
+  for (int64_t i = B - 1; i >= 0; i--) {
+    U256 sinv_m = M.mul(pref[i], inv_all);
+    inv_all = M.mul(inv_all, s_hat[i]);
+    U256 e = load_be(e_b + 32 * i);
+    U256 r = load_be(r_b + 32 * i);
+    U256 u1 = M.mul(e, sinv_m);
+    U256 u2 = M.mul(r, sinv_m);
+    if (limb_mode) {
+      limbs16_of(u1, w1 + stride * i);
+      limbs16_of(u2, w2 + stride * i);
+    } else {
+      windows16_of(u1, w1 + stride * i);
+      windows16_of(u2, w2 + stride * i);
+    }
   }
   delete[] s_hat;
   delete[] pref;

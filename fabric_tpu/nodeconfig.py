@@ -99,8 +99,14 @@ class PeerConfig:
     deliver_censorship_check_s: float = 2.0
     # commit pipeline (peer/pipeline.py CommitPipeline): depth 2 =
     # deliver prefetch + committer-thread overlap with the predecessor
-    # batch as a launch overlay; 1 = strict serial launch→finish→commit
-    # per block (the correctness oracle)
+    # batch as a launch overlay; N >= 3 = deep window (block n on
+    # device while n-1 commits and n-2 fsyncs — up to N-1 in-flight
+    # predecessors, their batches MERGED into the launch overlay, the
+    # dup-txid window widened to all of them, and mid-window fsyncs
+    # deferred to the blockstore's group commit); 1 = strict serial
+    # launch→finish→commit per block (the correctness oracle).  Depth
+    # 3+ needs a real accelerator to win — the default stays 2 so
+    # CPU-only hosts keep the exact classic path.
     pipeline_depth: int = 2
     # signature-verify microbatch: signatures per device chunk with
     # double-buffered dispatch (ops/p256v3.py); 0 = one monolithic
@@ -438,6 +444,11 @@ def _load(cls, source, environ=None):
             )
         if len(tmiss) == 3:
             cfg.tls = None  # an all-empty section means no TLS
+    if isinstance(cfg, PeerConfig) and cfg.pipeline_depth < 1:
+        raise ConfigError(
+            f"key 'pipeline_depth': must be >= 1 (1 = serial, 2 = "
+            f"classic overlap, N = deep window), got {cfg.pipeline_depth}"
+        )
     if isinstance(cfg, PeerConfig) and cfg.host_stage_mode not in (
             "thread", "process"):
         raise ConfigError(

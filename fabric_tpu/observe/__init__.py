@@ -1,6 +1,12 @@
-"""fabric_tpu.observe — block-commit span tracing (tracer.py) and the
-latency/error SLO burn-rate engine (slo.py)."""
+"""fabric_tpu.observe — block-commit span tracing (tracer.py), the
+latency/error SLO burn-rate engine (slo.py), and the pipeline
+overlap-coverage analyzer (overlap.py)."""
 
+from fabric_tpu.observe.overlap import (  # noqa: F401
+    coverage_from_roots,
+    coverage_from_spans,
+    coverage_from_trace_dump,
+)
 from fabric_tpu.observe.tracer import (  # noqa: F401
     DEFAULT_RING_BLOCKS,
     DEFAULT_SLOW_FACTOR,

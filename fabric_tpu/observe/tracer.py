@@ -372,10 +372,22 @@ class Tracer:
             roots = list(self._slow)
         return [self._root_dict(r) for r in roots]
 
+    def recent_roots(self, ns: str = "") -> list:
+        """The flight recorder's live Span roots (oldest first) — the
+        overlap-coverage analyzer (observe/overlap.py) walks these
+        directly; the trees are finished, so reading them lock-free
+        after the snapshot copy is safe."""
+        with self._lock:
+            return list(self._rings.get(ns, ()))
+
     @staticmethod
     def _root_dict(root) -> dict:
         d = root.to_dict(root.t0)
         d["block"] = root.attrs.get("block")
+        # absolute perf_counter base: start_ms values are per-block
+        # relative, and cross-BLOCK consumers (overlap coverage) need
+        # a common timeline to compare neighbors on
+        d["t0_s"] = root.t0
         return d
 
     # -- Chrome trace-event export -----------------------------------------

@@ -835,6 +835,133 @@ def _packed_kernel(recode_device: bool):
             else verify_batch_packed_jit)
 
 
+def prepare_cols_packed(digest_b, r_b, s_b, qx_res, qy_res, pub_ok,
+                        pad_to: int | None = None,
+                        recode_device: bool = False,
+                        out=None) -> np.ndarray:
+    """Single-pass host staging STRAIGHT into the packed int16 launch
+    frame — ``pack_cols(prepare_cols(...))`` collapsed into one pass.
+
+    The two-phase form allocates eight full-size staging arrays, fills
+    them, and then copies every plane AGAIN into the int16 frame; this
+    writes each plane exactly once:
+
+    * the native ``ec_prepare_pack`` emits the window digit (or limb)
+      planes int16 and STRIDED, directly into the frame's window
+      columns (no int32 digit temps, no pack copy),
+    * the residue dgemm lands in one int32 scratch that casts straight
+      into the frame's r/rpn columns,
+    * qx/qy/flags are single cast-assignments.
+
+    Byte-identical to ``pack_cols(prepare_cols(...))`` /
+    ``pack_cols_limbs(...)`` — pinned by tests/test_p256v3.py — and
+    ~2× less memory traffic per staged batch, which is most of what
+    the serial ``sig_prepare_launch`` stage still paid in host cycles.
+    ``out``: optional preallocated [Bp, cols] C-contiguous int16 frame
+    (reused across blocks by callers that want zero allocation)."""
+    import ctypes
+
+    B0 = len(r_b)
+    R = _PK_R
+    wcols = _PK_LIMBS if recode_device else STEPS
+    ncols = _PKL_COLS if recode_device else _PK_COLS
+    Bp = pad_to if pad_to is not None else max(B0, 1)
+    if out is not None:
+        frame = out
+        if (frame.shape != (Bp, ncols) or frame.dtype != np.int16
+                or not frame.flags.c_contiguous):
+            raise ValueError(
+                f"out must be a C-contiguous int16 [{Bp}, {ncols}] "
+                f"frame, got {frame.dtype} {frame.shape}"
+            )
+    else:
+        frame = np.empty((Bp, ncols), np.int16)
+    if Bp != B0:
+        frame[B0:] = 0  # pad tail: all-zero always-rejected lanes
+    if not B0:
+        frame[:] = 0
+        return frame
+
+    o_w1 = 4 * R
+    o_w2 = o_w1 + wcols
+    o_rpn_ok = o_w2 + wcols
+
+    eb = np.ascontiguousarray(digest_b)
+    rb = np.ascontiguousarray(r_b)
+    sb = np.ascontiguousarray(s_b)
+    try:
+        from fabric_tpu.native import ecprep_lib
+
+        lib = ecprep_lib()
+    except Exception:
+        lib = None
+    pre_ok = rpn_ok = None
+    if lib is not None and hasattr(lib, "ec_prepare_pack"):
+        flags = np.zeros(B0, np.uint8)
+        ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+        # strided C writes: row i's plane lands at base + i*row_width
+        lib.ec_prepare_pack(
+            ptr(eb), ptr(rb), ptr(sb), ctypes.c_int64(B0),
+            ptr(frame[:B0, o_w1:]), ptr(frame[:B0, o_w2:]),
+            ctypes.c_int64(frame.strides[0] // 2),
+            ctypes.c_int32(1 if recode_device else 0), ptr(flags),
+        )
+        pre_ok = pub_ok & (flags & 1).astype(bool)
+        rpn_ok = (flags & 2).astype(bool)
+    elif lib is not None:
+        # native without the strided symbol (stale cached .so): int32
+        # digit temps + one cast into the frame — still no Python ints
+        flags = np.zeros(B0, np.uint8)
+        w1 = np.zeros((B0, STEPS), np.int32)
+        w2 = np.zeros((B0, STEPS), np.int32)
+        ptr = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+        lib.ec_prepare(ptr(eb), ptr(rb), ptr(sb), ctypes.c_int64(B0),
+                       ptr(w1), ptr(w2), ptr(flags))
+        pre_ok = pub_ok & (flags & 1).astype(bool)
+        rpn_ok = (flags & 2).astype(bool)
+        if recode_device:
+            w1, w2 = windows_to_limbs(w1), windows_to_limbs(w2)
+        frame[:B0, o_w1:o_w2] = w1
+        frame[:B0, o_w2:o_rpn_ok] = w2
+    else:  # pure-Python fallback (no toolchain)
+        ebuf, rbuf, sbuf = eb.tobytes(), rb.tobytes(), sb.tobytes()
+        es = [int.from_bytes(ebuf[32 * i:32 * i + 32], "big")
+              for i in range(B0)]
+        rints = [int.from_bytes(rbuf[32 * i:32 * i + 32], "big")
+                 for i in range(B0)]
+        sints = [int.from_bytes(sbuf[32 * i:32 * i + 32], "big")
+                 for i in range(B0)]
+        pre_ok = np.zeros(B0, bool)
+        rpn_ok = np.zeros(B0, bool)
+        ss = [1] * B0
+        for i, (r, s) in enumerate(zip(rints, sints)):
+            pre_ok[i] = bool(pub_ok[i]) and 0 < r < N and 0 < s <= HALF_N
+            rpn_ok[i] = (r + N) < P
+            ss[i] = s if 0 < s < N else 1
+        s_inv = _batch_inv_mod_n(ss)
+        u1s = [(e * si) % N for e, si in zip(es, s_inv)]
+        u2s = [(r * si) % N for r, si in zip(rints, s_inv)]
+        if recode_device:
+            frame[:B0, o_w1:o_w2] = _limbs16(u1s)
+            frame[:B0, o_w2:o_rpn_ok] = _limbs16(u2s)
+        else:
+            frame[:B0, o_w1:o_w2] = _windows(u1s)
+            frame[:B0, o_w2:o_rpn_ok] = _windows(u2s)
+
+    frame[:B0, :R] = qx_res
+    frame[:B0, R:2 * R] = qy_res
+    primes = np.array(rns.BASE_A + rns.BASE_B, np.int32)
+    n_res = rns._to_res(N, rns.BASE_A + rns.BASE_B)  # int32 already
+    scratch = rns.bytes_to_rns(rb)  # [B0, R] int32
+    frame[:B0, 2 * R:3 * R] = scratch
+    np.mod(scratch + n_res[None, :], primes, out=scratch)
+    scratch[~rpn_ok] = 0
+    frame[:B0, 3 * R:4 * R] = scratch
+    frame[:B0, o_rpn_ok] = rpn_ok
+    frame[:B0, o_rpn_ok + 1] = pre_ok
+    return frame
+
+
 def _prepare_cols_pooled(cols, pad_to, pool, recode_device: bool = False):
     """``prepare_cols`` sharded over the host staging pool along the
     lane axis at MIN_BUCKET boundaries.  Bit-equal to the serial call:
@@ -962,29 +1089,17 @@ def _shard(mesh, arr):
     return shard_batch(mesh, arr)
 
 
-def _launch_chunked(n_real: int, chunk: int, stage_fn) -> VerifyHandle:
-    """Microbatched double-buffered dispatch: ``stage_fn(lo, hi, pad)``
-    stages [lo:hi) on the host (admission checks, batch inversion,
-    window recoding, residue dgemm) padded to ``pad`` lanes and
-    dispatches it, returning the chunk's device output.
-
-    Every chunk except the last is EXACTLY ``chunk`` lanes and the last
-    pads the total out to ``_bucket(n_real)`` — so item i lives at
-    device index i of the concatenated output (no remapping for
-    stage-2 gathers / creator / endorsement item indices) AND the
-    concatenated length stays in the same bucket family as a
-    monolithic launch, so chunking multiplies neither the tail's
-    verify-kernel shapes nor the fused stage-2 program shapes keyed on
-    it.  Because jax dispatch is asynchronous, staging chunk k+1 on
-    the host overlaps chunk k's device compute instead of accumulating
-    one monolithic ``device_wait`` stall; H2D transfers interleave
-    with compute the same way (classic double-buffered accelerator
-    staging).
-    """
-    stage_hist, chunks_hist = _chunk_metrics()
-    outs = []
+def _chunk_bounds(n_real: int, chunk: int) -> list[tuple[int, int, int]]:
+    """[(lo, hi, pad)] microbatch slicing: every chunk except the last
+    is EXACTLY ``chunk`` lanes and the last pads the total out to
+    ``_bucket(n_real)`` — so item i lives at device index i of the
+    concatenated output (no remapping for stage-2 gathers / creator /
+    endorsement item indices) AND the concatenated length stays in the
+    same bucket family as a monolithic launch, so chunking multiplies
+    neither the tail's verify-kernel shapes nor the fused stage-2
+    program shapes keyed on it."""
+    bounds = []
     off = 0
-    n_chunks = 0
     total = _bucket(n_real)
     while off < n_real:
         k = min(chunk, n_real - off)
@@ -992,57 +1107,115 @@ def _launch_chunked(n_real: int, chunk: int, stage_fn) -> VerifyHandle:
         # tail absorbs all padding (total - off ≥ k since
         # _bucket(n_real) ≥ n_real)
         pad = chunk if off + k < n_real else total - off
+        bounds.append((off, off + k, pad))
+        off += k
+    return bounds
+
+
+def _launch_chunked(n_real: int, chunk: int, stage_fn,
+                    dispatch_fn=None, pool=None) -> VerifyHandle:
+    """Microbatched double-buffered dispatch.
+
+    Legacy form (``dispatch_fn`` None): ``stage_fn(lo, hi, pad)``
+    stages [lo:hi) on the host AND dispatches it, returning the
+    chunk's device output.  Because jax dispatch is asynchronous,
+    staging chunk k+1 on the host overlaps chunk k's device compute —
+    but only AFTER chunk k's H2D and dispatch were issued from the
+    same thread.
+
+    Split form (``dispatch_fn`` given): ``stage_fn(lo, hi, pad)`` is
+    host-only (returns the packed launch frame) and ``dispatch_fn``
+    ships+launches it.  With a host ``pool``, chunk k+1's staging is
+    submitted to a pool worker BEFORE chunk k's dispatch runs on the
+    caller thread — one-chunk lookahead, so chunk k+1's staging
+    genuinely rides under chunk k's H2D + device compute instead of
+    serializing behind the dispatch call (the lookahead worker stages
+    its chunk serially; the parallelism comes from the overlap, which
+    is why the pipelined commit path finally gives the double
+    buffering something to hide).  Without a pool the split form
+    degrades to the legacy serial order — CPU-only hosts unchanged.
+    """
+    stage_hist, chunks_hist = _chunk_metrics()
+    bounds = _chunk_bounds(n_real, chunk)
+    outs = []
+    lookahead = pool is not None and dispatch_fn is not None
+    nxt = (pool.submit(stage_fn, *bounds[0], stage="chunk_stage")
+           if lookahead else None)
+    for i, (lo, hi, pad) in enumerate(bounds):
         t0 = time.perf_counter()
-        out = stage_fn(off, off + k, pad)
+        if dispatch_fn is None:
+            out = stage_fn(lo, hi, pad)
+        else:
+            frame = nxt.result() if lookahead else stage_fn(lo, hi, pad)
+            if lookahead and i + 1 < len(bounds):
+                # stage k+1 NOW — it overlaps chunk k's H2D + dispatch
+                # below and whatever device compute is already queued
+                nxt = pool.submit(stage_fn, *bounds[i + 1],
+                                  stage="chunk_stage")
+            out = dispatch_fn(frame)
         t1 = time.perf_counter()
         stage_hist.observe(t1 - t0, stage="stage_dispatch")
         # per-chunk span on the block timeline (no-op off traced paths)
-        _trc().add("verify_chunk", t0, t1, chunk=n_chunks, lanes=int(k))
+        _trc().add("verify_chunk", t0, t1, chunk=i, lanes=int(hi - lo))
         outs.append(out)
-        off += k
-        n_chunks += 1
-    chunks_hist.observe(n_chunks)
+    chunks_hist.observe(len(bounds))
     dev = jnp.concatenate(outs) if len(outs) > 1 else outs[0]
     if hasattr(dev, "copy_to_host_async"):
         dev.copy_to_host_async()
     return VerifyHandle(dev, n_real)
 
 
-def _stage_prepare(cols, lo, hi, pad, pool, recode_device):
-    """Host staging for rows [lo, hi) of a column set: prepare_cols,
-    sharded over the host pool when one is configured."""
+def _stage_packed(cols, lo, hi, pad, pool, recode_device) -> np.ndarray:
+    """Rows [lo, hi) of a column set → the packed int16 launch frame.
+    Serial staging takes the single-pass ``prepare_cols_packed`` route
+    (no intermediate eight-array allocation, native strided window
+    writes); pooled staging keeps the slab-sharded two-phase form
+    whose workers already write in place."""
     sl = cols if (lo == 0 and hi == len(cols[1])) else tuple(
         c[lo:hi] for c in cols
     )
     if pool is not None:
-        return _prepare_cols_pooled(sl, pad, pool,
+        args = _prepare_cols_pooled(sl, pad, pool,
                                     recode_device=recode_device)
-    return prepare_cols(*sl, pad_to=pad, recode_device=recode_device)
+        return _pack_launch(args, recode_device, pool=pool)
+    return prepare_cols_packed(*sl, pad_to=pad,
+                               recode_device=recode_device)
 
 
 def _launch_cols(n_real, cols, chunk, mesh, pool, recode_device):
-    """Column-form launch: stage (pooled), pack (host or limb wire
-    form), dispatch (sharded), with the H2D frame size observed per
+    """Column-form launch: stage straight into the packed wire frame
+    (single-pass serial path, or slab-sharded over the host pool),
+    dispatch (sharded), with the H2D frame size observed per
     dispatch."""
     kern = _packed_kernel(recode_device)
     rc = "device" if recode_device else "host"
-    if chunk and n_real > chunk:
-        def stage(lo, hi, pad):
-            args = _stage_prepare(cols, lo, hi, pad, pool, recode_device)
-            packed = _pack_launch(args, recode_device, pool=pool)
-            _h2d_hist().observe(packed.nbytes, recode=rc)
-            with _dev_ann("fabtpu.verify_dispatch"):
-                return kern(_shard(mesh, packed))
 
-        return _launch_chunked(n_real, chunk, stage)
-    args = _stage_prepare(cols, 0, n_real, _bucket(n_real), pool,
-                          recode_device)
-    packed = _pack_launch(args, recode_device, pool=pool)
-    _h2d_hist().observe(packed.nbytes, recode=rc)
-    # the TraceAnnotation lines this dispatch up with the XLA timeline
-    # when a jax profiler capture is running (real-TPU rounds)
-    with _dev_ann("fabtpu.verify_dispatch"):
-        out = kern(_shard(mesh, packed))
+    def dispatch(packed):
+        _h2d_hist().observe(packed.nbytes, recode=rc)
+        # the TraceAnnotation lines this dispatch up with the XLA
+        # timeline when a jax profiler capture runs (real-TPU rounds)
+        with _dev_ann("fabtpu.verify_dispatch"):
+            return kern(_shard(mesh, packed))
+
+    if chunk and n_real > chunk:
+        # split stage/dispatch: with a host pool, _launch_chunked
+        # stages chunk k+1 on a worker while chunk k dispatches (the
+        # lookahead overlap the pipelined path needs).  The lookahead
+        # worker may still SHARD its chunk across the pool when there
+        # are ≥ 2 workers (map_slices from inside a worker completes
+        # on the remaining slots); a 1-worker pool would deadlock on
+        # itself, so it stages serially there.
+        inner = pool if (pool is not None
+                         and getattr(pool, "workers", 1) >= 2) else None
+
+        def stage(lo, hi, pad):
+            return _stage_packed(cols, lo, hi, pad, inner, recode_device)
+
+        return _launch_chunked(n_real, chunk, stage, dispatch_fn=dispatch,
+                               pool=pool)
+    packed = _stage_packed(cols, 0, n_real, _bucket(n_real), pool,
+                           recode_device)
+    out = dispatch(packed)
     if hasattr(out, "copy_to_host_async"):
         out.copy_to_host_async()
     return VerifyHandle(out, n_real)

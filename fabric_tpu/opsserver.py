@@ -210,6 +210,20 @@ class OperationsServer:
                 for key, s in sorted(m.snapshot().items())
             }
         ring = self.tracer.blocks(ns=ns)
+        # pipeline overlap coverage over the whole ring: what fraction
+        # of each block's device_wait the k±window neighbors' host
+        # stages actually hid (observe/overlap.py; the deep-pipelining
+        # acceptance number).  ?overlap_window=N matches depth N+1.
+        from fabric_tpu.observe import overlap as _overlap
+
+        try:
+            window = int(q.get("overlap_window", ["2"])[0])
+        except ValueError:
+            window = 2
+        cov = _overlap.coverage_from_roots(
+            self.tracer.recent_roots(ns=ns), window=window
+        )
+        cov.pop("per_block", None)  # the index stays an index
         payload = {
             "enabled": self.tracer.enabled,
             "ring_blocks": self.tracer.ring_blocks,
@@ -218,6 +232,7 @@ class OperationsServer:
             "recent_blocks": ring[-4:],
             "blocks_in_ring": [b.get("block") for b in ring],
             "namespaces": self.tracer.namespaces(),
+            "pipeline_overlap_coverage": cov,
             "summary": summary,
         }
         if ns:

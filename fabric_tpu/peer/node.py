@@ -83,9 +83,12 @@ class PeerChannel:
         self.tracer = observe.global_tracer()
         # commit-path knobs (nodeconfig pipeline_depth / verify_chunk /
         # coalesce_blocks): depth 2 = CommitPipeline overlap on the
-        # deliver loop, 1 = strict serial commit_block per block;
-        # coalesce_blocks ≥ 2 = multi-block verify-dispatch coalescing
-        # over the deliver backlog (CommitPipeline.submit_many)
+        # deliver loop, N ≥ 3 = deep window (merged multi-batch launch
+        # overlays, widened dup-txid window, fsyncs deferred to the
+        # blockstore group commit), 1 = strict serial commit_block per
+        # block; coalesce_blocks ≥ 2 = multi-block verify-dispatch
+        # coalescing over the deliver backlog
+        # (CommitPipeline.submit_many)
         self.pipeline_depth = int(pipeline_depth)
         self.coalesce_blocks = int(coalesce_blocks)
         snap_meta = None
@@ -292,7 +295,7 @@ class PeerChannel:
         return flt
 
     async def _commit_inner(self, block, txs, flt, batch, history,
-                            hd_bytes, root=None) -> None:
+                            hd_bytes, root=None, sync=True) -> None:
         """Validated triple → committed ledger state: pvt-data phase,
         ledger commit + fsync, post-commit bookkeeping.  The caller
         holds the commit writer lock; ``txs`` are the block's parsed
@@ -302,7 +305,19 @@ class PeerChannel:
 
         ``root``: the block's tracer root span, passed EXPLICITLY —
         this coroutine runs on the event-loop thread, where the
-        pipeline committer thread's span attachment cannot follow."""
+        pipeline committer thread's span attachment cannot follow.
+
+        ``sync=False`` — deep-pipelined commits with more of the
+        window in flight behind them (``CommittedBlock.defer_sync``):
+        skip the forced per-block fsync and let the blockstore's
+        group-commit machinery batch the syncs across the pipeline
+        window.  Every barrier/tail/idle-flush commit arrives with
+        sync=True and closes the window, so the durability exposure is
+        bounded by the ``group_commit`` knob (set it to 1 to fsync
+        every add regardless) plus the deliver driver's idle flush; a
+        crash inside the window reopens at the last synced boundary
+        and replays forward (the PR-6 crash-replay story, re-pinned by
+        the windowed-fsync tests)."""
         # pvt phase (StoreBlock, coordinator.go:190-220): cleartext
         # from transient/pull, hash-verified, into pvt namespaces
         from fabric_tpu.peer.transient import encode_kv
@@ -360,9 +375,12 @@ class PeerChannel:
         # open group-commit fsync window closed BEFORE signalling
         # height / commit status, so an acknowledged block can never
         # be lost to a crash on a quiet channel (the add-block-time
-        # lag check only runs while traffic flows)
-        with tracer.span("fsync", parent=root):
-            self.ledger.blocks.sync()
+        # lag check only runs while traffic flows).  Deep-pipelined
+        # mid-window commits (sync=False) defer this to the window's
+        # closing commit — the whole segment file syncs then.
+        if sync:
+            with tracer.span("fsync", parent=root):
+                self.ledger.blocks.sync()
         self._post_commit(block, flt, batch, txs)
 
     def _commit_metrics(self, flt: bytes, validate_s: float,
@@ -408,6 +426,7 @@ class PeerChannel:
             await self._commit_inner(
                 res.block, res.pend.txs, res.tx_filter, res.batch,
                 res.history, res.pend.hd_bytes, root=res.root_span,
+                sync=not getattr(res, "defer_sync", False),
             )
         commit_s = _time.perf_counter() - t0
         # launch + finish ≈ the serial path's validate span, so a
@@ -658,11 +677,14 @@ class PeerChannel:
     PIPELINE_IDLE_FLUSH_S = 0.05
 
     async def _run_deliver_pipelined(self, gen):
-        """Depth-2 deliver commit driver over peer.pipeline: the
+        """Depth-N deliver commit driver over peer.pipeline: the
         production analog of the reference's deliver prefetch +
         committer overlap (gossip/state/state.go:540) — the commit
         path stops paying full launch→finish→commit serialization per
-        block."""
+        block.  At depth ≥ 3 up to N−1 predecessors' commits drain
+        behind the launch under a merged overlay, with mid-window
+        fsyncs deferred to the blockstore's group commit (the idle
+        flush below closes the window on a quiet channel)."""
         from fabric_tpu.peer.pipeline import CommitPipeline
 
         loop = asyncio.get_event_loop()

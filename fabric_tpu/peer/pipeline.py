@@ -1,22 +1,38 @@
-"""The production commit pipeline: depth-2 block overlap as a
+"""The production commit pipeline: depth-N block overlap as a
 reusable subsystem shared by the peer node's deliver loop and bench.py.
 
-Shape (the TPU analog of the reference peer's deliver prefetch +
-committer overlap, gossip/state/state.go:540 + the validator pool,
-v20/validator.go:193):
+Shape at depth 3 (the TPU analog of the reference peer's deliver
+prefetch + committer overlap, gossip/state/state.go:540 + the
+validator pool, v20/validator.go:193):
 
     prefetch thread   preprocess(block n+1)      host parse + async
                                                  device verify launch
     caller thread     validate_finish(block n-1) device sync → filter
-                      validate_launch(block n)   overlay = n-1's batch
-    committer thread  commit(block n-1)          ledger fsync
+                      validate_launch(block n)   overlay = merged
+                                                 batches of n-1, n-2
+    committer thread  commit(block n-1)          ledger commit
+                      commit(block n-2)          …still fsyncing
 
-While block n sits on device and block n-1's ledger commit fsyncs on
-the committer thread, the prefetch thread parses block n+1.  The
-predecessor's UpdateBatch rides along as an *overlay* on block n's
-launch (committed-version fill, range re-execution, dup-txid checks),
-so launch(n) never waits for commit(n-1)'s fsync — the overlay
-equivalence is pinned by tests/test_pipeline.py.
+While block n sits on device and up to ``depth - 1`` predecessors'
+ledger commits drain in order on the committer thread, the prefetch
+thread parses block n+1.  The in-flight predecessors' UpdateBatches
+ride along as a MERGED *overlay* on block n's launch (newest-wins key
+resolution — ``ledger.statedb.UpdateBatch.merged`` — feeding the
+committed-version fill, range re-execution and SBE probes), and the
+duplicate-txid window widens to every in-flight predecessor's txid
+set, so launch(n) never waits for any predecessor's fsync.  Depth 2
+degrades to the classic single-overlay overlap (pointer-identical
+batch, same wait points); the overlay equivalence at every depth is
+pinned by tests/test_pipeline.py and tests/test_commit_pipeline.py.
+
+At depth ≥ 3, commits handed to the committer thread are marked
+``defer_sync``: the ledger's group-commit machinery batches their
+fsyncs across the pipeline window, and the window closes (forced
+sync) at every barrier, stream-idle flush, and tail — the
+crash-replay story is the blockstore's (ledger/blockstore.py
+group_commit): a kill mid-window reopens at the last synced boundary
+and replays forward.  Depth 2 never defers — the default config keeps
+the classic per-block acknowledged-durability fsync exactly.
 
 Lifecycle/config barrier: blocks that rotate validation inputs —
 CONFIG txs (MSP/policy object rotation) and blocks writing the
@@ -48,7 +64,8 @@ from __future__ import annotations
 
 import logging
 import time
-from concurrent.futures import Future, ThreadPoolExecutor
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 from concurrent.futures import TimeoutError as _CfTimeout
@@ -100,6 +117,13 @@ class CommittedBlock:
     batch: object
     history: list
     barrier: bool = False
+    # True when this commit runs on the committer thread of a DEPTH ≥ 3
+    # pipeline with more of the window behind it: the commit_fn may
+    # SKIP its forced per-block fsync and let the blockstore's
+    # group-commit batch the syncs across the window (force-closed at
+    # every barrier, idle flush, and tail — those commits carry False,
+    # and depth ≤ 2 never defers: classic durability unchanged)
+    defer_sync: bool = False
     # filled by the pipeline for telemetry (seconds)
     stage_s: dict = field(default_factory=dict)
     # this block's tracer root span (fabric_tpu.observe) — commit_fn
@@ -143,8 +167,31 @@ def _is_barrier(pend, batch) -> bool:
     )
 
 
+@dataclass
+class _InflightCommit:
+    """One predecessor whose ledger commit is in flight on the
+    committer thread — its batch joins the merged launch overlay and
+    its txids the widened dup window until the commit is drained."""
+
+    fut: object       # committer-thread Future
+    batch: object     # the block's UpdateBatch (overlay chain member)
+    txids: object     # the block's txid set (dup-window member)
+    number: int
+
+
 class CommitPipeline:
-    """Streaming depth-2 commit pipeline over a BlockValidator.
+    """Streaming depth-N commit pipeline over a BlockValidator.
+
+    ``depth`` is the number of blocks in flight: 1 = strict serial
+    (the correctness oracle), 2 = the classic overlap (one launched +
+    one committing, single-batch overlay), N ≥ 3 = a deep window where
+    up to N−1 predecessors' commits drain on the committer thread
+    while the newest block launches under a MERGED overlay of their
+    batches (``UpdateBatch.merged``, newest-wins) and a dup-txid
+    window spanning all of them — block n on device while n−1 commits
+    and n−2 fsyncs.  The committer thread serializes commits in block
+    order at every depth; a barrier (or flush) drains the whole
+    window before proceeding.
 
     ``submit(block)`` feeds the next block in height order and returns
     the COMPLETED predecessor (its commit handed to the committer
@@ -156,7 +203,11 @@ class CommitPipeline:
     ``commit_fn(res: CommittedBlock)`` runs on the committer thread
     (inline for barriers and in serial mode) and must perform the
     ledger commit; commits are serialized in block order and a commit
-    failure surfaces at the next ``submit``/``flush``.
+    failure surfaces at the next ``submit``/``flush``.  At depth ≥ 3,
+    pipelined commits carry ``res.defer_sync=True`` — a commit_fn may
+    skip its forced per-block fsync for those and let the blockstore's
+    group-commit batch the syncs over the window (barrier/tail/idle
+    commits carry False, closing the window; depth ≤ 2 never defers).
 
     ``prefetch_fn(block)`` (default ``validator.preprocess``) runs on
     the prefetch thread.  ``pre_launch_fn(block)`` runs on the CALLER
@@ -194,9 +245,9 @@ class CommitPipeline:
         # children across the three threads — the flight recorder and
         # /trace read what this records
         self.tracer = tracer
-        # the overlay mechanism covers exactly ONE in-flight
-        # predecessor, so useful depths are 1 (serial) and 2
-        self.depth = 1 if depth <= 1 else 2
+        # 1 = serial oracle; N ≥ 2 = up to N−1 in-flight predecessor
+        # commits, their batches merged into the launch overlay
+        self.depth = max(1, int(depth))
         self.prefetch_fn = prefetch_fn or validator.preprocess
         self.pre_launch_fn = pre_launch_fn
         self.coalesce_blocks = int(coalesce_blocks)
@@ -245,9 +296,10 @@ class CommitPipeline:
         self._pre: tuple | None = None   # (block, prefetch Future, root)
         self._launched = None                # PendingBlock in flight
         self._launched_root = None           # its tracer root span
-        self._commit_fut: Future | None = None
-        self._overlay = None
-        self._extra = None
+        # in-flight predecessor commits, oldest first: at most depth−1
+        # deep; their batches form the merged launch overlay and their
+        # txids the widened dup window
+        self._commits: deque[_InflightCommit] = deque()
         # set when a barrier flushed AFTER the next block was already
         # staged on the prefetch thread — that prefetch ran against
         # pre-barrier state and must be redone (see _launch_next)
@@ -286,12 +338,11 @@ class CommitPipeline:
         self._pre = None
         self._launched = None
         self._launched_root = None
-        # a still-pending committer task finishes inside shutdown's
-        # wait; its error (if any) was either surfaced already or is
+        # still-pending committer tasks finish inside shutdown's wait;
+        # their errors (if any) were either surfaced already or are
         # superseded by the failure that got us here
-        self._commit_fut = None
+        self._commits.clear()
         self._stale_prefetch = False
-        self._overlay = self._extra = None
         self._prefetch.shutdown(wait=True)
         self._committer.shutdown(wait=True)
         self._inflight_gauge.set(0, channel=self.channel)
@@ -324,11 +375,44 @@ class CommitPipeline:
 
     @property
     def inflight(self) -> int:
-        """Blocks accepted but not yet returned as committed — feeds
-        the ``commit_pipeline_inflight`` gauge.  (Replay protection in
-        the deliver loop tracks the next expected block number
-        directly; it does not consume this.)"""
-        return (self._pre is not None) + (self._launched is not None)
+        """Blocks accepted but not yet fully committed (prefetched,
+        launched, or draining on the committer thread) — feeds the
+        ``commit_pipeline_inflight`` gauge and the deliver driver's
+        idle-flush decision.  (Replay protection in the deliver loop
+        tracks the next expected block number directly; it does not
+        consume this.)"""
+        return ((self._pre is not None) + (self._launched is not None)
+                + len(self._commits))
+
+    # -- the in-flight commit window ---------------------------------------
+
+    def _drain_commits(self, keep: int) -> None:
+        """Wait out in-flight predecessor commits (oldest first) until
+        at most ``keep`` remain.  Records are POPPED before waiting so
+        a commit error surfaces exactly once; 0 = full drain (barrier,
+        tail, flush)."""
+        while len(self._commits) > keep:
+            rec = self._commits.popleft()
+            _wait_result(rec.fut, "committer", self.channel)
+
+    def _launch_overlay(self):
+        """(overlay, extra_txids) for the next launch, derived from the
+        in-flight commit window: a singleton window hands the batch and
+        txid set through UNMERGED (the depth-2 fast path — pointer
+        identity preserved); deeper windows merge newest-wins and union
+        the dup-txid sets."""
+        if not self._commits:
+            return None, None
+        if len(self._commits) == 1:
+            rec = self._commits[0]
+            return rec.batch, rec.txids
+        from fabric_tpu.ledger.statedb import UpdateBatch
+
+        recs = list(self._commits)
+        return (
+            UpdateBatch.merged([r.batch for r in recs]),
+            set().union(*(set(r.txids) for r in recs)),
+        )
 
     # -- the pipeline ------------------------------------------------------
 
@@ -525,10 +609,11 @@ class CommitPipeline:
                     _faults.fire("pipeline.launch")
                     if self.pre_launch_fn is not None:
                         self.pre_launch_fn(block)
+                    overlay, extra = self._launch_overlay()
                     t0 = time.perf_counter()
                     pend = self.validator.validate_launch(
-                        block, pre=pre, overlay=self._overlay,
-                        extra_txids=self._extra,
+                        block, pre=pre, overlay=overlay,
+                        extra_txids=extra,
                     )
                     self._launch_s = time.perf_counter() - t0
             except BaseException:
@@ -536,12 +621,9 @@ class CommitPipeline:
                 raise
             self._launched_root = root
             out = self._finish_and_commit(pend, tail=True)
-        if self._commit_fut is not None:
-            # pop BEFORE waiting: a commit error must surface exactly
-            # once, not re-raise from the stored future at close()
-            fut, self._commit_fut = self._commit_fut, None
-            _wait_result(fut, "committer", self.channel)
-        self._overlay = self._extra = None
+        # records are popped before waiting inside the drain: a commit
+        # error must surface exactly once, not re-raise at close()
+        self._drain_commits(0)
         # nothing is prefetched past this point: a barrier flushed as
         # the tail must not make the NEXT submit discard and redo its
         # (post-barrier) prefetch
@@ -592,10 +674,12 @@ class CommitPipeline:
         return res
 
     def _finish_and_commit(self, pend, tail: bool = False):
-        """Sync the device for ``pend``, serialize behind the previous
-        ledger commit, then either commit inline (barrier) or hand the
-        commit to the committer thread and expose the batch as the
-        successor's overlay."""
+        """Sync the device for ``pend``, serialize behind enough of the
+        in-flight commit window (all of it for barriers/tails; enough
+        to keep at most depth−1 commits in flight otherwise), then
+        either commit inline (barrier/tail) or hand the commit to the
+        committer thread and join the batch to the successors' merged
+        overlay window."""
         root = self._launched_root
         self._launched_root = None
         t0 = time.perf_counter()
@@ -606,16 +690,23 @@ class CommitPipeline:
             self._note_stage_failure("finish", pend.block.header.number)
             raise
         t1 = time.perf_counter()
-        if self._commit_fut is not None:
-            # pop BEFORE waiting so a commit error surfaces exactly once
-            fut, self._commit_fut = self._commit_fut, None
-            _wait_result(fut, "committer", self.channel)  # in order
+        barrier = _is_barrier(pend, batch)
+        # depth 2: wait THE predecessor commit (the classic overlap);
+        # depth N: only block once N−1 commits are already in flight —
+        # a slow fsync deep in the window no longer stalls this launch
+        self._drain_commits(
+            0 if (barrier or tail) else max(0, self.depth - 2)
+        )
         t2 = time.perf_counter()
         self.tracer.add("commit_wait", t1, t2, parent=root)
-        barrier = _is_barrier(pend, batch)
         res = CommittedBlock(
             block=pend.block, pend=pend, tx_filter=flt, batch=batch,
             history=history, barrier=barrier,
+            # fsync deferral is a DEPTH ≥ 3 behavior: at the default
+            # depth 2 every commit keeps the classic forced per-block
+            # fsync, so acknowledged-durability semantics are exactly
+            # the pre-depth-N ones on unchanged configs
+            defer_sync=self.depth >= 3 and not (barrier or tail),
             stage_s={"launch": self._launch_s, "finish": t1 - t0,
                      "commit_wait": t2 - t1},
             root_span=root,
@@ -627,8 +718,9 @@ class CommitPipeline:
                                  stage="commit_wait")
         if barrier or tail:
             # barrier: rotated validation inputs must be fully
-            # committed (and the overlay dropped) before any launch;
-            # tail: nothing left to overlap with
+            # committed (and the overlay window dropped) before any
+            # launch; tail: nothing left to overlap with.  Either way
+            # the fsync window closes here (defer_sync=False).
             self.tracer.set_attrs(
                 root, **({"barrier": True} if barrier else {"tail": True})
             )
@@ -643,14 +735,14 @@ class CommitPipeline:
                 raise
             finally:
                 self.tracer.finish_block(root)
-            self._overlay = self._extra = None
             if barrier:
                 self._stale_prefetch = True
         else:
-            self._commit_fut = self._committer.submit(
-                self._commit_traced, res, root
-            )
-            self._overlay, self._extra = batch, pend.txids
+            fut = self._committer.submit(self._commit_traced, res, root)
+            self._commits.append(_InflightCommit(
+                fut=fut, batch=batch, txids=pend.txids,
+                number=pend.block.header.number,
+            ))
         self._blocks_ctr.add(
             1, channel=self.channel,
             mode="barrier" if barrier else "pipelined",
@@ -691,9 +783,10 @@ class CommitPipeline:
                     # flushed — the node verifies orderer block
                     # signatures here against the post-rotation bundle
                     self.pre_launch_fn(block)
+                overlay, extra = self._launch_overlay()
                 self._launched = self.validator.validate_launch(
-                    block, pre=pre, overlay=self._overlay,
-                    extra_txids=self._extra,
+                    block, pre=pre, overlay=overlay,
+                    extra_txids=extra,
                 )
         except BaseException:
             self._note_stage_failure("launch", block.header.number)

@@ -297,6 +297,10 @@ class _DevicePre:
     rwp: object = None    # native mvcc_prep flat arrays (fast blocks)
     ns_names: list = None
     ukeys: list = None    # decoded unique key strings (shared w/ fill)
+    # True iff fb.codes tracks every later per-tx code assignment (the
+    # columnar builder + the launch-time dup check keep it live) — the
+    # gate for the vectorized state_fill in _launch_device
+    codes_synced: bool = False
 
 
 class BlockValidator:
@@ -1262,13 +1266,17 @@ class BlockValidator:
         and return a PendingBlock; ``validate_finish`` syncs the device
         and produces (filter, batch, history).
 
-        ``overlay``: the UpdateBatch of the PREDECESSOR block whose
-        ledger commit may still be in flight on a committer thread —
-        its writes override committed-version lookups (and range
-        re-execution), so this block launches without waiting for the
-        predecessor's fsync.  ``extra_txids``: txids of in-flight
-        predecessors for the duplicate-txid check (their block-store
-        index insert may not have landed yet).
+        ``overlay``: the UpdateBatch of the in-flight predecessor
+        WINDOW — one block's batch at pipeline depth 2, or the
+        newest-wins MERGE of up to depth−1 predecessors' batches
+        (``UpdateBatch.merged``) whose ledger commits may still be
+        draining on the committer thread.  Its writes override
+        committed-version lookups (and range re-execution, and the SBE
+        metadata probes via the unioned ``has_meta``), so this block
+        launches without waiting for any predecessor's fsync.
+        ``extra_txids``: txids of EVERY in-flight predecessor for the
+        duplicate-txid check (their block-store index inserts may not
+        have landed yet).
 
         Pipelined callers must SERIALIZE around blocks that rotate
         validation inputs — config blocks (MSP/policy object rotation)
@@ -1300,7 +1308,8 @@ class BlockValidator:
         self.last_parsed = txs
 
         # dup txid vs committed ledger + in-flight predecessors
-        # (deferred from preprocess)
+        # (deferred from preprocess).  fb.codes is kept in sync — the
+        # vectorized state_fill reads it as the live verdict array.
         if self.blocks is not None or extra_txids:
             for ptx in txs:
                 if ptx.undetermined and not ptx.is_config and (
@@ -1309,6 +1318,8 @@ class BlockValidator:
                         and self.blocks.tx_exists(ptx.txid))
                 ):
                     ptx.code = C.DUPLICATE_TXID
+                    if fb is not None:
+                        fb.codes[ptx.idx] = int(C.DUPLICATE_TXID)
 
         pending = PendingBlock(
             block=block, txs=txs, items=items, fetch=fetch, dpre=dpre,
@@ -1326,7 +1337,7 @@ class BlockValidator:
         ):
             try:
                 pending.fetch2, pending.range_phantom = self._launch_device(
-                    block, txs, fetch, dpre, overlay
+                    block, txs, fetch, dpre, overlay, fb=fb
                 )
             except Exception as e:
                 # fused stage-2 dispatch died: with a lane guard this
@@ -1772,6 +1783,11 @@ class BlockValidator:
             ]
             static = mvcc_ops.prepare_block_from_flat(len(txs), rwp, composite)
             static.u_pairs = [(c[1], c[2]) for c in composite]
+            # key → unique-id index for the launch-time overlay
+            # overrides — built HERE (prefetch thread) so the caller
+            # thread's state_fill never pays the dict construction
+            static.u_index = dict(zip(static.u_pairs,
+                                      range(rwp.n_keys)))
             static.packed_static()
             return _DevicePre(
                 groups=groups, group_entries=group_entries, static=static,
@@ -1900,17 +1916,30 @@ class BlockValidator:
         composite = [("pub", ns, k) for ns, k in pairs]
         static = mvcc_ops.prepare_block_from_flat(n, rwp, composite)
         static.u_pairs = pairs
+        # prefetch-thread key index (see _device_preprocess)
+        static.u_index = dict(zip(pairs, range(rwp.n_keys)))
         static.packed_static()  # ONE H2D, prefetch thread
         return _DevicePre(
             groups=groups, group_entries=group_entries, static=static,
             has_range=False, policies=self.policies,
             rwp=rwp, ns_names=ns_names, ukeys=ukeys,
+            codes_synced=True,
         )
 
-    def _launch_device(self, block, txs, handle, dpre, overlay=None):
+    def _launch_device(self, block, txs, handle, dpre, overlay=None,
+                       fb=None):
         """Host-side device-path launch: range re-execution, structural
         arrays, committed-version fill (+ overlay), stage-2 dispatch.
-        Returns the packed-output fetch."""
+        Returns the packed-output fetch.
+
+        The ``state_fill`` stage here is fully vectorized for columnar
+        blocks (``fb`` with codes kept in sync by the columnar builder
+        — ``dpre.codes_synced``): the per-tx structural/creator loop
+        becomes numpy masks over the live code array, and the
+        committed-version fill is one fused backend column gather
+        (``statedb.get_versions_cols``) with overlay overrides applied
+        by iterating the (small) overlay instead of probing it per
+        unique key."""
         from fabric_tpu.peer.device_block import DeviceBlockPipeline
 
         t0 = time.perf_counter()
@@ -1936,12 +1965,24 @@ class BlockValidator:
         t_bucket = int(dpre.static.read_keys.shape[0])
         structural = np.zeros(t_bucket, bool)
         creator_idx = np.full(t_bucket, -1, np.int32)
-        for ptx in txs:
-            if ptx.undetermined and not ptx.is_config:
-                structural[ptx.idx] = ptx.idx not in range_phantom
-                creator_idx[ptx.idx] = (
-                    -2 if ptx.host_creator_ok else ptx.creator_item_idx
-                )  # -2 = host-verified (idemix) → always-true lane
+        if (fb is not None and getattr(dpre, "codes_synced", False)
+                and not dpre.has_range):
+            # columnar fast lane: fb.codes IS the live verdict array
+            # (the columnar builder and the dup check keep it synced),
+            # every live tx is a flat columnar endorser tx (no idemix
+            # -2 lanes, no range phantoms) — two masked assignments
+            # replace the 1000-iteration Python loop
+            n = len(txs)
+            live = (fb.codes == int(C.NOT_VALIDATED)) & ~fb.is_config
+            structural[:n] = live
+            creator_idx[:n] = np.where(live, fb.creator_item, -1)
+        else:
+            for ptx in txs:
+                if ptx.undetermined and not ptx.is_config:
+                    structural[ptx.idx] = ptx.idx not in range_phantom
+                    creator_idx[ptx.idx] = (
+                        -2 if ptx.host_creator_ok else ptx.creator_item_idx
+                    )  # -2 = host-verified (idemix) → always-true lane
 
         static = dpre.static
         if getattr(static, "u_pairs", None) is not None:
@@ -1971,30 +2012,38 @@ class BlockValidator:
         return fetch2, range_phantom
 
     def _flat_ver_ok(self, static, overlay):
-        """[T] bool committed-version check for a flat block: one bulk
-        state lookup over the UNIQUE read keys (the
-        preLoadCommittedVersionOfRSet analog), overlay overrides for
-        the in-flight predecessor, then a vectorized per-read compare
-        reduced per tx (VecStaticBlock.ver_ok_from_u)."""
+        """[T] bool committed-version check for a flat block: one FUSED
+        column gather over the UNIQUE read keys (the
+        preLoadCommittedVersionOfRSet analog —
+        ``statedb.get_versions_cols`` fills the arrays in a single
+        backend pass, no dict round-trip), overlay overrides for the
+        in-flight predecessor window applied by walking the overlay's
+        (small) write set against the prefetch-built key index instead
+        of probing the overlay once per unique key, then a vectorized
+        per-read compare reduced per tx (VecStaticBlock.ver_ok_from_u).
+        A merged multi-batch overlay needs no special casing: its
+        ``updates`` mapping is already newest-wins."""
         pairs = static.u_pairs
         U = len(pairs)
-        up = np.zeros(U, bool)
-        uv = np.zeros((U, 2), np.uint32)
-        vers = self.state.get_versions_bulk(pairs) if U else {}
-        ol = overlay.updates if overlay is not None else None
-        vget = vers.get
-        for ui, pr in enumerate(pairs):
-            if ol is not None:
-                vv = ol.get(pr)
-                if vv is not None:
-                    if vv.value is not None:
-                        up[ui] = True
-                        uv[ui] = vv.version
+        if not U:
+            return static.ver_ok_from_u(
+                np.zeros(0, bool), np.zeros((0, 2), np.uint32)
+            )
+        up, uv = self.state.get_versions_cols(pairs)
+        if overlay is not None and overlay.updates:
+            idx = getattr(static, "u_index", None)
+            if idx is None:  # built on the prefetch thread normally
+                idx = static.u_index = dict(zip(pairs, range(U)))
+            iget = idx.get
+            for pr, vv in overlay.updates.items():
+                ui = iget(pr)
+                if ui is None:
                     continue
-            v = vget(pr)
-            if v is not None:
-                up[ui] = True
-                uv[ui] = v
+                if vv.value is None:  # in-flight delete
+                    up[ui] = False
+                else:
+                    up[ui] = True
+                    uv[ui] = vv.version
         return static.ver_ok_from_u(up, uv)
 
     def _finish_device(self, pending: "PendingBlock"):

@@ -180,6 +180,52 @@ def render(data, width: int = 48, block: int | None = None) -> str:
     return render_trace_dump(data, width, block)
 
 
+# -- pipeline overlap coverage ----------------------------------------------
+
+
+def render_coverage(data, window: int = 2) -> str:
+    """Per-block device_wait coverage by neighbor-block host stages
+    (observe/overlap.py) — the deep-pipelining acceptance number as a
+    text table, from either input form."""
+    import os
+    import sys as _sys
+
+    _sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from fabric_tpu.observe import overlap
+
+    if isinstance(data, dict) and "traceEvents" in data:
+        cov = overlap.coverage_from_spans(
+            overlap.spans_from_chrome(data["traceEvents"]), window=window
+        )
+    elif isinstance(data, list):
+        cov = overlap.coverage_from_spans(
+            overlap.spans_from_chrome(data), window=window
+        )
+    else:
+        cov = overlap.coverage_from_trace_dump(data, window=window)
+        if cov is None:
+            return ("no t0_s anchors in dump — re-capture from a "
+                    "/trace endpoint that emits them")
+    lines = [
+        "pipeline overlap coverage (window ±%d): mean %s  p50 %s  "
+        "min %s over %d block(s)" % (
+            cov["window"], cov["mean"], cov["p50"], cov["min"],
+            cov["blocks_measured"],
+        )
+    ]
+    for b in cov["per_block"]:
+        lines.append(
+            "  block %-6s device_wait %8.2f ms  covered %8.2f ms  "
+            "(%.1f%%)" % (
+                b["block"], b["device_wait_ms"], b["covered_ms"],
+                b["coverage"] * 100.0,
+            )
+        )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="chrome trace JSON or /trace dump")
@@ -187,10 +233,20 @@ def main(argv=None) -> int:
                     help="render one block only")
     ap.add_argument("--width", type=int, default=48,
                     help="waterfall bar width (chars)")
+    ap.add_argument("--coverage", action="store_true",
+                    help="print the pipeline overlap-coverage table "
+                         "(device_wait hidden by neighbor host stages) "
+                         "instead of the waterfall")
+    ap.add_argument("--window", type=int, default=2,
+                    help="coverage neighbor window in blocks "
+                         "(depth−1; default 2 = depth-3)")
     args = ap.parse_args(argv)
     with open(args.path) as f:
         data = json.load(f)
-    print(render(data, width=args.width, block=args.block))
+    if args.coverage:
+        print(render_coverage(data, window=args.window))
+    else:
+        print(render(data, width=args.width, block=args.block))
     return 0
 
 

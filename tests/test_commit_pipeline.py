@@ -112,7 +112,11 @@ class ToyValidator:
                 continue
             codes.append(self.VALID)
             for k, val in t.get("writes", {}).items():
-                batch.put(self._ns(k), k, val.encode(), (num, ptx.idx))
+                if val is None:  # JSON null = delete
+                    batch.delete(self._ns(k), k, (num, ptx.idx))
+                else:
+                    batch.put(self._ns(k), k, val.encode(),
+                              (num, ptx.idx))
         return bytes(codes), batch, []
 
 
@@ -548,6 +552,268 @@ def test_barrier_redo_prefetch_failure_no_wedged_threads():
     assert _no_live_pipeline_threads() == []
     # everything BEFORE the quarantined block committed in order
     assert committed == [0, 1]
+
+
+# -- depth-N: merged overlay chains, widened dup window, deferred fsync ------
+
+
+def _stream_deep(n_blocks=6, n_tx=6):
+    """Conflict chains spanning BOTH in-flight predecessors (the
+    depth-3 shape): block n reads block n−1's AND block n−2's writes
+    at the versions they wrote (fresh — resolvable only through the
+    merged overlay chain while both commits are in flight), overwrites
+    a shared hot key every block (newest-wins resolution), reads the
+    hot key at the IMMEDIATE predecessor's version, and carries one
+    stale lane per block (must fail MVCC at every depth)."""
+    blocks, prev = [], b""
+    for n in range(n_blocks):
+        txs = []
+        for i in range(n_tx):
+            t = {"id": f"tx{n}_{i}", "writes": {f"k{n}_{i}": f"v{n}"}}
+            if i == 3:
+                t["writes"]["hot"] = f"h{n}"
+            if n > 0 and i == 0:
+                t["reads"] = {f"k{n-1}_0": [n - 1, 0]}   # k→k+1 fresh
+            if n > 1 and i == 1:
+                t["reads"] = {f"k{n-2}_1": [n - 2, 1]}   # k→k+2 fresh
+            if n > 1 and i == 2:
+                t["reads"] = {f"k{n-2}_2": [0, 0]}       # stale → MVCC
+            if n > 0 and i == 4:
+                t["reads"] = {"hot": [n - 1, 3]}         # newest-wins
+            txs.append(t)
+        blk = _block(n, prev, txs)
+        prev = pu.block_header_hash(blk.header)
+        blocks.append(blk)
+    return blocks
+
+
+def test_depth3_matches_serial_with_k2_conflict_chains(tmp_path):
+    """THE depth-3 differential: accept set AND post-commit ledger
+    state ≡ the serial oracle on a stream whose RW dependencies span
+    both in-flight predecessors (k→k+1, k→k+2, hot-key newest-wins),
+    through a real KVLedger — depths 4 and 2 ride along."""
+    from fabric_tpu.ledger.kvledger import KVLedger
+
+    blocks = _stream_deep(6, 6)
+
+    def run(depth, sub):
+        state = MemVersionedDB()
+        v = ToyValidator(state)
+        lg = KVLedger(str(tmp_path / f"lg{sub}"), state_db=state)
+        filters = []
+
+        def commit_fn(res):
+            state.apply_updates(res.batch, (res.block.header.number, 0))
+            lg.commit_block(res.block, res.tx_filter, res.batch,
+                            res.history, None, res.txids)
+
+        with CommitPipeline(v, commit_fn, depth=depth) as pipe:
+            for b in blocks:
+                r = pipe.submit(b)
+                if r is not None:
+                    filters.append((r.block.header.number,
+                                    list(r.tx_filter)))
+            r = pipe.flush()
+            if r is not None:
+                filters.append((r.block.header.number,
+                                list(r.tx_filter)))
+        height = lg.blocks.height
+        lg.close()
+        filters.sort()
+        return filters, dict(state._data), height, v
+
+    f1, s1, h1, _ = run(1, "serial")
+    for depth in (2, 3, 4):
+        fd, sd, hd, v = run(depth, f"d{depth}")
+        assert fd == f1, f"depth {depth} filters diverged"
+        assert sd == s1, f"depth {depth} state diverged"
+        assert hd == h1 == len(blocks)
+        if depth >= 3:
+            # actually pipelined deep: every non-head block launched
+            # with an overlay
+            assert all(ov for n, ov in v.launch_order if n >= 1)
+    # the stale lane failed and the fresh k→k+2 lane passed, serially
+    for n, flt in f1:
+        if n > 1:
+            assert flt[1] == ToyValidator.VALID   # k→k+2 fresh
+            assert flt[2] == ToyValidator.MVCC    # stale
+            assert flt[4] == ToyValidator.VALID   # hot newest-wins
+
+
+def test_depth3_overlay_chain_spans_two_inflight_predecessors():
+    """Deterministic merged-overlay proof: commits of blocks 0 AND 1
+    are gated closed on the committer thread, so block 2's reads can
+    resolve ONLY through the merged overlay chain — newest-wins for
+    the twice-written key, delete override, and an oldest-batch key
+    surviving the merge."""
+    import threading
+
+    b0 = _block(0, b"", [
+        {"id": "a0", "writes": {"x": "a", "y": "a", "z": "a"}},
+    ])
+    b1 = _block(1, pu.block_header_hash(b0.header), [
+        {"id": "a1", "writes": {"x": "b", "y": None}},  # overwrite + delete
+    ])
+    b2 = _block(2, pu.block_header_hash(b1.header), [
+        {"id": "a2", "reads": {"x": [1, 0]}, "writes": {}},   # newest wins
+        {"id": "a3", "reads": {"y": None}, "writes": {}},     # deleted
+        {"id": "a4", "reads": {"z": [0, 0]}, "writes": {}},   # oldest survives
+        {"id": "a5", "reads": {"x": [0, 0]}, "writes": {}},   # stale → MVCC
+    ])
+    state = MemVersionedDB()
+    v = ToyValidator(state)
+    gate = threading.Event()
+    committed = []
+
+    def commit_fn(res):
+        num = res.block.header.number
+        if num < 2:
+            assert gate.wait(30.0), "commit gate never opened"
+        state.apply_updates(res.batch, (num, 0))
+        committed.append(num)
+
+    results = []
+    with CommitPipeline(v, commit_fn, depth=3) as pipe:
+        for b in (b0, b1, b2):
+            r = pipe.submit(b)
+            if r is not None:
+                results.append(r)
+        # block 2 launched with BOTH predecessors still uncommitted;
+        # open the gate so the flush can drain
+        assert committed == []
+        gate.set()
+        r = pipe.flush()
+        if r is not None:
+            results.append(r)
+    by_num = {r.block.header.number: list(r.tx_filter) for r in results}
+    V, M = ToyValidator.VALID, ToyValidator.MVCC
+    assert by_num[2] == [V, V, V, M]
+    assert committed == [0, 1, 2]
+    # pipelined mid-window commits defer their fsync; the tail closes
+    # the window
+    defer = {r.block.header.number: r.defer_sync for r in results}
+    assert defer[0] is True and defer[1] is True and defer[2] is False
+
+
+def test_dup_txid_across_widened_window_depth3():
+    """A txid replayed two blocks later, while BOTH predecessors are
+    in the in-flight window: depth 3's widened extra_txids must catch
+    it (depth 2's single-predecessor window structurally cannot — the
+    block store's tx_exists covers it there)."""
+    blocks = _stream(3, 3)
+    dup = json.loads(bytes(blocks[0].data.data[0]))
+    blocks[2].data.data.append(json.dumps(dup).encode())
+    blocks[2] = pu.finalize_block(blocks[2])
+    # re-link the chain after mutating block 2
+    f3, _, _ = _run(blocks, depth=3)
+    assert f3[2][1][-1] == ToyValidator.DUP
+
+
+def test_depth3_barrier_drains_whole_window_and_taints_successor():
+    """A lifecycle barrier at depth 3 drains BOTH in-flight commits
+    before committing inline, drops the whole overlay chain, and the
+    staged successor's prefetch is redone post-barrier."""
+    blocks = _stream(5, 4)
+    lc = json.loads(bytes(blocks[2].data.data[2]))
+    lc["writes"]["_lifecycle/cc1"] = "defn"
+    blocks[2].data.data[2] = json.dumps(lc).encode()
+
+    log = []
+    state = MemVersionedDB()
+    v = ToyValidator(state)
+
+    def commit_fn(res):
+        state.apply_updates(res.batch, (res.block.header.number, 0))
+        log.append((res.block.header.number, res.barrier))
+
+    with CommitPipeline(v, commit_fn, depth=3) as pipe:
+        for b in blocks:
+            pipe.submit(b)
+            if v.launch_order and v.launch_order[-1][0] == 3:
+                # by block 3's launch the barrier committed — and so
+                # did everything before it (window fully drained)
+                assert (2, True) in log
+                assert [n for n, _ in log] == [0, 1, 2]
+        pipe.flush()
+    assert [n for n, _ in log] == [0, 1, 2, 3, 4]
+    by_num = dict(v.launch_order)
+    assert by_num[3] is False   # overlay chain dropped at the barrier
+    assert by_num[4] is True    # pipelining resumed
+    # the barrier successor's pre-barrier prefetch was redone
+    pre3 = [seen for n, seen in v.preprocess_order if n == 3]
+    assert len(pre3) == 2 and pre3[-1] is True
+
+
+def test_coalesced_barrier_taints_both_successors_depth3():
+    """Config/lifecycle barrier mid-chain inside a coalesced group at
+    DEPTH 3: both staged successors redo their prefetch post-barrier
+    and verdicts/state equal the serial oracle (the group-wide taint
+    extends to every later slice at deep depths too)."""
+    blocks = _stream(4, 4)
+    lc = json.loads(bytes(blocks[1].data.data[2]))
+    lc["writes"]["_lifecycle/cc1"] = "defn"
+    blocks[1].data.data[2] = json.dumps(lc).encode()
+
+    state = MemVersionedDB()
+    v = CoalescingToyValidator(state)
+    filters = []
+
+    def commit_fn(res):
+        state.apply_updates(res.batch, (res.block.header.number, 0))
+
+    with CommitPipeline(v, commit_fn, depth=3,
+                        coalesce_blocks=4) as pipe:
+        for r in pipe.submit_many(blocks):
+            filters.append((r.block.header.number, list(r.tx_filter)))
+        r = pipe.flush()
+        if r is not None:
+            filters.append((r.block.header.number, list(r.tx_filter)))
+    filters.sort()
+    f_serial, s_serial, _ = _run(blocks, depth=1)
+    assert filters == f_serial
+    assert dict(state._data) == s_serial
+    for n in (2, 3):
+        seen = [s for num, s in v.preprocess_order if num == n]
+        assert len(seen) == 2, (n, v.preprocess_order)
+        assert seen[0] is False and seen[-1] is True
+
+
+def test_update_batch_merged_semantics():
+    """The merged-overlay primitive itself: newest-wins key
+    resolution, SBE has_meta union, delete override, singleton
+    identity (the depth-2 fast path), empty → None."""
+    from fabric_tpu.ledger.statedb import UpdateBatch
+
+    a = UpdateBatch()
+    a.put("ns", "x", b"a", (0, 0))
+    a.put("ns", "z", b"z", (0, 1), metadata=b"pol")  # SBE metadata
+    b = UpdateBatch()
+    b.put("ns", "x", b"b", (1, 0))   # overwrite
+    b.delete("ns", "y", (1, 1))      # delete rides through
+    assert a.has_meta and not b.has_meta
+
+    m = UpdateBatch.merged([a, b])
+    assert m is not a and m is not b
+    assert m.updates[("ns", "x")].value == b"b"          # newest wins
+    assert m.updates[("ns", "x")].version == (1, 0)
+    assert m.updates[("ns", "y")].value is None          # delete kept
+    assert m.updates[("ns", "z")].metadata == b"pol"     # oldest survives
+    assert m.has_meta                                    # union
+    # reversed chain order flips the winner
+    m2 = UpdateBatch.merged([b, a])
+    assert m2.updates[("ns", "x")].value == b"a"
+    # singleton: the batch ITSELF (pointer identity — depth-2 path)
+    assert UpdateBatch.merged([a]) is a
+    assert UpdateBatch.merged([None, a, None]) is a
+    assert UpdateBatch.merged([]) is None
+    assert UpdateBatch.merged([None]) is None
+    # a later metadata-less overwrite keeps the union flag (the SBE
+    # gate must stay engaged for the whole window)
+    c = UpdateBatch()
+    c.put("ns", "z", b"plain", (2, 0))
+    m3 = UpdateBatch.merged([a, c])
+    assert m3.updates[("ns", "z")].metadata is None
+    assert m3.has_meta
 
 
 def test_stage_failure_metrics_and_resume_from_height():

@@ -922,3 +922,143 @@ def test_no_fault_child_is_clean(tmp_path):
     assert out.returncode == 0, (out.stdout, out.stderr)
     assert "HEIGHT 12" in out.stdout
     _reopen_and_verify(ledger_dir, expect_height=12)
+
+
+# -- crash consistency under the PIPELINED windowed fsync --------------------
+
+_PIPE_CRASH_CHILD = """\
+import json, sys
+sys.path.insert(0, {repo!r})
+from fabric_tpu import protoutil as pu
+from fabric_tpu.ledger.kvledger import KVLedger
+from fabric_tpu.ledger.statedb import MemVersionedDB, UpdateBatch
+from fabric_tpu.peer.pipeline import CommitPipeline
+
+
+class V:  # minimal validator protocol over 1-tx JSON blocks
+    def preprocess(self, block):
+        return [json.loads(bytes(d)) for d in block.data.data]
+
+    def validate_launch(self, block, pre=None, overlay=None,
+                        extra_txids=None):
+        raw = pre if pre is not None else self.preprocess(block)
+        return type("P", (), {{
+            "block": block, "raw": raw, "txs": [],
+            "txids": {{t["id"] for t in raw}},
+        }})()
+
+    def validate_finish(self, pend):
+        batch = UpdateBatch()
+        num = pend.block.header.number
+        for i, t in enumerate(pend.raw):
+            batch.put("ns", t["key"], b"v", (num, i))
+        return bytes([0] * len(pend.raw)), batch, []
+
+
+lg = KVLedger(sys.argv[1], state_db=MemVersionedDB(),
+              enable_history=False)
+lg.blocks.group_commit = 4
+depth = int(sys.argv[3])
+mode = sys.argv[4]  # "honor" = node discipline; "windowed" = pure
+                    # group-commit batching (no forced per-block sync)
+
+
+def commit_fn(res):
+    lg.commit_block(res.block, res.tx_filter, res.batch, res.history,
+                    None, [(t["id"], i)
+                           for i, t in enumerate(res.pend.raw)])
+    # the node's windowed-fsync discipline: mid-window DEEP-pipelined
+    # commits defer; everything else forces the window closed
+    if mode == "honor" and not res.defer_sync:
+        lg.blocks.sync()
+
+
+prev = b""
+blocks = []
+for n in range(int(sys.argv[2])):
+    blk = pu.new_block(n, prev)
+    blk.data.data.append(
+        json.dumps({{"id": "tx%d" % n, "key": "k%d" % n}}).encode()
+    )
+    blk = pu.finalize_block(blk)
+    prev = pu.block_header_hash(blk.header)
+    blocks.append(blk)
+with CommitPipeline(V(), commit_fn, depth=depth) as pipe:
+    for blk in blocks:
+        pipe.submit(blk)
+print("HEIGHT", lg.height)
+lg.close()
+"""
+
+
+def _run_pipe_crash_child(tmp_path, n_blocks, depth, fault_spec,
+                          mode="honor"):
+    script = tmp_path / "pipe_crash_child.py"
+    script.write_text(_PIPE_CRASH_CHILD.format(repo=REPO))
+    ledger_dir = str(tmp_path / "ledger")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("FABTPU_FAULTS", None)
+    if fault_spec:
+        env["FABTPU_FAULTS"] = fault_spec
+    out = subprocess.run(
+        [sys.executable, str(script), ledger_dir, str(n_blocks),
+         str(depth), mode],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    return ledger_dir, out
+
+
+@pytest.mark.parametrize("hook", ["before", "after"])
+def test_pipelined_windowed_fsync_crash_replays_depth3(tmp_path, hook):
+    """THE windowed-fsync durability re-pin at depth 3: mid-window
+    commits carry defer_sync=True, the node discipline skips their
+    forced fsync, and group_commit=4 batches the window — a hard kill
+    at the SECOND group fsync must reopen at the last group-commit
+    boundary (height 8: block 7's record on disk but unindexed), link
+    the chain, replay state forward, and keep accepting blocks."""
+    ledger_dir, out = _run_pipe_crash_child(
+        tmp_path, 12, 3, f"ledger.fsync.{hook}:crash:after=1"
+    )
+    assert out.returncode == 86, (out.stdout, out.stderr)
+    assert "HEIGHT" not in out.stdout  # died mid-stream, as intended
+    _reopen_and_verify(ledger_dir, expect_height=8, indexed_txids=7)
+
+
+def test_pipelined_depth2_keeps_classic_per_block_durability(tmp_path):
+    """Depth 2 NEVER defers (defer_sync is a depth ≥ 3 behavior): the
+    honor-discipline child force-fsyncs every commit, so the same
+    crash plan fires at the SECOND per-block sync and only blocks 0–1
+    are on disk — the default config's acknowledged-durability
+    semantics are exactly the pre-depth-N ones."""
+    ledger_dir, out = _run_pipe_crash_child(
+        tmp_path, 12, 2, "ledger.fsync.before:crash:after=1"
+    )
+    assert out.returncode == 86, (out.stdout, out.stderr)
+    _reopen_and_verify(ledger_dir, expect_height=2)
+
+
+@pytest.mark.parametrize("hook", ["before", "after"])
+def test_pipelined_windowed_fsync_crash_depth2_group_knob(tmp_path,
+                                                          hook):
+    """The depth-2 windowed story rides the group_commit KNOB, not
+    defer_sync: a committer that opts out of forced per-block syncs
+    entirely (mode=windowed) batches fsyncs every 4 blocks at depth 2
+    too, and the kill-mid-group replay holds there as well."""
+    ledger_dir, out = _run_pipe_crash_child(
+        tmp_path, 12, 2, f"ledger.fsync.{hook}:crash:after=1",
+        mode="windowed",
+    )
+    assert out.returncode == 86, (out.stdout, out.stderr)
+    _reopen_and_verify(ledger_dir, expect_height=8, indexed_txids=7)
+
+
+@pytest.mark.parametrize("depth", [2, 3])
+def test_pipelined_windowed_fsync_clean_run(tmp_path, depth):
+    """No fault: the pipelined honor-discipline child commits all 12
+    blocks and the TAIL commit closes any open window (the stream's
+    last block arrives with defer_sync=False), so everything is
+    durable at exit even before close()."""
+    ledger_dir, out = _run_pipe_crash_child(tmp_path, 12, depth, "")
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "HEIGHT 12" in out.stdout
+    _reopen_and_verify(ledger_dir, expect_height=12)

@@ -285,11 +285,46 @@ def _device_stream(key, n_blocks=6, n_tx=8):
     return blocks
 
 
+def _device_stream_deep(key, n_blocks=6, n_tx=6):
+    """Depth-3 shape with REAL signatures: RW dependencies spanning
+    BOTH in-flight predecessors — block n reads block n−1's AND block
+    n−2's writes at the written versions (fresh only through the
+    merged overlay chain), a per-block stale k→k+2 lane, and the usual
+    corrupted-signature lane so device verdicts stay load-bearing."""
+    blocks, prev = [], b""
+    for n in range(n_blocks):
+        txs = []
+        for i in range(n_tx):
+            e = ec_ref.digest_int(b"dtx%d_%d" % (n, i))
+            r, s = key.sign_digest(e)
+            if i == 2:
+                s = ec_ref.N - s  # high-S → device rejects
+            t = {
+                "id": f"dtx{n}_{i}",
+                "sig": [str(v) for v in (e, r, s, *key.public)],
+                "writes": {f"k{n}_{i}": f"v{n}"},
+            }
+            if n > 0 and i == 0:
+                t["reads"] = {f"k{n-1}_0": [n - 1, 0]}   # k→k+1 fresh
+            if n > 1 and i == 1:
+                t["reads"] = {f"k{n-2}_1": [n - 2, 1]}   # k→k+2 fresh
+            if n > 1 and i == 4:
+                t["reads"] = {f"k{n-2}_4": [0, 0]}       # stale → MVCC
+            txs.append(t)
+        blk = pu.new_block(n, prev)
+        for t in txs:
+            blk.data.data.append(json.dumps(t).encode())
+        blk = pu.finalize_block(blk)
+        prev = pu.block_header_hash(blk.header)
+        blocks.append(blk)
+    return blocks
+
+
 def _run_device_pipe(blocks, depth, mesh=None, coalesce=0, pool=None,
-                     recode_device=False):
+                     recode_device=False, chunk=0):
     state = MemVersionedDB()
     v = DeviceToyValidator(state, mesh=mesh, pool=pool,
-                           recode_device=recode_device)
+                           recode_device=recode_device, chunk=chunk)
     filters = []
 
     def commit_fn(res):
@@ -333,6 +368,38 @@ def test_sharded_coalesced_pipeline_matches_serial(key):
     for _, flt in f_serial:
         assert flt[2] == DeviceToyValidator.BADSIG
         assert DeviceToyValidator.VALID in flt
+
+
+def test_depth3_device_pipeline_matches_serial(key):
+    """THE depth-3 acceptance gate through the REAL device lane:
+    a stream whose conflict chains span both in-flight predecessors
+    (k→k+1 and k→k+2 fresh reads, k→k+2 stale lane, corrupted-sig
+    lanes) must produce filters and final state identical to the
+    serial oracle at depth 3 — solo, chunked (the double-buffered
+    dispatch under the pipeline), and mesh-sharded + coalesced."""
+    blocks = _device_stream_deep(key, n_blocks=6, n_tx=6)
+    f1, s1, _ = _run_device_pipe(blocks, depth=1)
+    # the stream exercises what it claims: bad-sig lanes rejected,
+    # fresh k→k+2 lanes valid, stale lanes MVCC-failed
+    for n, flt in f1:
+        assert flt[2] == DeviceToyValidator.BADSIG
+        if n > 1:
+            assert flt[1] == DeviceToyValidator.VALID
+            assert flt[4] == DeviceToyValidator.MVCC
+
+    f3, s3, v = _run_device_pipe(blocks, depth=3)
+    assert f3 == f1
+    assert s3 == s1
+    assert all(ov for n, ov in v.launch_order if n >= 1)
+
+    f3c, s3c, _ = _run_device_pipe(blocks, depth=3, chunk=16)
+    assert f3c == f1 and s3c == s1
+
+    f3m, s3m, vm = _run_device_pipe(
+        blocks, depth=3, mesh=pmesh.resolve_mesh(2), coalesce=3
+    )
+    assert f3m == f1 and s3m == s1
+    assert vm.coalesced_calls == 2
 
 
 def test_pooled_staging_pipeline_matches_serial(key):

@@ -328,6 +328,15 @@ def test_trace_endpoint_roundtrip(toy_run):
             # the summary reads histograms through the LOCKED snapshot
             summ = idx["summary"]["validator_stage_seconds"]
             assert summ["stage=finish"]["count"] == 1
+            # the deep-pipelining acceptance number rides the index
+            cov = idx["pipeline_overlap_coverage"]
+            assert cov["window"] == 2
+            assert set(cov) >= {"blocks_measured", "mean", "p50", "min"}
+            # and ?overlap_window= adjusts the neighbor window
+            st, idx1 = await loop.run_in_executor(
+                None, _get, srv.port, "/trace?overlap_window=1"
+            )
+            assert idx1["pipeline_overlap_coverage"]["window"] == 1
             st, tree = await loop.run_in_executor(
                 None, _get, srv.port, "/trace?block=3"
             )
@@ -542,3 +551,136 @@ def test_traceview_renders_multiprocess_dump():
     assert "sidecar:" in text
     assert "clock offset -2.000 ms" in text
     assert "sig_prepare_launch" in text
+
+
+# ---------------------------------------------------------------------------
+# overlap-coverage analyzer (observe/overlap.py)
+
+
+def _cov_rows():
+    """Hand-built timeline: block 1's device_wait [10.00, 10.10);
+    block 0's commit covers [10.00, 10.05), block 3's prefetch (a
+    DISTANCE-2 neighbor) [10.05, 10.08) — union coverage 0.8 at
+    window 2, 0.5 at window 1.  Block 6 sits outside every window.
+    Non-host spans (commit_wait) and SAME-block host work must not
+    count."""
+    return [
+        (0, "commit", 10.00, 10.05),
+        (1, "device_wait", 10.00, 10.10),
+        (1, "host_parse", 10.00, 10.10),    # own block: never counts
+        (3, "prefetch", 10.05, 10.08),
+        (3, "commit_wait", 10.00, 10.20),   # pure wait: never counts
+        (6, "device_wait", 20.00, 20.10),   # no in-window neighbor
+    ]
+
+
+def test_overlap_coverage_math():
+    from fabric_tpu.observe import overlap
+
+    cov = overlap.coverage_from_spans(_cov_rows(), window=2)
+    assert cov["window"] == 2
+    per = {b["block"]: b for b in cov["per_block"]}
+    assert per[1]["coverage"] == pytest.approx(0.8)
+    assert per[1]["device_wait_ms"] == pytest.approx(100.0)
+    assert per[1]["covered_ms"] == pytest.approx(80.0)
+    # block 6 has NO in-window neighbor at all → skipped entirely
+    assert 6 not in per
+    assert cov["blocks_measured"] == 1
+    assert cov["min"] == pytest.approx(0.8)
+
+    # window 1: block 0's commit is the only neighbor of block 1 —
+    # block 3's prefetch falls out of the window
+    cov1 = overlap.coverage_from_spans(_cov_rows(), window=1)
+    per1 = {b["block"]: b for b in cov1["per_block"]}
+    assert per1[1]["coverage"] == pytest.approx(0.5)
+    assert cov1["blocks_measured"] == 1
+
+
+def test_overlap_coverage_union_no_double_count():
+    """Nested/overlapping host spans union — a container span plus
+    its children must not count twice."""
+    from fabric_tpu.observe import overlap
+
+    rows = [
+        (1, "device_wait", 0.0, 1.0),
+        (0, "commit", 0.0, 0.6),
+        (0, "ledger_commit", 0.0, 0.5),   # nested inside commit
+        (0, "fsync", 0.5, 0.6),           # ditto
+    ]
+    cov = overlap.coverage_from_spans(rows, window=1)
+    assert cov["per_block"][0]["coverage"] == pytest.approx(0.6)
+
+
+def _device_wait_tracer():
+    """A tracer whose trees carry device_wait spans with a known
+    overlap shape — 3 blocks, each block's device_wait half-covered by
+    its predecessor's commit."""
+    clk = _Clock()
+    tr = Tracer(ring_blocks=8, slow_factor=0, clock=clk)
+    for n in range(3):
+        base = 10.0 * n
+        root = tr.begin_block(n)
+        root.t0 = base
+        tr.add("launch", base, base + 1.0, parent=root)
+        tr.add("device_wait", base + 1.0, base + 5.0, parent=root)
+        if n + 1 < 3:
+            # predecessor's commit overlaps HALF the successor's wait
+            tr.add("commit", base + 11.0, base + 13.0, parent=root)
+        root.t1 = base + 9.0
+        tr.finish_block(root)
+    return tr
+
+
+def test_overlap_coverage_all_three_input_forms():
+    """The live-roots, /trace-dump (t0_s anchored), and Chrome-event
+    forms of the SAME flight recorder must agree."""
+    from fabric_tpu.observe import overlap
+
+    tr = _device_wait_tracer()
+    live = overlap.coverage_from_roots(tr.recent_roots(), window=2)
+    dump = overlap.coverage_from_trace_dump(
+        {"recent_blocks": tr.blocks(), "slow_blocks": []}, window=2
+    )
+    chrome = overlap.coverage_from_spans(
+        overlap.spans_from_chrome(tr.chrome_events()), window=2
+    )
+    assert live["blocks_measured"] == dump["blocks_measured"] \
+        == chrome["blocks_measured"] > 0
+    # block 1's wait [11, 15] is covered by block 0's commit [11, 13]
+    per = {b["block"]: b for b in live["per_block"]}
+    assert per[1]["coverage"] == pytest.approx(0.5)
+    for a, b in ((live, dump), (live, chrome)):
+        for x, y in zip(a["per_block"], b["per_block"]):
+            assert x["block"] == y["block"]
+            assert x["coverage"] == pytest.approx(y["coverage"],
+                                                  abs=1e-3)
+
+    # a dump with no t0_s anchors (pre-upgrade capture) returns None
+    old = [{k: v for k, v in b.items() if k != "t0_s"}
+           for b in tr.blocks()]
+    assert overlap.coverage_from_trace_dump(
+        {"recent_blocks": old, "slow_blocks": []}
+    ) is None
+
+
+def test_traceview_coverage_table():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "traceview", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "traceview.py",
+        ),
+    )
+    traceview = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(traceview)
+
+    tr = _device_wait_tracer()
+    dump = {"recent_blocks": tr.blocks(), "slow_blocks": [],
+            "blocks_in_ring": [b["block"] for b in tr.blocks()]}
+    text = traceview.render_coverage(dump, window=2)
+    assert "pipeline overlap coverage" in text
+    assert "device_wait" in text
+    chrome = {"traceEvents": tr.chrome_events()}
+    text2 = traceview.render_coverage(chrome, window=2)
+    assert "pipeline overlap coverage" in text2

@@ -368,6 +368,58 @@ def test_prepare_cols_out_views_match_alloc(keys, rng):
         v3.prepare_cols(*cols, pad_to=pad, out=tuple(a[:8] for a in out))
 
 
+def test_prepare_cols_packed_matches_two_phase(keys, rng):
+    """The single-pass packed staging (``prepare_cols_packed`` — the
+    serial sig_prepare host-cycle eliminator: native STRIDED int16
+    window/limb writes straight into the launch frame, one residue
+    scratch, no intermediate eight-array staging) must be BYTE-equal
+    to ``pack_cols(prepare_cols(...))`` / ``pack_cols_limbs(...)`` for
+    host digits and device limbs alike — admission flags, reject
+    lanes, out-of-range r, pad tail and all — with ``out=`` frame
+    reuse over prefilled garbage, and identical through the kernel."""
+    items = []
+    for i in range(41):
+        k = keys[i % 3]
+        e = ec_ref.digest_int(rng.bytes(16))
+        r, s = k.sign_digest(e)
+        if i % 4 == 1:
+            s = ec_ref.N - s  # high-S reject lane
+        if i % 13 == 0:
+            r = ec_ref.N + 5  # out-of-range r
+        items.append((e, r, s, *k.public))
+    n, cols = v3._to_cols(items)
+    pad = v3._bucket(n)
+    assert pad > n  # pad-tail zeroing is load-bearing
+    for recode in (False, True):
+        args = v3.prepare_cols(*cols, pad_to=pad, recode_device=recode)
+        two_phase = (v3.pack_cols_limbs(*args) if recode
+                     else v3.pack_cols(*args))
+        packed = v3.prepare_cols_packed(*cols, pad_to=pad,
+                                        recode_device=recode)
+        assert packed.dtype == np.int16
+        assert np.array_equal(two_phase, packed), recode
+        # out= reuse over garbage: every element rewritten or zeroed
+        buf = np.full(packed.shape, 77, np.int16)
+        got = v3.prepare_cols_packed(*cols, pad_to=pad,
+                                     recode_device=recode, out=buf)
+        assert got is buf and np.array_equal(buf, two_phase)
+        # mis-shaped out fails loudly
+        with pytest.raises(ValueError):
+            v3.prepare_cols_packed(*cols, pad_to=pad,
+                                   recode_device=recode,
+                                   out=buf[:, :-1].copy())
+    # empty batch: an all-zero (all-rejected) frame
+    empty = v3.prepare_cols_packed(*(c[:0] for c in cols), pad_to=16)
+    assert empty.shape == (16, v3._PK_COLS) and not empty.any()
+    # and the kernel sees the same accept set either way (the serial
+    # launch path now stages through prepare_cols_packed)
+    base = [
+        ec_ref.verify_digest((qx, qy), e, r, s)
+        for (e, r, s, qx, qy) in items[:16]
+    ]
+    assert v3.verify_launch(items[:16])() == base
+
+
 def test_prepare_cols_native_matches_python():
     """The native ec_prepare (batch inversion + window recoding +
     admission flags in C) must be bit-exact with the Python prepare

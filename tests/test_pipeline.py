@@ -313,6 +313,109 @@ def test_commit_pipeline_matches_serial(net):
     assert all(not ov for _, ov, _ in launches1)
 
 
+def test_commit_pipeline_depth3_matches_serial(net):
+    """Depth-3 over the FULL BlockValidator: a stream whose RW
+    dependencies span BOTH in-flight predecessors (k→k+1 and k→k+2
+    fresh reads, a hot key overwritten every block and read at the
+    immediate predecessor's version — merged-overlay newest-wins)
+    must equal the serial oracle in filters and state, and depth 4
+    rides along."""
+    def build_blocks(lo=2, hi=9):
+        blocks, prev = [], b"genesis"
+        for n in range(lo, hi):
+            reads = []
+            if n > lo:
+                reads.append((f"k{n-1}", (n - 1, 1)))
+                reads.append(("hot", (n - 1, 1)))
+            if n > lo + 1:
+                reads.append((f"q{n-2}", (n - 2, 1)))
+            envs = [
+                # reader FIRST: its hot read validates against the
+                # predecessor's version, not this block's own writer
+                _tx(net, reads=reads),
+                _tx(net, writes=[(f"k{n}", b"v"), (f"q{n}", b"v"),
+                                 ("hot", b"h%d" % n)]),
+            ]
+            blk = _block(n, prev, envs, pad_net=net)
+            prev = pu.block_header_hash(blk.header)
+            blocks.append(blk)
+        return blocks
+
+    blocks = build_blocks()
+
+    # serial reference
+    state_s = _state(net)
+    v_s = BlockValidator(net["mgr"], net["prov"], state_s)
+    serial = []
+    for n, b in enumerate(blocks, start=2):
+        flt, batch, _ = v_s.validate(b)
+        state_s.apply_updates(batch, (n, 0))
+        serial.append((n, list(flt)))
+    # every lane VALID: the conflict chains are all fresh by design
+    assert all(all(c == 0 for c in flt) for _, flt in serial)
+
+    for depth in (3, 4):
+        filters, state_p, launches, _ = _drive_pipeline(
+            net, blocks, depth=depth
+        )
+        assert filters == serial, f"depth {depth}"
+        assert state_p == dict(state_s._data), f"depth {depth}"
+        assert [ov for _, ov, _ in launches] == [False] + [True] * 6
+
+
+def test_commit_pipeline_depth3_merged_overlay_forced(net):
+    """Deterministic merged-overlay proof on the full validator: the
+    commits of BOTH predecessors are gated closed while block 4
+    launches, so its k→k+1, k→k+2 and hot-key reads can resolve only
+    through the merged overlay chain."""
+    import threading
+
+    blocks, prev = [], b"genesis"
+    for n in (2, 3, 4):
+        reads = []
+        if n > 2:
+            reads.append((f"k{n-1}", (n - 1, 1)))
+            reads.append(("hot", (n - 1, 1)))
+        if n > 3:
+            reads.append((f"q{n-2}", (n - 2, 1)))
+        envs = [
+            _tx(net, reads=reads),
+            _tx(net, writes=[(f"k{n}", b"v"), (f"q{n}", b"v"),
+                             ("hot", b"h%d" % n)]),
+        ]
+        blk = _block(n, prev, envs, pad_net=net)
+        prev = pu.block_header_hash(blk.header)
+        blocks.append(blk)
+
+    state = _state(net)
+    v = BlockValidator(net["mgr"], net["prov"], state)
+    gate = threading.Event()
+    committed: list = []
+
+    def commit_fn(res):
+        if res.block.header.number < 4:
+            assert gate.wait(60.0), "commit gate never opened"
+        state.apply_updates(res.batch, (res.block.header.number, 0))
+        committed.append(res.block.header.number)
+
+    filters = []
+    with CommitPipeline(v, commit_fn, depth=3) as pipe:
+        for b in blocks:
+            r = pipe.submit(b)
+            if r is not None:
+                filters.append((r.block.header.number, list(r.tx_filter)))
+        # block 4 is launched; blocks 2 and 3 are still uncommitted
+        assert committed == []
+        gate.set()
+        r = pipe.flush()
+        if r is not None:
+            filters.append((r.block.header.number, list(r.tx_filter)))
+    filters.sort()
+    assert committed == [2, 3, 4]
+    # every read resolved fresh through the merged chain
+    assert all(all(c == 0 for c in flt) for _, flt in filters)
+
+
 def test_commit_pipeline_lifecycle_barrier(net):
     """A block writing ``_lifecycle`` must commit FULLY before its
     successor launches, and the successor launches with the overlay

@@ -1396,6 +1396,170 @@ class TestUnfinishedSpan:
         assert got == []
 
 
+# -- FT011 device-buffer-lifetime --------------------------------------------
+
+BAD_BUFFER = """\
+import jax
+from fabric_tpu.parallel.mesh import shard_batch
+from fabric_tpu.ops.p256v3 import pack_cols
+
+
+def pinned_past_fetch(kern, args, handle):
+    packed = pack_cols(*args)
+    out = kern(packed)
+    return handle.fetch()
+
+
+def device_put_pinned(kern, arr, handle):
+    buf = jax.device_put(arr)
+    kern(buf)
+    res = handle.fetch()
+    return res
+
+
+def shard_pinned(mesh, kern, arr, handle):
+    sharded = shard_batch(mesh, arr)
+    kern(sharded)
+    return handle.fetch()
+"""
+
+CLEAN_BUFFER = """\
+import jax
+from fabric_tpu.ops.p256v3 import pack_cols
+
+
+def deleted_after_dispatch(kern, args, handle):
+    packed = pack_cols(*args)
+    out = kern(packed)
+    del packed
+    return handle.fetch()
+
+
+def used_after_sync(kern, args, handle):
+    packed = pack_cols(*args)
+    kern(packed)
+    bits = handle.fetch()
+    return packed.nbytes, bits
+
+
+def escapes_via_return(kern, args, handle):
+    packed = pack_cols(*args)
+    kern(packed)
+    handle.fetch()
+    return packed
+
+
+def escapes_to_container(kern, args, handles, frames):
+    packed = pack_cols(*args)
+    frames.append(packed)
+    return [h.fetch() for h in handles]
+
+
+def rebound_narrows_lifetime(kern, args, handle):
+    packed = pack_cols(*args)
+    kern(packed)
+    packed = None
+    return handle.fetch()
+
+
+def no_sync_in_scope(kern, args):
+    packed = pack_cols(*args)
+    return kern(packed)
+
+
+def in_loop_is_skipped(kern, argsets, handle):
+    for args in argsets:
+        packed = pack_cols(*args)
+        kern(packed)
+    return handle.fetch()
+
+
+def local_def_never_matches(kern, args, handle):
+    def pack_cols(*a):
+        return a
+
+    packed = pack_cols(*args)
+    kern(packed)
+    return handle.fetch()
+"""
+
+
+class TestDeviceBufferLifetime:
+    def test_flags_pinned_uploads(self, tmp_path):
+        from fabric_tpu.analysis.rules.device_buffer_lifetime import (
+            DeviceBufferLifetimeRule,
+        )
+
+        got = run_rule(tmp_path, DeviceBufferLifetimeRule(),
+                       {"mod.py": BAD_BUFFER})
+        assert [(f.rule, f.line) for f in got] == [
+            ("FT011", 7),    # pack_cols frame outlives handle.fetch()
+            ("FT011", 13),   # jax.device_put result pinned past fetch
+            ("FT011", 20),   # shard_batch result pinned past fetch
+        ]
+        assert "del" in got[0].message
+
+    def test_clean_shapes(self, tmp_path):
+        from fabric_tpu.analysis.rules.device_buffer_lifetime import (
+            DeviceBufferLifetimeRule,
+        )
+
+        got = run_rule(tmp_path, DeviceBufferLifetimeRule(),
+                       {"mod.py": CLEAN_BUFFER})
+        assert got == []
+
+    def test_local_def_shadow_never_matches(self, tmp_path):
+        # a module with NO qualifying imports never produces findings,
+        # even with the same call names (the FT003 lesson)
+        from fabric_tpu.analysis.rules.device_buffer_lifetime import (
+            DeviceBufferLifetimeRule,
+        )
+
+        src = "\n".join([
+            "def pack_cols(*a):",
+            "    return a",
+            "",
+            "def f(kern, args, handle):",
+            "    packed = pack_cols(*args)",
+            "    kern(packed)",
+            "    return handle.fetch()",
+            "",
+        ])
+        got = run_rule(tmp_path, DeviceBufferLifetimeRule(),
+                       {"mod.py": src})
+        assert got == []
+
+    def test_test_code_exempt(self, tmp_path):
+        from fabric_tpu.analysis.rules.device_buffer_lifetime import (
+            DeviceBufferLifetimeRule,
+        )
+
+        got = run_rule(tmp_path, DeviceBufferLifetimeRule(), {
+            "test_mod.py": BAD_BUFFER,
+            "tests/helper.py": BAD_BUFFER,
+            "conftest.py": BAD_BUFFER,
+        })
+        assert got == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        from fabric_tpu.analysis.rules.device_buffer_lifetime import (
+            DeviceBufferLifetimeRule,
+        )
+
+        src = "\n".join([
+            "from fabric_tpu.ops.p256v3 import pack_cols",
+            "",
+            "def f(kern, args, handle):",
+            "    packed = pack_cols(*args)  # fabtpu: noqa(FT011)",
+            "    kern(packed)",
+            "    return handle.fetch()",
+            "",
+        ])
+        got = run_rule(tmp_path, DeviceBufferLifetimeRule(),
+                       {"mod.py": src})
+        assert got == []
+
+
 def test_rule_battery_registered():
     from fabric_tpu.analysis import all_rules
 
@@ -1411,4 +1575,5 @@ def test_rule_battery_registered():
         "FT008": "asyncio-task-leak",
         "FT009": "unbounded-blocking-wait",
         "FT010": "unfinished-span",
+        "FT011": "device-buffer-lifetime",
     }
