@@ -122,7 +122,8 @@ def _bench_sha256():
 
 def _build_commit_network(n_tx: int, n_blocks: int = 1,
                           invalid_frac: float = 0.0,
-                          validator_kwargs: dict | None = None):
+                          validator_kwargs: dict | None = None,
+                          block_plan: list | None = None):
     """3 orgs, 2-of-3 endorsement policy, a STREAM of ``n_blocks``
     blocks of n_tx signed txs each, reading seeded keys and writing
     fresh ones — the BASELINE.json config-#2 workload (1000-tx blocks
@@ -131,7 +132,12 @@ def _build_commit_network(n_tx: int, n_blocks: int = 1,
     ``invalid_frac``: fraction of txs made invalid (half broken
     creator signatures, half stale reads) — the commit path pays for
     failures too, and the perf number must survive adversarial
-    traffic."""
+    traffic.
+
+    ``block_plan``: optional per-block [(n_tx, invalid_frac)] — the
+    bursty bench's mixed block sizes + seeded invalid-sig storms;
+    overrides ``n_tx``/``n_blocks``/``invalid_frac`` and makes the
+    returned ``n_invalid`` a PER-BLOCK list."""
     from fabric_tpu import protoutil as pu
     from fabric_tpu.crypto import cryptogen, policy as pol
     from fabric_tpu.crypto.msp import MSPManager
@@ -158,20 +164,36 @@ def _build_commit_network(n_tx: int, n_blocks: int = 1,
     )
     prov = PolicyProvider({CC: NamespaceInfo(policy=policy)})
 
+    import math
+
+    if block_plan is None:
+        plan = [(n_tx, invalid_frac)] * n_blocks
+    else:
+        plan = [(int(t), float(f)) for t, f in block_plan]
+        n_blocks = len(plan)
+
     seed = UpdateBatch()
-    for b in range(n_blocks):
-        for i in range(n_tx):
+    for b, (b_tx, _f) in enumerate(plan):
+        for i in range(b_tx):
             seed.put(CC, f"seed{b}_{i:05d}", b"genesis", (1, 0))
             seed.put(CC, f"ro{b}_{i:05d}", b"genesis", (1, 0))
 
-    import math
+    def _stride(frac):
+        return math.inf if frac <= 0 else max(2, round(1 / frac))
 
-    stride = math.inf if invalid_frac <= 0 else max(2, round(1 / invalid_frac))
-    n_invalid_per_block = 0 if stride == math.inf else len(range(0, n_tx, int(stride)))
+    n_invalid_list = [
+        0 if _stride(f) == math.inf
+        else len(range(0, t, int(_stride(f))))
+        for t, f in plan
+    ]
+    n_invalid_per_block = (
+        n_invalid_list if block_plan is not None else n_invalid_list[0]
+    )
     blocks, prev = [], b""
-    for b in range(n_blocks):
+    for b, (b_tx, b_frac) in enumerate(plan):
+        stride = _stride(b_frac)
         envs = []
-        for i in range(n_tx):
+        for i in range(b_tx):
             _, _, prop = txa.create_signed_proposal(client, CHANNEL, CC, [b"invoke"])
             tx = TxRWSet()
             ns = tx.ns_rwset(CC)
@@ -1152,6 +1174,281 @@ def _bench_block_commit_sidecar(n_tx: int = 200, n_blocks: int = 12):
     }
 
 
+def _bench_block_commit_bursty(n_blocks: int = 18,
+                               seed: int = 20260804):
+    """p99 UNDER OVERLOAD as a tracked number (ISSUE 11): an
+    OPEN-LOOP bursty stream — block arrivals ride a fixed schedule
+    that does NOT wait for the server, so backlog shows up as latency
+    (arrival → commit), exactly what a closed-loop bench hides —
+    through the loopback validation sidecar, with:
+
+    * **mixed block sizes** (alternating large/small blocks);
+    * **seeded invalid-sig storms**: a ``faults/`` FaultPlan decides
+      which blocks arrive with ~half their creator signatures broken
+      (deterministic replay per seed) — invalid lanes cost the full
+      verify + reject path;
+    * **config churn**: scripted mid-stream runtime re-knob pulses
+      (pipeline depth up then back, coalesce toggled) through the new
+      block-boundary setters — the safe-re-knobbing path under load;
+    * ``FABTPU_BENCH_AUTOPILOT=1``: a live traffic autopilot
+      (fabric_tpu/control) reads the run's SLO burns + scheduler
+      telemetry and actuates shed/weights/coalesce — ON-vs-OFF is one
+      env flip, and the end-of-run actuation log lands in extras.
+
+    Reports per-tenant p50/p99/max ARRIVAL→commit latency, shed/BUSY
+    counts off the scheduler, the SLO burn snapshot, and asserts the
+    committed accept set equals the build plan's fault-free
+    expectation for every block (shed requests fall back to the local
+    CPU lane — liveness and verdicts are never traded)."""
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from fabric_tpu import observe as _observe
+    from fabric_tpu.control import Autopilot
+    from fabric_tpu.faults import FaultPlan, InjectedFault
+    from fabric_tpu.ledger.kvledger import KVLedger
+    from fabric_tpu.observe import slo as _slo
+    from fabric_tpu.ops_metrics import Registry as _Registry
+    from fabric_tpu.peer.pipeline import CommitPipeline
+    from fabric_tpu.protos import common_pb2
+    from fabric_tpu.sidecar.validator import SidecarValidator
+
+    import os
+
+    autopilot_on = os.environ.get("FABTPU_BENCH_AUTOPILOT", "0") == "1"
+    knobs = _bench_knobs()
+
+    # seeded storm plan: which blocks arrive as an invalid-sig storm
+    # (the faults registry supplies the deterministic replay; the
+    # corruption itself is real broken creator signatures)
+    storm_plan = FaultPlan("bursty.storm:raise:p=0.35:after=3",
+                          seed=seed)
+    block_plan = []
+    storm_blocks = []
+    for b in range(n_blocks):
+        storm = False
+        try:
+            storm_plan.fire("bursty.storm", block=b)
+        except InjectedFault:
+            storm = True
+            storm_blocks.append(b)
+        n_tx = 600 if b % 3 == 0 else 150  # mixed block sizes
+        block_plan.append((n_tx, 0.5 if storm else 0.0))
+    (blocks, fresh_state, _fv, mgr, prov, _,
+     n_invalid) = _build_commit_network(0, block_plan=block_plan)
+    expected_valid = sum(
+        t - bad for (t, _f), bad in zip(block_plan, n_invalid)
+    )
+
+    host = _SidecarHost(queue_blocks=4, coalesce=4)
+    # open-loop arrival schedule: the bursty tenant fires well above
+    # the 2-core container's service rate during storms; the steady
+    # tenant paces modestly — its p99 is the collateral-damage number
+    arrivals = {
+        "bursty": [0.05 * b for b in range(n_blocks)],
+        "steady": [0.40 * b for b in range(n_blocks)],
+    }
+    results: dict = {}
+    errors: list = []
+    pipes: dict = {}
+    validators: dict = {}
+
+    def drive(name: str, weight: float):
+        state = fresh_state()
+        v = SidecarValidator(
+            mgr, prov, state,
+            sidecar_endpoint=f"127.0.0.1:{host.port}",
+            sidecar_weight=weight, channel=name,
+            sidecar_fail_threshold=1, sidecar_recovery_s=0.5,
+            sidecar_timeout_s=30.0,
+        )
+        validators[name] = v
+        stream = []
+        for blk in blocks:
+            b = common_pb2.Block()
+            b.CopyFrom(blk)
+            stream.append(b)
+        tmp = tempfile.mkdtemp(prefix=f"benchbursty-{name}")
+        lg = KVLedger(tmp, state_db=state, enable_history=True)
+        commit_t: dict[int, float] = {}
+        arrive_t: dict[int, float] = {}
+
+        def commit_fn(res):
+            lg.commit_block(res.block, res.tx_filter, res.batch,
+                            res.history, None, res.txids,
+                            res.pend.hd_bytes)
+            commit_t[res.block.header.number] = time.perf_counter()
+
+        try:
+            with CommitPipeline(v, commit_fn, depth=2,
+                                channel=name) as pipe:
+                pipes[name] = pipe
+                t0 = time.perf_counter()
+                for b in stream:
+                    n = b.header.number
+                    # OPEN LOOP: wait for the schedule, never for the
+                    # server — a backlog shows up as latency
+                    delay = t0 + arrivals[name][n] - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                    arrive_t[n] = time.perf_counter()
+                    # config churn: scripted runtime re-knob pulses at
+                    # fixed stream positions exercise the
+                    # block-boundary setters under load (the autopilot
+                    # layers its own actuations on top when armed)
+                    if name == "bursty" and n == n_blocks // 3:
+                        pipe.set_depth(3)
+                        v.set_verify_chunk(1024)
+                    if name == "bursty" and n == 2 * n_blocks // 3:
+                        pipe.set_depth(2)
+                        v.set_verify_chunk(0)
+                    pipe.submit(b)
+                pipe.flush()
+            # ledger accept set ≡ the build plan's fault-free
+            # expectation: overload machinery must shed REQUESTS
+            # (to BUSY + CPU fallback), never correctness
+            from fabric_tpu import protoutil as pu
+
+            got_valid = 0
+            for n in range(lg.height):
+                flt = pu.get_tx_filter(lg.blocks.get_block(n))
+                got_valid += sum(1 for c in flt if c == 0)
+            assert lg.height == n_blocks, (name, lg.height, n_blocks)
+            assert got_valid == expected_valid, (
+                name, got_valid, expected_valid
+            )
+            lats = sorted(
+                commit_t[n] - arrive_t[n]
+                for n in commit_t if n in arrive_t and n >= 2
+            )
+            results[name] = {
+                "lats": lats,
+                "fallback_s": v.sidecar_guard.degraded_seconds(),
+            }
+        except Exception as e:  # surfaced after join
+            errors.append(f"{name}: {type(e).__name__}: {e}")
+        finally:
+            pipes.pop(name, None)
+            validators.pop(name, None)
+            v.close()
+            lg.close()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    slo_engine = _slo.SloEngine(
+        _slo.parse_slos(
+            "commit:latency:ms=1500:target=0.9:windows=600:"
+            "min_events=3;"
+            "busy:busy:pct=10:windows=600:min_events=3"
+        ),
+        registry=_Registry(),
+    )
+    _observe.global_tracer().add_listener(slo_engine.on_block)
+    pilot = None
+    if autopilot_on:
+        def _apply(knob, value):
+            if knob == "verify_chunk":
+                for v in list(validators.values()):
+                    v.set_verify_chunk(value)
+                return
+            for pipe in list(pipes.values()):
+                if knob == "coalesce_blocks":
+                    pipe.set_coalesce_blocks(value)
+                elif knob == "pipeline_depth":
+                    pipe.set_depth(value)
+
+        pilot = Autopilot(
+            None, _apply,
+            set_weight=host.server.scheduler.set_weight,
+            set_shed=host.server.scheduler.set_shed,
+            slo=slo_engine, scheduler=host.server.scheduler,
+            tick_s=0.25, registry=_Registry(),
+            bands={"shed_hi": 2.0, "burn_hi": 1.2},
+        )
+        host.server.autopilot = pilot
+        pilot.start()
+    tenants = [("bursty", 1.0), ("steady", 1.0)]
+    try:
+        threads = [
+            threading.Thread(target=drive, args=t, daemon=True)
+            for t in tenants
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=1200.0)
+        hung = [t.name for t in threads if t.is_alive()]
+        dt = time.perf_counter() - t0
+        sched_stats = host.server.scheduler.stats()
+        host.stop_server()
+    finally:
+        if pilot is not None:
+            pilot.stop()
+        _observe.global_tracer().remove_listener(slo_engine.on_block)
+        host.close()
+    assert not hung, f"tenant drive thread(s) timed out: {hung}"
+    assert not errors, errors
+
+    def pcts(name):
+        arr = np.asarray(results[name]["lats"])
+        if not len(arr):
+            return None
+        return {
+            "p50": round(float(np.percentile(arr, 50)) * 1000, 2),
+            "p99": round(float(np.percentile(arr, 99)) * 1000, 2),
+            "max": round(float(arr.max()) * 1000, 2),
+            "n_measured": int(len(arr)),
+        }
+
+    total = 2 * sum(t for t, _f in block_plan)
+    return {
+        "metric": f"bursty_tx_per_sec_2tenants_{n_blocks}blocks",
+        "value": round(total / dt, 1),
+        "unit": "tx/s",
+        "vs_baseline": 1.0,  # self-contained overload scenario
+        "extras": {
+            "autopilot": autopilot_on,
+            "open_loop_arrival_s": {
+                k: v[1] - v[0] for k, v in arrivals.items()
+            },
+            "storm_blocks": storm_blocks,
+            "storm_plan": storm_plan.stats(),
+            "storm_seed": seed,
+            "block_sizes": [t for t, _f in block_plan],
+            "latency_arrival_to_commit_ms": {
+                name: pcts(name) for name, _w in tenants
+            },
+            "shed_busy": {
+                name: {
+                    "shed_count": sched_stats.get(name, {}).get(
+                        "shed_count", 0
+                    ),
+                    "rejected": sched_stats.get(name, {}).get(
+                        "rejected", 0
+                    ),
+                    "busy_rate": sched_stats.get(name, {}).get(
+                        "busy_rate", 0.0
+                    ),
+                    "local_fallback_s": round(
+                        results[name]["fallback_s"], 4
+                    ),
+                }
+                for name, _w in tenants
+            },
+            "slo": slo_engine.report(),
+            "actuations": (
+                [d.to_dict() for d in pilot.decisions]
+                if pilot is not None else []
+            ),
+            "scheduler": sched_stats,
+            "knobs": knobs,
+        },
+    }
+
+
 def _bench_host_stage_micro(B: int = 3072, n_keys: int = 2048,
                             reps: int = 15):
     """Standalone stage micro-bench for the host-cycle-elimination
@@ -1294,6 +1591,11 @@ _BENCHES = {
     # validation sidecar — aggregate tx/s, per-tenant p50/p99, and a
     # weighted fairness index
     "block_commit_sidecar": _bench_block_commit_sidecar,
+    # ISSUE 11 overload story: OPEN-LOOP bursty arrivals + seeded
+    # invalid-sig storms + config churn through the sidecar, with
+    # FABTPU_BENCH_AUTOPILOT=0/1 flipping the traffic autopilot —
+    # p99-under-overload, shed/BUSY counts, and the actuation log
+    "block_commit_bursty": _bench_block_commit_bursty,
     # crypto-free standalone stage micro-bench: the host-cycle
     # elimination acceptance numbers (sig_prepare packed single-pass
     # vs two-phase; state_fill fused column gather vs dict path)
@@ -1317,7 +1619,8 @@ def main():
     name = sys.argv[1] if len(sys.argv) > 1 else "block_commit"
     if name in ("block_commit", "block_commit_mixed",
                 "block_commit_sustained", "block_commit_chaos",
-                "block_commit_sidecar", "p256_verify"):
+                "block_commit_sidecar", "block_commit_bursty",
+                "p256_verify"):
         # these benches need the `cryptography` package for the
         # OpenSSL CPU baseline and the cert-based test network — on
         # containers without it, report a skip instead of crashing at

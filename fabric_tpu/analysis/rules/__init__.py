@@ -14,6 +14,7 @@ them); slugs are the human-facing names:
     FT009 unbounded-blocking-wait  no-timeout Future/Queue/Event/Thread waits
     FT010 unfinished-span        begin_block roots with no reachable finish
     FT011 device-buffer-lifetime  packed uploads pinned past their fetch
+    FT012 pvtdata-purge-race     store writers racing the BTL purge walk
 """
 
 from fabric_tpu.analysis.rules import (  # noqa: F401
@@ -24,6 +25,7 @@ from fabric_tpu.analysis.rules import (  # noqa: F401
     jit_purity,
     kernel_dtype,
     lock_discipline,
+    pvtdata_purge_race,
     retrace_hazard,
     swallowed_exception,
     unfinished_span,

@@ -1,0 +1,250 @@
+"""FT012 pvtdata-purge-race: store writers racing the BTL purge walk.
+
+The pvtdata/transient stores (``ledger/pvtdata.py``,
+``peer/transient.py``) share ONE sqlite connection each
+(``check_same_thread=False``) between their writers (``persist``,
+``resolve_missing``, ``commit_block``) and their purge walks
+(``purge_expired`` — the BTL expiry SELECT-then-DELETE whose returned
+rows drive the private-STATE erase — and ``purge_below``).  The purge
+walk is not atomic against a concurrent writer: a row inserted between
+the walk's SELECT and its DELETE is deleted without ever being
+returned, so the corresponding private state is never erased (or, for
+the transient store, endorsement cleartext written during the walk is
+silently dropped below the retention line).  The repo's discipline is
+that writers and purges serialize on the event-loop thread / the
+commit lock; this rule polices the discipline.
+
+Mechanics (strictly under-approximating, per the FT003..FT011
+contract — a finding is always real):
+
+1. **Family match by receiver** — within one function scope, find
+   attribute calls ``<recv>.purge_expired(...)`` /
+   ``<recv>.purge_below(...)`` and attribute uses of
+   ``<recv>.persist`` / ``<recv>.resolve_missing`` /
+   ``<recv>.commit_block`` where ``<recv>`` is the SAME dotted
+   receiver (``self.transient``, ``store``, ``ch.ledger.pvtdata``).
+   The receiver pairing is what keeps the writer names honest:
+   ``commit_block`` exists on ledgers and block stores too, but only
+   the pvt stores also have a purge method on the same object.
+2. **Concurrent dispatch** — flag only when at least one of the two
+   family uses is handed to another thread, resolved IMPORT-AWARE
+   (the FT003 lesson — a same-named local helper never matches):
+
+   * ``threading.Thread(...)`` (module alias or bare from-import),
+   * ``<executor>.submit(...)`` where the executor local was assigned
+     from ``ThreadPoolExecutor``/``ProcessPoolExecutor``
+     (concurrent.futures, aliases and from-imports tracked),
+   * ``<loop>.run_in_executor(...)``,
+   * ``asyncio.run_coroutine_threadsafe(...)`` / ``asyncio.to_thread
+     (...)`` (aliases and from-imports tracked).
+
+   A family use *inside a dispatcher call's arguments* (a bound
+   method reference, or a use inside a ``lambda`` argument) counts as
+   dispatched.  Both-inline uses never flag — same-thread sequencing
+   is exactly the discipline.
+3. **Test code is exempt** (``tests/``, ``test_*.py``,
+   ``conftest.py``) — tests race writers against the purge walk on
+   purpose to pin recovery behavior.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import (
+    Finding,
+    ModuleCtx,
+    Rule,
+    dotted_name,
+    register,
+    walk_functions,
+)
+
+_PURGE = {"purge_expired", "purge_below"}
+_WRITERS = {"persist", "resolve_missing", "commit_block"}
+_EXECUTOR_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+_ASYNCIO_DISPATCH = {"run_coroutine_threadsafe", "to_thread"}
+
+
+def _bindings(tree: ast.Module):
+    """Import map: (threading aliases, asyncio aliases,
+    concurrent.futures aliases, bare Thread names, bare asyncio
+    dispatch names, bare executor ctor names)."""
+    threading_alias: set[str] = set()
+    asyncio_alias: set[str] = set()
+    cf_alias: set[str] = set()
+    bare_thread: set[str] = set()
+    bare_async: set[str] = set()
+    bare_ctor: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                base = a.asname or a.name.split(".")[0]
+                if a.name == "threading":
+                    threading_alias.add(base)
+                elif a.name == "asyncio":
+                    asyncio_alias.add(base)
+                elif a.name in ("concurrent.futures", "concurrent"):
+                    cf_alias.add(base)
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for a in node.names:
+                name = a.asname or a.name
+                if mod == "threading" and a.name == "Thread":
+                    bare_thread.add(name)
+                elif mod == "asyncio" and a.name in _ASYNCIO_DISPATCH:
+                    bare_async.add(name)
+                elif (mod == "concurrent.futures"
+                        and a.name in _EXECUTOR_CTORS):
+                    bare_ctor.add(name)
+    return (threading_alias, asyncio_alias, cf_alias, bare_thread,
+            bare_async, bare_ctor)
+
+
+def _walk_own(scope: ast.AST):
+    """A scope's own nodes; nested function defs are their own scopes
+    (lambdas are NOT skipped — a lambda handed to a dispatcher runs on
+    the dispatcher's thread and belongs to this scope's analysis)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _executor_locals(scope: ast.AST, cf_alias: set, bare_ctor: set) -> set:
+    """Local names assigned from ThreadPoolExecutor/ProcessPoolExecutor
+    calls (import-aware) — their ``.submit`` dispatches to a worker."""
+    out: set[str] = set()
+    for node in _walk_own(scope):
+        if not (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        name = dotted_name(node.value.func)
+        if name is None:
+            continue
+        parts = name.split(".")
+        if len(parts) == 1 and parts[0] in bare_ctor:
+            out.add(node.targets[0].id)
+        elif (len(parts) >= 2 and parts[0] in cf_alias
+                and parts[-1] in _EXECUTOR_CTORS):
+            out.add(node.targets[0].id)
+    return out
+
+
+def _is_dispatcher(call: ast.Call, binds, executor_locals: set) -> bool:
+    (threading_alias, asyncio_alias, _cf, bare_thread, bare_async,
+     _bc) = binds
+    name = dotted_name(call.func)
+    if name is None:
+        return False
+    parts = name.split(".")
+    if len(parts) == 1:
+        return parts[0] in bare_thread or parts[0] in bare_async
+    if len(parts) == 2:
+        head, attr = parts
+        if head in threading_alias and attr == "Thread":
+            return True
+        if head in asyncio_alias and attr in _ASYNCIO_DISPATCH:
+            return True
+        if head in executor_locals and attr == "submit":
+            return True
+        if attr == "run_in_executor":
+            # loop.run_in_executor: the attr name is asyncio-specific
+            # enough that any receiver is a real event loop in practice
+            return True
+    return False
+
+
+def _family_uses(scope: ast.AST, binds, executor_locals: set):
+    """→ {recv: {"purge": [(line, dispatched)],
+                 "write": [(line, dispatched)]}} over the scope.
+
+    ``dispatched`` = the use sits inside a dispatcher call's argument
+    subtree (bound-method handoff or lambda body)."""
+    parents: dict[int, ast.AST] = {}
+    for node in ast.walk(scope):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+
+    def dispatched(node: ast.AST) -> bool:
+        cur = node
+        while True:
+            parent = parents.get(id(cur))
+            if parent is None or isinstance(
+                    parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            if (isinstance(parent, ast.Call) and cur is not parent.func
+                    and _is_dispatcher(parent, binds, executor_locals)):
+                return True
+            cur = parent
+
+    out: dict[str, dict] = {}
+    for node in _walk_own(scope):
+        if not isinstance(node, ast.Attribute):
+            continue
+        recv = dotted_name(node.value)
+        if recv is None:
+            continue
+        if node.attr in _PURGE:
+            kind = "purge"
+        elif node.attr in _WRITERS:
+            kind = "write"
+        else:
+            continue
+        entry = out.setdefault(recv, {"purge": [], "write": []})
+        entry[kind].append((node.lineno, dispatched(node)))
+    return out
+
+
+@register
+class PvtdataPurgeRaceRule(Rule):
+    id = "FT012"
+    name = "pvtdata-purge-race"
+    severity = "error"
+    description = (
+        "flags pvt/transient store writers (persist / resolve_missing "
+        "/ commit_block) dispatched to another thread while the same "
+        "store's BTL purge walk (purge_expired / purge_below) runs in "
+        "the same scope — the walk's SELECT-then-DELETE is not atomic "
+        "against concurrent writers on the shared sqlite connection"
+    )
+
+    def check_module(self, ctx: ModuleCtx) -> list[Finding]:
+        rel = ctx.relpath
+        base = rel.rsplit("/", 1)[-1]
+        if ("tests/" in rel or rel.startswith("tests")
+                or base.startswith("test_") or base == "conftest.py"):
+            return []
+        binds = _bindings(ctx.tree)
+        out: list[Finding] = []
+        for scope in [ctx.tree] + list(walk_functions(ctx.tree)):
+            executor_locals = _executor_locals(scope, binds[2], binds[5])
+            for recv, uses in _family_uses(
+                    scope, binds, executor_locals).items():
+                purges, writes = uses["purge"], uses["write"]
+                if not purges or not writes:
+                    continue
+                if not any(d for _l, d in purges + writes):
+                    continue  # both inline = serialized by the thread
+                wline = min(l for l, _d in writes)
+                for pline, _d in sorted(set(purges)):
+                    out.append(self.finding(
+                        ctx, pline, 0,
+                        f"'{recv}' purge walk races a writer "
+                        f"dispatched to another thread in this scope "
+                        f"(writer at line {wline}): the walk's "
+                        "SELECT-then-DELETE is not atomic against "
+                        "concurrent inserts on the shared sqlite "
+                        "connection — a row written mid-walk is "
+                        "purged without its state erase (or dropped "
+                        "below the retention line); serialize both "
+                        "on one thread/lock or move them onto the "
+                        "same executor",
+                    ))
+        return out

@@ -151,6 +151,9 @@ async def _run_peer(cfg):
         trace_ring_blocks=cfg.trace_ring_blocks,
         trace_slow_factor=cfg.trace_slow_factor,
         slos=cfg.slos,
+        autopilot=cfg.autopilot,
+        autopilot_tick_s=cfg.autopilot_tick_s,
+        autopilot_knobs=cfg.autopilot_knobs,
         device_fail_threshold=cfg.device_fail_threshold,
         device_retries=cfg.device_retries,
         device_recovery_s=cfg.device_recovery_s,

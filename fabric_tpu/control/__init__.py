@@ -1,0 +1,16 @@
+"""fabric_tpu.control — the traffic autopilot: closed-loop overload
+control over the SLO burn-rate engine and the scheduler telemetry
+(autopilot.py)."""
+
+from fabric_tpu.control.autopilot import (  # noqa: F401
+    DEFAULT_BANDS,
+    DEFAULT_KNOB_SPECS,
+    Autopilot,
+    Decision,
+    KnobSpec,
+    KnobSpecError,
+    Signals,
+    global_autopilot,
+    parse_knob_specs,
+    set_global,
+)

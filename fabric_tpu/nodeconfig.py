@@ -210,6 +210,23 @@ class PeerConfig:
     sidecar_listen: str = ""
     sidecar_queue_blocks: int = 8
     sidecar_coalesce: int = 4
+    # traffic autopilot (fabric_tpu/control/autopilot.py): closed-loop
+    # overload control — a periodic controller reads trailing SLO burn
+    # rates, scheduler queue-age/BUSY telemetry and pipeline overlap
+    # coverage, and actuates coalesce_blocks / verify_chunk /
+    # pipeline_depth / sidecar tenant weights + shed mode through
+    # their runtime setters, governed by hysteresis bands, per-knob
+    # cooldowns, a max-one-step-per-tick rule and hard clamps.  OFF by
+    # default: tier-1 and CPU hosts keep the exact static path.
+    autopilot: bool = False
+    # seconds between controller ticks (the decision cadence; each
+    # tick actuates at most one knob step)
+    autopilot_tick_s: float = 1.0
+    # per-knob min/max clamp spec (autopilot.parse_knob_specs), e.g.
+    # 'coalesce_blocks:min=0:max=8;verify_chunk:min=512:max=4096;
+    # pipeline_depth:min=2:max=4;weight:min=0.125:max=8'.  Empty =
+    # the validated defaults; named knobs override per-key.
+    autopilot_knobs: str = ""
     # chaos fault plan (fabric_tpu/faults): spec string arming named
     # injection points, e.g.
     # 'validator.verify_launch:raise:n=3;deliver.read:disconnect:n=1'.
@@ -455,6 +472,21 @@ def _load(cls, source, environ=None):
             f"key 'host_stage_mode': must be 'thread' or 'process', "
             f"got {cfg.host_stage_mode!r}"
         )
+    if isinstance(cfg, PeerConfig) and cfg.autopilot_tick_s <= 0:
+        raise ConfigError(
+            f"key 'autopilot_tick_s': must be > 0 seconds, "
+            f"got {cfg.autopilot_tick_s}"
+        )
+    if isinstance(cfg, PeerConfig) and (cfg.autopilot
+                                        or cfg.autopilot_knobs):
+        # validate the knob-clamp spec HERE so a typo surfaces as an
+        # operator-grade config error, not an exception mid-start
+        from fabric_tpu.control import KnobSpecError, parse_knob_specs
+
+        try:
+            parse_knob_specs(cfg.autopilot_knobs)
+        except KnobSpecError as e:
+            raise ConfigError(f"key 'autopilot_knobs': {e}") from None
     if isinstance(cfg, PeerConfig) and cfg.slos:
         # validate the SLO spec HERE so a typo surfaces as an
         # operator-grade config error, not an exception mid-start

@@ -43,7 +43,12 @@ common keys: ``target=`` overrides the good-fraction objective
 (latency default 0.99), ``windows=<s1>,<s2>,...`` the rolling windows
 in seconds (default 60,300; the shortest is the fast-burn window),
 ``fast=`` the fast-burn threshold (default 14.0; 0 disables the
-WARN), ``channel=`` restricts the objective to one channel/tenant.
+WARN), ``channel=`` restricts the objective to one channel/tenant,
+``min_events=`` the per-window cold-start floor (default 5): a window
+holding fewer events reports burn ``None`` — one bad block on a
+freshly started peer is statistically nothing, and it must not fire a
+fast-burn WARN (or trip the traffic autopilot) before the window has
+a real sample.  Set ``min_events=1`` to restore the raw behavior.
 
 The engine is stdlib-only, locked, and clock-injectable (tests drive
 burn-up and recovery without sleeping).  Like the tracer it rides,
@@ -64,6 +69,10 @@ _log = logging.getLogger("fabric_tpu.observe.slo")
 DEFAULT_WINDOWS = (60.0, 300.0)
 DEFAULT_TARGET = 0.99
 DEFAULT_FAST_BURN = 14.0
+#: cold-start floor: a window holding fewer events than this reports
+#: burn None — one bad block in a near-empty window must not read as
+#: burn ≥ 1 (or WARN) on a freshly started peer
+DEFAULT_MIN_EVENTS = 5
 _KINDS = ("latency", "busy")
 
 #: events retained per (objective, channel) series — bounds memory
@@ -88,6 +97,7 @@ class Objective:
     windows: tuple = DEFAULT_WINDOWS
     fast: float = DEFAULT_FAST_BURN
     channel: str = ""            # "" = every channel
+    min_events: int = DEFAULT_MIN_EVENTS  # per-window cold-start floor
 
     @property
     def budget(self) -> float:
@@ -140,6 +150,8 @@ def parse_slos(spec: str) -> list[Objective]:
                     )
                 elif k == "channel":
                     kw["channel"] = v.strip()
+                elif k == "min_events":
+                    kw["min_events"] = int(v)
                 else:
                     raise SloError(
                         f"slo spec {part!r}: unknown key {k!r}"
@@ -169,6 +181,10 @@ def parse_slos(spec: str) -> list[Objective]:
         if not (0 < kw.get("target", DEFAULT_TARGET) < 1):
             raise SloError(
                 f"slo spec {part!r}: target must be in (0, 1)"
+            )
+        if kw.get("min_events", DEFAULT_MIN_EVENTS) < 1:
+            raise SloError(
+                f"slo spec {part!r}: min_events must be >= 1"
             )
         out.append(Objective(name=name, kind=kind, **kw))
     return out
@@ -223,6 +239,11 @@ class SloEngine:
         attrs = root.attrs
         channel = str(attrs.get("channel", "") or "")
         ns = attrs.get("ns", "")
+        if ns == "autopilot":
+            # controller decision events ride the tracer for the
+            # actuation trail — they are control plane, not traffic,
+            # and must not dilute any latency series
+            return
         busy = bool(attrs.get("busy"))
         dur_ms = root.dur * 1000.0
         for o in self.objectives:
@@ -291,6 +312,38 @@ class SloEngine:
                 return None
             return _burns(o, s.events, now).get(window)
 
+    def burns(self, window: float | None = None) -> dict:
+        """{(objective_name, channel): burn | None} across every live
+        series, recomputed at call time on the fast (or given)
+        window — the traffic autopilot's error-signal read.  Cheap:
+        one lock to snapshot, per-series reverse walk bounded by the
+        window."""
+        now = self.clock()
+        with self._lock:
+            objectives = self.objectives
+            series = {
+                k: list(s.events) for k, s in self._series.items()
+            }
+        out: dict = {}
+        for o in objectives:
+            w = o.windows[0] if window is None else float(window)
+            floor = max(1, o.min_events)
+            for (name, channel), events in series.items():
+                if name != o.name:
+                    continue
+                lo = now - w
+                total = bad = 0
+                for t, good in reversed(events):
+                    if t < lo:
+                        break
+                    total += 1
+                    if not good:
+                        bad += 1
+                out[(name, channel)] = (
+                    (bad / total / o.budget) if total >= floor else None
+                )
+        return out
+
     def report(self) -> dict:
         """JSON-able snapshot (the ``/slo`` endpoint and bench extras):
         every objective, per-channel window burns recomputed at call
@@ -353,8 +406,11 @@ class SloEngine:
 
 def _burns(o: Objective, events, now: float) -> dict:
     """{window_s: burn | None} over one series — None when the window
-    holds no events (no traffic is not a violation)."""
+    holds fewer than ``o.min_events`` events (no traffic is not a
+    violation, and a near-empty window is no sample: one bad block on
+    a freshly started peer must not read as burn ≥ 1)."""
     out: dict = {}
+    floor = max(1, o.min_events)
     for w in o.windows:
         lo = now - w
         total = bad = 0
@@ -364,7 +420,7 @@ def _burns(o: Objective, events, now: float) -> dict:
             total += 1
             if not good:
                 bad += 1
-        out[w] = (bad / total / o.budget) if total else None
+        out[w] = (bad / total / o.budget) if total >= floor else None
     return out
 
 

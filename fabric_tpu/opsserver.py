@@ -47,7 +47,7 @@ class OperationsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  registry: Registry | None = None,
                  health: HealthRegistry | None = None,
-                 tracer=None, slo=None):
+                 tracer=None, slo=None, autopilot=None):
         self.host, self.port = host, port
         self.registry = registry or global_registry()
         self.health = health or HealthRegistry()
@@ -61,6 +61,10 @@ class OperationsServer:
 
             slo = global_engine()
         self.slo = slo        # /slo: the burn-rate engine
+        # /autopilot: the traffic controller (None = resolve the
+        # process-global handle lazily per request, so a controller
+        # armed after the ops server starts is still served)
+        self.autopilot = autopilot
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self):
@@ -156,6 +160,19 @@ class OperationsServer:
         if path == "/slo" or path.startswith("/slo?"):
             return 200, "application/json", json.dumps(
                 self.slo.report()
+            ).encode()
+        if path == "/autopilot" or path.startswith("/autopilot?"):
+            ap = self.autopilot
+            if ap is None:
+                from fabric_tpu.control import global_autopilot
+
+                ap = global_autopilot()
+            if ap is None:
+                return 200, "application/json", json.dumps(
+                    {"enabled": False, "configured": False}
+                ).encode()
+            return 200, "application/json", json.dumps(
+                {"configured": True, **ap.report()}
             ).encode()
         if path.startswith("/debug/"):
             return self._route_debug(path)

@@ -228,6 +228,40 @@ class PeerChannel:
         self.commit_lock = AsyncRWLock()
         self._height_changed = asyncio.Event()
         self._deliver_task: asyncio.Task | None = None
+        # the live CommitPipeline while the deliver driver runs — the
+        # traffic autopilot actuates runtime knobs through it
+        # (apply_knob); None between deliver sessions
+        self.pipe = None
+
+    # -- runtime re-knobbing (the traffic autopilot's actuator) ----------
+
+    def apply_knob(self, knob: str, value) -> None:
+        """Apply one autopilot knob step to this channel's live commit
+        path.  Every setter latches and applies at a block boundary
+        (pipeline.set_depth / set_coalesce_blocks, validator.
+        set_verify_chunk), so actuation is always mid-stream-safe; a
+        channel with no live pipeline just updates the value the next
+        deliver session starts from."""
+        if knob == "verify_chunk":
+            fn = getattr(self.validator, "set_verify_chunk", None)
+            if fn is not None:
+                fn(int(value))
+        elif knob == "coalesce_blocks":
+            # the deliver driver reads this attribute per iteration,
+            # so the new group size takes effect on the next drain
+            self.coalesce_blocks = int(value)
+            if self.pipe is not None:
+                self.pipe.set_coalesce_blocks(int(value))
+        elif knob == "pipeline_depth":
+            # persist so the NEXT deliver session (pipeline rebuilt at
+            # reconnect from self.pipeline_depth) keeps the actuation,
+            # and a channel with no live pipe doesn't lose it.  The
+            # serial/pipelined boundary stays unconditional: a channel
+            # configured serial (1) never becomes pipelined at runtime.
+            if self.pipeline_depth > 1 and int(value) >= 2:
+                self.pipeline_depth = int(value)
+            if self.pipe is not None:
+                self.pipe.set_depth(int(value))
 
     @property
     def height(self) -> int:
@@ -732,6 +766,9 @@ class PeerChannel:
             pre_launch_fn=self.verify_block_signature, channel=self.id,
             coalesce_blocks=self.coalesce_blocks, tracer=self.tracer,
         )
+        # expose the live pipe to the autopilot's apply_knob for the
+        # duration of this deliver session
+        self.pipe = pipe
         # submit() blocks for device syncs and for the committer
         # thread — feeding from the shared default executor could
         # exhaust it when many channels block in submit at once,
@@ -858,6 +895,7 @@ class PeerChannel:
             # stream closed cleanly: flush the verified tail
             await loop.run_in_executor(feeder, pipe.close)
         finally:
+            self.pipe = None
             # await the cancelled reader before run_deliver's
             # aclosing() touches the generator: aclose() on a
             # still-running async generator raises and would MASK the
@@ -1044,6 +1082,9 @@ class PeerNode:
                  trace_ring_blocks: int | None = None,
                  trace_slow_factor: float | None = None,
                  slos: str = "",
+                 autopilot: bool = False,
+                 autopilot_tick_s: float = 1.0,
+                 autopilot_knobs: str = "",
                  device_fail_threshold: int = 0,
                  device_retries: int = 2,
                  device_recovery_s: float = 30.0,
@@ -1076,6 +1117,14 @@ class PeerNode:
         # tracer knobs — a constructor side effect would let a second
         # node silently wipe the first's engine state
         self.slos = slos
+        # traffic autopilot (nodeconfig ``autopilot`` / ``autopilot_
+        # tick_s`` / ``autopilot_knobs``): built and started at
+        # start() — OFF by default, so tier-1/CPU hosts never even
+        # construct the controller
+        self.autopilot = bool(autopilot)
+        self.autopilot_tick_s = float(autopilot_tick_s)
+        self.autopilot_knobs = autopilot_knobs
+        self.autopilot_ctl = None
         # device-lane degradation knobs (peer/degrade.py): threshold 0
         # keeps the guard off — the safe default everywhere
         self.device_fail_threshold = int(device_fail_threshold)
@@ -1340,6 +1389,39 @@ class PeerNode:
                 coalesce=self.sidecar_coalesce,
                 ssl_ctx=self.tls.server_ctx() if self.tls else None,
             ).start()
+        if self.autopilot:
+            # close the adaptive-control loop: the controller reads
+            # the global SLO engine + the sidecar scheduler (when this
+            # process serves one) + the tracer's flight recorder, and
+            # actuates every joined channel's runtime setters.  All
+            # knobs stay inside the operator's validated clamp spec.
+            from fabric_tpu.control import Autopilot, set_global
+            from fabric_tpu.observe.slo import global_engine
+
+            def _apply(knob, value):
+                # snapshot: this runs on the controller thread while
+                # join_channel mutates the dict on the event loop
+                for ch in list(self.channels.values()):
+                    ch.apply_knob(knob, value)
+
+            sched = (self.sidecar_server.scheduler
+                     if self.sidecar_server is not None else None)
+            self.autopilot_ctl = Autopilot(
+                self.autopilot_knobs or None, _apply,
+                set_weight=(sched.set_weight if sched else None),
+                set_shed=(sched.set_shed if sched else None),
+                slo=global_engine(), scheduler=sched,
+                tick_s=self.autopilot_tick_s,
+                initial={
+                    "coalesce_blocks": self.coalesce_blocks,
+                    "verify_chunk": self.verify_chunk,
+                    "pipeline_depth": self.pipeline_depth,
+                },
+            )
+            if self.sidecar_server is not None:
+                self.sidecar_server.autopilot = self.autopilot_ctl
+            set_global(self.autopilot_ctl)
+            self.autopilot_ctl.start()
         self.operations = None
         if operations_port is not None:
             from fabric_tpu.opsserver import HealthRegistry, OperationsServer
@@ -1381,11 +1463,22 @@ class PeerNode:
                     "sidecar_server", self.sidecar_server.health_check
                 )
             self.operations = await OperationsServer(
-                port=operations_port, health=health
+                port=operations_port, health=health,
+                autopilot=self.autopilot_ctl,
             ).start()
         return self
 
     async def stop(self):
+        if self.autopilot_ctl is not None:
+            # disable BEFORE stopping so /autopilot (and the gauge)
+            # never reads a dead control loop as live, and release the
+            # process-global handle if it is ours
+            self.autopilot_ctl.set_enabled(False)
+            self.autopilot_ctl.stop()
+            from fabric_tpu.control import global_autopilot, set_global
+
+            if global_autopilot() is self.autopilot_ctl:
+                set_global(None)
         for ch in self.channels.values():
             ch.stop()
         if getattr(self, "gossip_service", None) is not None:

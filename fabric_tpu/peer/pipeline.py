@@ -309,7 +309,50 @@ class CommitPipeline:
         # launch+finish under pipelining (prefetch parse overlaps the
         # predecessor and is deliberately excluded)
         self._launch_s = 0.0
+        # runtime re-knobbing (the traffic autopilot's actuators):
+        # set_depth/set_coalesce_blocks latch a pending value that is
+        # applied at the NEXT submit boundary — never mid-window, so a
+        # block's launch/finish/commit always runs under one knob
+        # vector.  GIL-atomic attribute writes; no lock needed.
+        self._pending_depth: int | None = None
+        self._pending_coalesce: int | None = None
         self._closed = False
+
+    # -- runtime re-knobbing (autopilot actuators) -------------------------
+
+    def set_depth(self, depth: int) -> None:
+        """Request a new pipeline depth, applied at the next submit
+        boundary (never mid-window).  A serial pipe (depth 1) stays
+        serial — the pipelined/serial boundary owns thread lifecycles
+        and cannot be crossed at runtime — and a pipelined pipe never
+        drops below 2 for the same reason; deeper→shallower simply
+        drains the excess window at the next finish."""
+        if self.depth <= 1:
+            return
+        self._pending_depth = max(2, int(depth))
+
+    def set_coalesce_blocks(self, n: int) -> None:
+        """Request a new multi-block coalescing group size, applied at
+        the next submit boundary.  Coalescing needs the validator's
+        ``preprocess_many``; without it the knob stays inert exactly
+        as at construction."""
+        n = int(n)
+        self._pending_coalesce = 0 if n < 2 else n
+
+    def _apply_pending_knobs(self) -> None:
+        """Block boundary: adopt any latched knob values.  Called at
+        the top of submit/submit_many, where no block is mid-stage on
+        the caller thread."""
+        d = self._pending_depth
+        if d is not None:
+            self._pending_depth = None
+            if d != self.depth:
+                self.depth = d
+        c = self._pending_coalesce
+        if c is not None:
+            self._pending_coalesce = None
+            if c != self.coalesce_blocks:
+                self.coalesce_blocks = c
 
     # -- failure containment ----------------------------------------------
 
@@ -429,6 +472,7 @@ class CommitPipeline:
         fresh pipeline and resume from the last committed height."""
         if self._closed:
             raise RuntimeError("pipeline is closed")
+        self._apply_pending_knobs()
         try:
             if self.depth == 1:
                 return self._submit_serial(block)
@@ -496,6 +540,7 @@ class CommitPipeline:
         when coalescing is off, the pipe is serial, or the validator
         has no ``preprocess_many``."""
         blocks = list(blocks)
+        self._apply_pending_knobs()
         k = self.coalesce_blocks
         if (self.depth == 1 or k < 2 or len(blocks) < 2
                 or self._prefetch_many_fn is None):

@@ -338,6 +338,9 @@ class BlockValidator:
         # device compute overlaps chunk k+1's host staging.  0 = one
         # monolithic launch (nodeconfig ``verify_chunk``).
         self.verify_chunk = int(verify_chunk)
+        # latched by set_verify_chunk (the autopilot actuator), applied
+        # at the next block boundary
+        self._pending_verify_chunk: int | None = None
         # device-mesh sharding of the production dispatch (nodeconfig
         # ``mesh_devices``): batch lanes of the verify kernel AND the
         # fused stage-2 program shard axis 0 over a parallel.mesh data
@@ -442,6 +445,22 @@ class BlockValidator:
         pool, self.host_pool = self.host_pool, None
         if pool is not None:
             pool.shutdown()
+
+    # -- runtime re-knobbing (autopilot actuator) --------------------------
+
+    def set_verify_chunk(self, n: int) -> None:
+        """Request a new signature-verify chunk size, applied at the
+        next block boundary (the top of ``preprocess`` /
+        ``preprocess_many``, where this block's verify dispatch has
+        not started) — a block's chunked launch always runs under one
+        chunk size, never a mid-window mix.  0 = monolithic."""
+        self._pending_verify_chunk = max(0, int(n))
+
+    def _apply_pending_knobs(self) -> None:
+        n = getattr(self, "_pending_verify_chunk", None)
+        if n is not None:
+            self._pending_verify_chunk = None
+            self.verify_chunk = n
 
     def _t(self, key: str, t0: float) -> float:
         t1 = time.perf_counter()
@@ -1150,6 +1169,7 @@ class BlockValidator:
         phase of the current one — the TPU-shaped analog of the
         reference's deliver prefetch + validator pool overlap
         (gossip/state/state.go:540, v20/validator.go:193)."""
+        self._apply_pending_knobs()
         t0 = time.perf_counter()
         txs, items, rwp, fb = self._parse(block)
         t0 = self._t("host_parse", t0)
@@ -1176,6 +1196,7 @@ class BlockValidator:
         a device-side slice with the exact lane layout a solo launch
         would produce, so stage-2 and the committer are unchanged."""
         blocks = list(blocks)
+        self._apply_pending_knobs()
         if len(blocks) <= 1:
             return [self.preprocess(b) for b in blocks]
         if self.host_pool is not None:

@@ -114,6 +114,7 @@ class SidecarLink:
         self._reader_task: asyncio.Task | None = None
         self._conn_lock: asyncio.Lock | None = None  # created on-loop
         self._pending: dict[int, asyncio.Future] = {}
+        self._hello_ack: asyncio.Future | None = None
         self._seq = 0
         self._closed = False
         if registry is None:
@@ -181,6 +182,25 @@ class SidecarLink:
         """One handle per batch; the server's scheduler coalesces them
         (cross-tenant included) into shared device dispatches."""
         return [self.submit(t) for t in tuple_sets]
+
+    def set_weight(self, weight: float, timeout_s: float = 5.0) -> bool:
+        """Change this tenant's fair-share weight IN PLACE via an
+        in-stream re-hello: the server updates the live registration
+        (deficit credit and trailing stats preserved — no
+        disconnect/re-register).  Returns True on a server ack; False
+        when detached (the new weight still rides the next hello, so
+        the change survives a reconnect either way)."""
+        self.weight = float(weight)
+        if self._closed or self._stream is None:
+            return False
+        try:
+            return bool(asyncio.run_coroutine_threadsafe(
+                self._arehello(self.weight), self._loop
+            ).result(timeout_s))
+        except Exception as e:
+            _log.debug("re-hello for %s failed (%s) — weight rides "
+                       "the next hello", self.tenant, e)
+            return False
 
     def close(self) -> None:
         if self._closed:
@@ -327,9 +347,35 @@ class SidecarLink:
                       self.tenant, self.host, self.port)
             return st
 
+    async def _arehello(self, weight: float) -> bool:
+        st = self._stream
+        if st is None:
+            return False
+        ack = self._loop.create_future()
+        self._hello_ack = ack
+        try:
+            await st.send(json.dumps(
+                {"tenant": self.tenant, "weight": weight}
+            ).encode())
+            got = await asyncio.wait_for(ack, CONNECT_TIMEOUT_S)
+            return bool(got.get("ok"))
+        finally:
+            self._hello_ack = None
+
     async def _reader(self, st) -> None:
         try:
             async for payload in st:
+                if payload[:1] == b"{":
+                    # re-hello ack (request frames lead with a u32
+                    # header length whose first byte is 0 — see
+                    # wire.py; a raw JSON object cannot collide)
+                    ack = self._hello_ack
+                    if ack is not None and not ack.done():
+                        try:
+                            ack.set_result(json.loads(payload))
+                        except ValueError:
+                            ack.set_result({})
+                    continue
                 hdr, verdicts = wire.decode_response(payload)
                 fut = self._pending.pop(int(hdr.get("seq", -1)), None)
                 if fut is not None and not fut.done():
@@ -355,6 +401,11 @@ class SidecarLink:
                 fut.set_exception(
                     SidecarUnavailable("sidecar connection lost")
                 )
+        ack, self._hello_ack = self._hello_ack, None
+        if ack is not None and not ack.done():
+            ack.set_exception(
+                SidecarUnavailable("sidecar connection lost")
+            )
         if cli is not None:
             t = asyncio.ensure_future(self._close_client(cli))
             t.add_done_callback(lambda _t: None)  # close is best-effort

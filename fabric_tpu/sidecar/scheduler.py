@@ -58,7 +58,7 @@ class Request:
 
 class _Tenant:
     __slots__ = ("name", "weight", "queue", "deficit", "served_cost",
-                 "enqueued", "rejected", "refs", "ages")
+                 "enqueued", "rejected", "shed_count", "refs", "ages")
 
     def __init__(self, name: str, weight: float):
         self.name = name
@@ -68,6 +68,7 @@ class _Tenant:
         self.served_cost = 0
         self.enqueued = 0
         self.rejected = 0
+        self.shed_count = 0  # arrivals turned away by shed mode
         self.refs = 1  # connections sharing this tenant entry
         # trailing queue ages (seconds spent waiting before dispatch):
         # stats() turns these into the p50/p99 the bench tracks
@@ -108,6 +109,11 @@ class WeightedScheduler:
         self._order: list[str] = []   # registration order = DRR rotation
         self._rr = 0
         self._carry: str | None = None  # tenant parked mid-credit
+        # tenants in SHED mode (the traffic autopilot's bounded
+        # load-shedding actuator): their arrivals are answered BUSY +
+        # retry-after at admission — keyed by NAME, independent of
+        # registration, so a shed survives the tenant's reconnect
+        self._shed: set[str] = set()
         # served/enqueued/rejected totals of fully-disconnected tenants:
         # restored on re-register (share continuity across reconnects)
         # and merged into stats() so the fairness picture survives the
@@ -140,6 +146,11 @@ class WeightedScheduler:
             "requests rejected at a full tenant admission queue "
             "(answered with a typed BUSY frame)",
         )
+        self._shed_ctr = registry.counter(
+            "sidecar_shed_total",
+            "requests turned away by autopilot shed mode (answered "
+            "with a typed BUSY frame + retry-after)",
+        )
 
     # -- tenant lifecycle --------------------------------------------------
 
@@ -160,9 +171,54 @@ class WeightedScheduler:
                 t.served_cost = old["served_cost"]
                 t.enqueued = old["enqueued"]
                 t.rejected = old["rejected"]
+                t.shed_count = old.get("shed_count", 0)
                 t.ages.extend(old.get("_ages", ()))
             self._tenants[name] = t
             self._order.append(name)
+
+    def set_weight(self, name: str, weight: float) -> bool:
+        """Update a LIVE registration's weight in place — deficit
+        credit and trailing stats (ages, served totals) are preserved,
+        so a re-hello with a changed weight (or an autopilot re-weight)
+        never costs the tenant its scheduling position the way a
+        disconnect/re-register would.  False when the tenant is not
+        currently registered (a retired entry's weight is updated for
+        its next registration)."""
+        if weight <= 0:
+            raise ValueError(f"tenant {name!r}: weight must be > 0")
+        with self._lock:
+            t = self._tenants.get(name)
+            if t is None:
+                old = self._retired.get(name)
+                if old is not None:
+                    old["weight"] = float(weight)
+                return False
+            t.weight = float(weight)
+            return True
+
+    def weight(self, name: str) -> float | None:
+        with self._lock:
+            t = self._tenants.get(name)
+            return t.weight if t is not None else None
+
+    # -- shed mode (the autopilot's bounded load-shedding actuator) --------
+
+    def set_shed(self, name: str, shed: bool) -> None:
+        """Enter/leave shed mode for one tenant: while shed, every
+        arrival is turned away at admission (``submit`` returns False
+        and the server answers a typed BUSY + retry-after).  Queued
+        requests are NOT dropped — shedding bounds NEW work; what was
+        admitted still completes, so the shed set is exactly the
+        arrivals counted on ``sidecar_shed_total``."""
+        with self._lock:
+            if shed:
+                self._shed.add(name)
+            else:
+                self._shed.discard(name)
+
+    def is_shed(self, name: str) -> bool:
+        with self._lock:
+            return name in self._shed
 
     def unregister(self, name: str) -> list:
         """Drop one connection's claim; when the last goes, the tenant
@@ -185,6 +241,7 @@ class WeightedScheduler:
                 "served_cost": t.served_cost,
                 "enqueued": t.enqueued,
                 "rejected": t.rejected,
+                "shed_count": t.shed_count,
                 "_ages": list(t.ages),
             }
             orphans = list(t.queue)
@@ -196,12 +253,19 @@ class WeightedScheduler:
 
     def submit(self, req: Request) -> bool:
         """Admit one request to its tenant's bounded queue; False =
-        queue full (the caller answers BUSY)."""
+        queue full OR the tenant is in shed mode (the caller answers
+        BUSY; ``is_shed`` distinguishes the two for retry-after)."""
+        shed = False
         with self._lock:
             t = self._tenants.get(req.tenant)
             if t is None:
                 raise KeyError(f"tenant {req.tenant!r} is not registered")
-            if len(t.queue) >= self.queue_limit:
+            if req.tenant in self._shed:
+                t.rejected += 1
+                t.shed_count += 1
+                shed = True
+                depth = None
+            elif len(t.queue) >= self.queue_limit:
                 t.rejected += 1
                 depth = None
             else:
@@ -214,6 +278,8 @@ class WeightedScheduler:
         # never nest the registry lock under it)
         if depth is None:
             self._busy_ctr.add(1, tenant=req.tenant)
+            if shed:
+                self._shed_ctr.add(1, tenant=req.tenant)
             return False
         self._depth_gauge.set(depth, tenant=req.tenant)
         return True
@@ -330,6 +396,8 @@ class WeightedScheduler:
                     "served_cost": t.served_cost,
                     "enqueued": t.enqueued,
                     "rejected": t.rejected,
+                    "shed_count": t.shed_count,
+                    "shed": name in self._shed,
                     "deficit": round(t.deficit, 1),
                 }
                 ages[name] = list(t.ages)
@@ -337,7 +405,9 @@ class WeightedScheduler:
                 if name not in rows:
                     row = {k: v for k, v in old.items()
                            if not k.startswith("_")}
-                    rows[name] = {"depth": 0, "deficit": 0.0, **row}
+                    rows[name] = {"depth": 0, "deficit": 0.0,
+                                  "shed_count": 0,
+                                  "shed": name in self._shed, **row}
                     ages[name] = list(old.get("_ages", ()))
             total = sum(r["served_cost"] for r in rows.values())
         for name, r in rows.items():

@@ -71,6 +71,11 @@ _log = logging.getLogger("fabric_tpu.sidecar")
 #: utils.backoff.Backoff owns the actual cadence)
 BUSY_RETRY_MS = 20.0
 
+#: suggested retry-after while a tenant is in autopilot SHED mode —
+#: much longer than a transient queue-full: the controller is telling
+#: this tenant to back off until its burn clears
+SHED_RETRY_MS = 250.0
+
 
 class SidecarServer:
     """See module docstring.
@@ -86,7 +91,7 @@ class SidecarServer:
                  recode_device: bool = False, queue_blocks: int = 8,
                  coalesce: int = 4, quantum: int | None = None,
                  ssl_ctx=None, verify_fn=None, registry=None,
-                 tracer=None):
+                 tracer=None, autopilot=None):
         self.host, self.port = host, port
         self.mesh_devices = int(mesh_devices)
         self.verify_chunk = int(verify_chunk)
@@ -94,6 +99,10 @@ class SidecarServer:
         self.coalesce = max(1, int(coalesce))
         self.mesh = None
         self._verify_fn = verify_fn
+        # optional traffic autopilot (fabric_tpu/control): hellos
+        # report tenant weights so the controller knows each tenant's
+        # declared restore target for its re-weight rule
+        self.autopilot = autopilot
         self._rpc = RpcServer(host, port, ssl_ctx=ssl_ctx)
         if tracer is None:
             from fabric_tpu.observe import global_tracer
@@ -204,6 +213,8 @@ class SidecarServer:
         except ValueError as e:
             await stream.error(f"bad hello: {e}")
             return
+        if self.autopilot is not None:
+            self.autopilot.observe_hello(tenant, weight)
         self._conns += 1
         self._tenants_gauge.set(self._conns)
         # everything past registration runs under the unregister
@@ -216,6 +227,23 @@ class SidecarServer:
             async for payload in stream:
                 if _faults.plan() is not None:
                     await _faults.afire("sidecar.request", tenant=tenant)
+                if payload[:1] == b"{":
+                    # in-stream RE-HELLO (request frames always lead
+                    # with a u32 header length, whose first byte is 0
+                    # for any sane header — a raw JSON object cannot
+                    # collide): a weight change updates the live
+                    # registration in place, deficit and trailing
+                    # stats preserved, no disconnect required
+                    err = self._re_hello(tenant, payload)
+                    if err is not None:
+                        await stream.error(err)
+                        return
+                    await stream.send(json.dumps(
+                        {"ok": True, "tenant": tenant,
+                         "weight": self.scheduler.weight(tenant),
+                         "rehello": True}
+                    ).encode())
+                    continue
                 try:
                     hdr, items = wire.decode_request(payload)
                 except (ValueError, KeyError) as e:
@@ -246,10 +274,21 @@ class SidecarServer:
                               else None,
                               t_enqueue=self.tracer.clock())
                 if not self.scheduler.submit(req):
-                    self._req_ctr.add(1, tenant=tenant, status="busy")
-                    self.tracer.set_attrs(root, busy=True)
+                    shed = self.scheduler.is_shed(tenant)
+                    self._req_ctr.add(
+                        1, tenant=tenant,
+                        status="shed" if shed else "busy",
+                    )
+                    self.tracer.set_attrs(root, busy=True,
+                                          **({"shed": True} if shed
+                                             else {}))
                     self.tracer.finish_block(root)
-                    await stream.send(wire.encode_busy(seq, BUSY_RETRY_MS))
+                    # shed mode's retry-after is deliberately long —
+                    # the autopilot is telling this tenant to back off
+                    # until its burn clears, not to hammer a full queue
+                    await stream.send(wire.encode_busy(
+                        seq, SHED_RETRY_MS if shed else BUSY_RETRY_MS
+                    ))
                     continue
                 self._work.set()
         finally:
@@ -261,6 +300,29 @@ class SidecarServer:
                 # of disappearing tenants is visible
                 self._req_ctr.add(1, tenant=req.tenant, status="dropped")
                 self.tracer.finish_block(req.root)
+
+    def _re_hello(self, tenant: str, payload: bytes) -> str | None:
+        """In-stream weight update; → error text or None on success.
+        The tenant name must match the stream's registration — one
+        connection cannot re-weight another tenant."""
+        try:
+            hello = json.loads(payload)
+            who = str(hello["tenant"])
+            weight = float(hello.get("weight", 1.0))
+        except (ValueError, KeyError, TypeError) as e:
+            return f"bad re-hello: {e}"
+        if who != tenant:
+            return (
+                f"bad re-hello: stream is registered as {tenant!r}, "
+                f"not {who!r}"
+            )
+        try:
+            self.scheduler.set_weight(tenant, weight)
+        except ValueError as e:
+            return f"bad re-hello: {e}"
+        if self.autopilot is not None:
+            self.autopilot.observe_hello(tenant, weight)
+        return None
 
     def _next_req_id(self) -> int:
         self._req_counter += 1

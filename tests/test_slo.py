@@ -119,7 +119,8 @@ class TestBurn:
         """Recovery must not require fresh events: burn() recomputes
         at call time, so a quiet channel's violations age out."""
         clk = _Clock()
-        eng = _engine("q:latency:ms=1:windows=10", clock=clk)
+        eng = _engine("q:latency:ms=1:windows=10:min_events=1",
+                      clock=clk)
         (o,) = eng.objectives
         eng.record(o, "c", good=False)
         assert eng.burn("q", "c") > 1.0
@@ -144,11 +145,79 @@ class TestBurn:
         assert eng.burn("q", "c", window=10) == 0.0
         assert eng.burn("q", "c", window=100) == pytest.approx(1.0)
 
+    def test_cold_start_floor_one_bad_block_is_no_burn(self):
+        """The cold-start guard (default min_events=5): ONE bad block
+        in a near-empty window reports burn None — a freshly started
+        peer must not read as burn ≥ 1 (or page) off a single sample."""
+        clk = _Clock()
+        eng = _engine("q:latency:ms=1:windows=60", clock=clk)
+        (o,) = eng.objectives
+        assert o.min_events == 5  # the default floor
+        eng.record(o, "c", good=False)
+        assert eng.burn("q", "c") is None
+        for _ in range(3):
+            eng.record(o, "c", good=False)
+        assert eng.burn("q", "c") is None       # 4 < 5: still no sample
+        eng.record(o, "c", good=False)
+        assert eng.burn("q", "c") >= 1.0        # 5th event: real signal
+
+    def test_cold_start_floor_suppresses_fast_burn_warn(self, caplog):
+        clk = _Clock()
+        reg = Registry()
+        eng = SloEngine(
+            parse_slos("q:latency:ms=1:target=0.9:windows=30:fast=2"),
+            clock=clk, registry=reg,
+        )
+        (o,) = eng.objectives
+        with caplog.at_level(logging.WARNING,
+                             logger="fabric_tpu.observe.slo"):
+            eng.record(o, "c", good=False)  # the one cold-start bad block
+        assert not [r for r in caplog.records
+                    if "fast burn" in r.getMessage()]
+        assert reg.counter("slo_fast_burn_total").value(
+            slo="q", channel="c"
+        ) == 0
+
+    def test_min_events_one_restores_raw_behavior(self):
+        clk = _Clock()
+        eng = _engine("q:latency:ms=1:windows=60:min_events=1",
+                      clock=clk)
+        (o,) = eng.objectives
+        eng.record(o, "c", good=False)
+        assert eng.burn("q", "c") >= 1.0
+
+    def test_min_events_spec_validation(self):
+        with pytest.raises(SloError):
+            parse_slos("q:latency:ms=1:min_events=0")
+        (o,) = parse_slos("q:latency:ms=1:min_events=7")
+        assert o.min_events == 7
+
+    def test_burns_accessor_recomputes_all_series(self):
+        """The autopilot's error-signal read: every (objective,
+        channel) series on the fast window, floors respected."""
+        clk = _Clock()
+        eng = _engine(
+            "q:latency:ms=1:target=0.9:windows=10:min_events=1",
+            clock=clk,
+        )
+        (o,) = eng.objectives
+        for _ in range(5):
+            eng.record(o, "a", good=False)
+        eng.record(o, "b", good=True)
+        burns = eng.burns()
+        assert burns[("q", "a")] >= 1.0
+        assert burns[("q", "b")] == 0.0
+        clk.advance(11.0)  # everything ages out; recomputed at read
+        burns = eng.burns()
+        assert burns[("q", "a")] is None and burns[("q", "b")] is None
+
     def test_burn_gauge_exported(self):
         reg = Registry()
         clk = _Clock()
-        eng = SloEngine(parse_slos("q:latency:ms=1:windows=60"),
-                        clock=clk, registry=reg)
+        eng = SloEngine(
+            parse_slos("q:latency:ms=1:windows=60:min_events=1"),
+            clock=clk, registry=reg,
+        )
         (o,) = eng.objectives
         eng.record(o, "c", good=False)
         g = reg.gauge("slo_burn_rate")
@@ -160,8 +229,10 @@ class TestBurn:
         rolls."""
         reg = Registry()
         clk = _Clock()
-        eng = SloEngine(parse_slos("q:latency:ms=1:windows=60"),
-                        clock=clk, registry=reg)
+        eng = SloEngine(
+            parse_slos("q:latency:ms=1:windows=60:min_events=1"),
+            clock=clk, registry=reg,
+        )
         (o,) = eng.objectives
         eng.record(o, "c", good=False)
         g = reg.gauge("slo_burn_rate")
@@ -180,7 +251,8 @@ class TestFastBurn:
         clk = _Clock()
         reg = Registry()
         eng = SloEngine(
-            parse_slos("q:latency:ms=1:target=0.9:windows=30:fast=2"),
+            parse_slos("q:latency:ms=1:target=0.9:windows=30:fast=2:"
+                       "min_events=1"),
             clock=clk, registry=reg,
         )
         (o,) = eng.objectives

@@ -1560,6 +1560,124 @@ class TestDeviceBufferLifetime:
         assert got == []
 
 
+# -- FT012 pvtdata-purge-race ------------------------------------------------
+
+BAD_PURGE = """\
+from concurrent.futures import ThreadPoolExecutor
+import threading
+
+
+def races_executor(store, height, rows):
+    pool = ThreadPoolExecutor(2)
+    pool.submit(store.persist, "tx", rows, height)
+    store.purge_below(height - 100)
+
+
+def races_thread(store, num):
+    t = threading.Thread(target=store.resolve_missing, args=(num,))
+    t.start()
+    return store.purge_expired(num)
+
+
+def races_loop(loop, store, num, data):
+    loop.run_in_executor(None, store.commit_block, num, data)
+    store.purge_expired(num)
+
+
+def purge_dispatched_writer_inline(store, pool, num, data):
+    store.commit_block(num, data)
+    pool2 = ThreadPoolExecutor(1)
+    pool2.submit(lambda: store.purge_expired(num))
+"""
+
+CLEAN_PURGE = """\
+from concurrent.futures import ThreadPoolExecutor
+
+
+def inline_is_serialized(store, height, rows):
+    store.persist("tx", rows, height)
+    store.purge_below(height - 100)
+
+
+def different_receivers(a, b, height):
+    pool = ThreadPoolExecutor(2)
+    pool.submit(a.persist, "tx", height)
+    b.purge_below(height)
+
+
+def no_writer_in_scope(store, pool, height, job):
+    pool = ThreadPoolExecutor(2)
+    pool.submit(job)
+    store.purge_expired(height)
+
+
+def unknown_submit_is_not_an_executor(scheduler, store, h):
+    scheduler.submit(store.persist)
+    store.purge_below(h)
+
+
+def no_purge_in_scope(store, h, rows):
+    pool = ThreadPoolExecutor(2)
+    pool.submit(store.persist, "tx", rows, h)
+"""
+
+
+class TestPvtdataPurgeRace:
+    def test_flags_dispatched_writers_racing_the_walk(self, tmp_path):
+        from fabric_tpu.analysis.rules.pvtdata_purge_race import (
+            PvtdataPurgeRaceRule,
+        )
+
+        got = run_rule(tmp_path, PvtdataPurgeRaceRule(),
+                       {"mod.py": BAD_PURGE})
+        assert [(f.rule, f.line) for f in got] == [
+            ("FT012", 8),    # purge_below vs executor-submitted persist
+            ("FT012", 14),   # purge_expired vs Thread(resolve_missing)
+            ("FT012", 19),   # purge_expired vs run_in_executor commit
+            ("FT012", 25),   # DISPATCHED purge vs inline commit_block
+        ]
+        assert "SELECT-then-DELETE" in got[0].message
+
+    def test_clean_shapes(self, tmp_path):
+        from fabric_tpu.analysis.rules.pvtdata_purge_race import (
+            PvtdataPurgeRaceRule,
+        )
+
+        got = run_rule(tmp_path, PvtdataPurgeRaceRule(),
+                       {"mod.py": CLEAN_PURGE})
+        assert got == []
+
+    def test_test_code_exempt(self, tmp_path):
+        from fabric_tpu.analysis.rules.pvtdata_purge_race import (
+            PvtdataPurgeRaceRule,
+        )
+
+        got = run_rule(tmp_path, PvtdataPurgeRaceRule(), {
+            "test_mod.py": BAD_PURGE,
+            "tests/helper.py": BAD_PURGE,
+            "conftest.py": BAD_PURGE,
+        })
+        assert got == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        from fabric_tpu.analysis.rules.pvtdata_purge_race import (
+            PvtdataPurgeRaceRule,
+        )
+
+        src = "\n".join([
+            "from concurrent.futures import ThreadPoolExecutor",
+            "",
+            "def f(store, h, rows):",
+            "    pool = ThreadPoolExecutor(2)",
+            "    pool.submit(store.persist, rows, h)",
+            "    store.purge_below(h)  # fabtpu: noqa(FT012)",
+            "",
+        ])
+        got = run_rule(tmp_path, PvtdataPurgeRaceRule(),
+                       {"mod.py": src})
+        assert got == []
+
+
 def test_rule_battery_registered():
     from fabric_tpu.analysis import all_rules
 
@@ -1576,4 +1694,5 @@ def test_rule_battery_registered():
         "FT009": "unbounded-blocking-wait",
         "FT010": "unfinished-span",
         "FT011": "device-buffer-lifetime",
+        "FT012": "pvtdata-purge-race",
     }
