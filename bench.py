@@ -284,6 +284,42 @@ def _bench_knobs() -> dict:
     }
 
 
+def _vitals_capture(interval_s: float = 0.25):
+    """``FABTPU_BENCH_VITALS=1``: arm a run-local flight-data sampler
+    (fabric_tpu.observe.timeseries.MetricsSampler) over the process
+    registry — short interval, deep ring — for the scenario's whole
+    duration.  Returns None (and costs nothing) when the knob is off,
+    so default bench runs keep the recorder-less hot path."""
+    import os
+
+    if os.environ.get("FABTPU_BENCH_VITALS", "0") != "1":
+        return None
+    from fabric_tpu.observe.timeseries import MetricsSampler
+
+    s = MetricsSampler(interval_s=float(
+        os.environ.get("FABTPU_BENCH_VITALS_INTERVAL_S", interval_s)
+    ), retention=4096)
+    s.start()
+    return s
+
+
+def _vitals_extras(sampler) -> dict | None:
+    """Stop a :func:`_vitals_capture` sampler and dump its FULL metric
+    trails for the BENCH_*.json extras (delta-aware series per metric
+    and label variant — the attribution record)."""
+    if sampler is None:
+        return None
+    sampler.stop()
+    sampler.sample()  # final pass so the scenario's tail lands
+    rep = sampler.report()
+    return {
+        "interval_s": sampler.interval_s,
+        "samples": rep["samples"],
+        "series_count": rep["series_count"],
+        "series": sampler.series(),
+    }
+
+
 def _host_stage_extras(fresh_validator) -> dict | None:
     """host_stage sub-breakdown for the JSON extras: resolved worker
     count, per-shard p50, and the recode location — read off the last
@@ -1634,6 +1670,12 @@ def main():
                 "metric": name,
             }))
             return
+    # FABTPU_BENCH_VITALS=1: arm a run-local flight-data sampler
+    # (observe/timeseries.py) over the process registry for the whole
+    # scenario — every bench then ships its full metric trails into
+    # BENCH_*.json extras, turning end-number snapshots into
+    # attributed per-stage trajectories (the BENCH_r06 runbook knob)
+    vitals = _vitals_capture()
     result = _BENCHES[name]()
     if name == "block_commit":
         # self-contained round artifact: the headline clean number
@@ -1665,6 +1707,9 @@ def main():
         result.pop("host_stage", None)
         result.pop("trace", None)
         result.pop("pipeline_overlap_coverage", None)
+    trails = _vitals_extras(vitals)
+    if trails is not None:
+        result.setdefault("extras", {})["vitals"] = trails
     print(json.dumps(result))
 
 

@@ -15,6 +15,7 @@ them); slugs are the human-facing names:
     FT010 unfinished-span        begin_block roots with no reachable finish
     FT011 device-buffer-lifetime  packed uploads pinned past their fetch
     FT012 pvtdata-purge-race     store writers racing the BTL purge walk
+    FT013 metric-label-cardinality  per-request ids as metric labels
 """
 
 from fabric_tpu.analysis.rules import (  # noqa: F401
@@ -25,6 +26,7 @@ from fabric_tpu.analysis.rules import (  # noqa: F401
     jit_purity,
     kernel_dtype,
     lock_discipline,
+    metric_label_cardinality,
     pvtdata_purge_race,
     retrace_hazard,
     swallowed_exception,

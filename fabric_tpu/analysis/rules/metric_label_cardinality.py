@@ -1,0 +1,249 @@
+"""FT013 metric-label-cardinality: per-request ids as metric labels.
+
+The metrics registry (fabric_tpu.ops_metrics) materializes one series
+per LABEL VARIANT, forever: every distinct label value grows the
+exposition (`/metrics` render walks all of them), and — since the
+flight-data recorder landed — also one bounded time-series ring per
+variant in the sampler.  A label value derived from per-request or
+per-loop data (transaction ids, block numbers, request sequence
+numbers) therefore makes cardinality unbounded: a day of traffic
+turns a counter into millions of dead series.  The label discipline
+in this repo is small closed sets — channel, tenant, stage, status,
+knob, point, kind — and this rule polices it.
+
+Mechanics (strictly under-approximating, per the FT003..FT012
+contract — a finding is always real):
+
+1. **Metric receiver match** — a write call ``<recv>.add(...)`` /
+   ``<recv>.set(...)`` / ``<recv>.observe(...)`` counts only when
+   ``<recv>`` provably is a registry instrument:
+
+   * a chained constructor call ``<reg>.counter("name", ...)`` /
+     ``.gauge(...)`` / ``.histogram(...)`` whose FIRST argument is a
+     string literal (every registry registration passes the metric
+     name; a same-named method on an unrelated object does not), or
+   * a local assigned once in the same scope from such a constructor
+     call, or
+   * a ``self.<attr>`` assigned from such a constructor call anywhere
+     in the same class (the repo's ``self._ctr = registry.counter``
+     idiom).
+
+2. **Unbounded label value** — a keyword argument (label) flags only
+   when its value expression provably carries per-request identity:
+
+   * an attribute chain ending in ``.txid`` / ``.tx_id``, or
+     containing ``header.number`` (the block-number chain), or
+   * a bare name exactly ``txid`` / ``tx_id`` / ``request_id`` /
+     ``req_id``, or a local assigned once from one of the above, or
+   * any of those wrapped in ``str()`` / ``int()`` / ``repr()`` /
+     ``format()``, an f-string, or a ``%``/``+`` format expression.
+
+   Anything else — loop variables, computed strings, unknown names —
+   never flags: the closed-set discipline cannot be proven violated,
+   so the rule stays silent (under-approximation).
+
+3. **Test code is exempt** (``tests/``, ``test_*.py``,
+   ``conftest.py``) — a test labeling a throwaway registry with a
+   txid is pinning behavior, not leaking cardinality.
+
+Suppress a deliberate bounded-by-construction case with
+``# fabtpu: noqa(FT013)`` on the write line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import (
+    Finding,
+    ModuleCtx,
+    Rule,
+    dotted_name,
+    register,
+)
+
+_CTORS = {"counter", "gauge", "histogram"}
+_WRITES = {"add", "set", "observe"}
+_BAD_NAMES = {"txid", "tx_id", "request_id", "req_id"}
+_BAD_ATTR_TAILS = {"txid", "tx_id"}
+_WRAPPERS = {"str", "int", "repr", "format"}
+
+
+def _is_metric_ctor(call: ast.AST) -> bool:
+    """``<reg>.counter("name", ...)``-shaped: attribute call named
+    like a registry constructor whose first argument is a string
+    literal (the metric name)."""
+    return (
+        isinstance(call, ast.Call)
+        and isinstance(call.func, ast.Attribute)
+        and call.func.attr in _CTORS
+        and bool(call.args)
+        and isinstance(call.args[0], ast.Constant)
+        and isinstance(call.args[0].value, str)
+    )
+
+
+def _scopes(tree: ast.Module):
+    """(scope, own-statement nodes) pairs: module + every function,
+    nested defs excluded from the parent's own set."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _own_nodes(scope: ast.AST):
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _single_assigns(scope: ast.AST) -> dict[str, ast.AST | None]:
+    """{name: value expr} for locals assigned exactly once in the
+    scope (None marks a re-assigned name — unusable for resolution)."""
+    out: dict[str, ast.AST | None] = {}
+    for node in _own_nodes(scope):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            name = node.targets[0].id
+            out[name] = None if name in out else node.value
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            tgt = node.target
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = None
+        elif isinstance(node, ast.For) and isinstance(node.target,
+                                                      ast.Name):
+            out[node.target.id] = None
+    return out
+
+
+def _unbounded_reason(expr: ast.AST, assigns: dict,
+                      depth: int = 0) -> str | None:
+    """Why ``expr`` carries per-request identity, or None."""
+    if depth > 3:
+        return None
+    if isinstance(expr, ast.Name):
+        if expr.id in _BAD_NAMES:
+            return f"per-request identifier {expr.id!r}"
+        src = assigns.get(expr.id)
+        if src is not None:
+            return _unbounded_reason(src, assigns, depth + 1)
+        return None
+    if isinstance(expr, ast.Attribute):
+        dn = dotted_name(expr)
+        if expr.attr in _BAD_ATTR_TAILS:
+            return f"per-transaction id {dn or expr.attr!r}"
+        if dn is not None and "header.number" in dn:
+            return f"per-block number {dn!r}"
+        return None
+    if isinstance(expr, ast.Call):
+        name = dotted_name(expr.func)
+        if name in _WRAPPERS and expr.args:
+            return _unbounded_reason(expr.args[0], assigns, depth + 1)
+        return None
+    if isinstance(expr, ast.JoinedStr):
+        for v in expr.values:
+            if isinstance(v, ast.FormattedValue):
+                r = _unbounded_reason(v.value, assigns, depth + 1)
+                if r is not None:
+                    return r
+        return None
+    if isinstance(expr, ast.BinOp):
+        return (_unbounded_reason(expr.left, assigns, depth + 1)
+                or _unbounded_reason(expr.right, assigns, depth + 1))
+    return None
+
+
+def _class_metric_attrs(tree: ast.Module) -> dict[ast.ClassDef, set]:
+    """{class: self-attr names assigned from metric constructors}."""
+    out: dict[ast.ClassDef, set] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        attrs: set = set()
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Attribute)
+                    and isinstance(sub.targets[0].value, ast.Name)
+                    and sub.targets[0].value.id == "self"
+                    and _is_metric_ctor(sub.value)):
+                attrs.add(sub.targets[0].attr)
+        out[node] = attrs
+    return out
+
+
+@register
+class MetricLabelCardinalityRule(Rule):
+    id = "FT013"
+    name = "metric-label-cardinality"
+    severity = "error"
+    description = (
+        "flags Registry counter/gauge/histogram label values derived "
+        "from per-request data (txids, block numbers, request ids): "
+        "every distinct value materializes a series forever, so "
+        "exposition — and the flight-data recorder's per-variant "
+        "time-series rings — grow without bound"
+    )
+
+    def check_module(self, ctx: ModuleCtx) -> list[Finding]:
+        rel = ctx.relpath
+        base = rel.rsplit("/", 1)[-1]
+        if ("tests/" in rel or rel.startswith("tests")
+                or base.startswith("test_") or base == "conftest.py"):
+            return []
+        class_attrs = _class_metric_attrs(ctx.tree)
+        # map each function scope to its enclosing class (if any)
+        owner: dict[int, ast.ClassDef] = {}
+        for cls in class_attrs:
+            for sub in ast.walk(cls):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    owner.setdefault(id(sub), cls)
+        out: list[Finding] = []
+        for scope in _scopes(ctx.tree):
+            assigns = _single_assigns(scope)
+            metric_locals = {
+                name for name, val in assigns.items()
+                if val is not None and _is_metric_ctor(val)
+            }
+            cls = owner.get(id(scope))
+            self_metrics = class_attrs.get(cls, set()) if cls else set()
+            for node in _own_nodes(scope):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _WRITES):
+                    continue
+                recv = node.func.value
+                is_metric = (
+                    _is_metric_ctor(recv)
+                    or (isinstance(recv, ast.Name)
+                        and recv.id in metric_locals)
+                    or (isinstance(recv, ast.Attribute)
+                        and isinstance(recv.value, ast.Name)
+                        and recv.value.id == "self"
+                        and recv.attr in self_metrics)
+                )
+                if not is_metric:
+                    continue
+                for kw in node.keywords:
+                    if kw.arg is None:
+                        continue  # **labels: unresolvable, stay silent
+                    reason = _unbounded_reason(kw.value, assigns)
+                    if reason is None:
+                        continue
+                    out.append(self.finding(
+                        ctx, node.lineno, node.col_offset,
+                        f"metric label {kw.arg!r} takes {reason}: "
+                        "every distinct value materializes a label "
+                        "variant forever (unbounded /metrics "
+                        "exposition + one vitals series ring per "
+                        "value) — label with a small closed set "
+                        "(channel/tenant/stage/status) and put "
+                        "per-request ids in trace attrs or logs",
+                    ))
+        return out

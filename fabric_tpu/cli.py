@@ -151,6 +151,9 @@ async def _run_peer(cfg):
         trace_ring_blocks=cfg.trace_ring_blocks,
         trace_slow_factor=cfg.trace_slow_factor,
         slos=cfg.slos,
+        vitals_interval_s=cfg.vitals_interval_s,
+        vitals_retention=cfg.vitals_retention,
+        blackbox_dir=cfg.blackbox_dir,
         autopilot=cfg.autopilot,
         autopilot_tick_s=cfg.autopilot_tick_s,
         autopilot_knobs=cfg.autopilot_knobs,
@@ -230,6 +233,14 @@ async def _run_sidecar(args):
         from fabric_tpu.observe import slo as slo_mod
 
         slo_mod.configure(args.slos)
+    if args.vitals_interval_s > 0 or args.blackbox_dir:
+        # flight-data recorder on the sidecar process: trailing metric
+        # series at /vitals plus black-box bundles on incident edges
+        # (shed decisions, SLO fast burns) — default OFF
+        from fabric_tpu.observe import timeseries as ts_mod
+
+        ts_mod.configure(interval_s=args.vitals_interval_s,
+                         retention=args.vitals_retention)
     ssl_ctx = None
     if args.tls_cert and args.tls_key:
         from fabric_tpu.comm.rpc import make_server_tls
@@ -254,6 +265,44 @@ async def _run_sidecar(args):
     await srv.start()
     print(f"validation sidecar serving on {srv.host}:{srv.port}",
           flush=True)
+    if args.vitals_interval_s > 0 or args.blackbox_dir:
+        from fabric_tpu.observe import blackbox as bb_mod
+
+        # armed after start so bundles carry the live scheduler stats
+        bb_mod.configure(out_dir=args.blackbox_dir,
+                         scheduler=srv.scheduler)
+    if args.autopilot:
+        # SERVER-SIDE knob actuation: a sidecar-serve-local autopilot
+        # reads its OWN scheduler's queue-age/BUSY telemetry and the
+        # global SLO engine, and actuates the sidecar's own knobs —
+        # cross-tenant coalescing and the device microbatch chunk via
+        # the dispatcher-drain-boundary setters, plus tenant shed/
+        # weights on the live scheduler.  (The peer-side controller
+        # actuates pipeline knobs; this one owns the dispatch.)
+        from fabric_tpu.control import Autopilot, set_global
+        from fabric_tpu.observe.slo import global_engine
+
+        def _apply(knob, value):
+            if knob == "coalesce_blocks":
+                srv.set_coalesce(int(value))
+            elif knob == "verify_chunk":
+                srv.set_verify_chunk(int(value))
+            # pipeline_depth / host_stage_workers have no sidecar
+            # meaning; their signals never fire here (no block roots)
+
+        ap = Autopilot(
+            args.autopilot_knobs or None, _apply,
+            set_weight=srv.scheduler.set_weight,
+            set_shed=srv.scheduler.set_shed,
+            slo=global_engine(), scheduler=srv.scheduler,
+            tick_s=args.autopilot_tick_s,
+            initial={"coalesce_blocks": args.coalesce,
+                     "verify_chunk": args.verify_chunk},
+        )
+        srv.autopilot = ap
+        set_global(ap)
+        ap.start()
+        print("sidecar autopilot armed", flush=True)
     if args.operations_port is not None:
         from fabric_tpu.opsserver import HealthRegistry, OperationsServer
 
@@ -487,6 +536,23 @@ def main(argv=None):
                    help="SLO spec string (observe/slo.py), e.g. "
                         "'req:latency:ms=50;busy:busy:pct=5' — served "
                         "at /slo on the operations port")
+    c.add_argument("--vitals-interval-s", type=float, default=0.0,
+                   help="flight-data recorder sample interval "
+                        "(seconds; 0 = recorder off)")
+    c.add_argument("--vitals-retention", type=int, default=240,
+                   help="points retained per metric series")
+    c.add_argument("--blackbox-dir", default="",
+                   help="directory for black-box incident bundles "
+                        "('' = in-memory index only)")
+    c.add_argument("--autopilot", action="store_true",
+                   help="arm a sidecar-local traffic autopilot "
+                        "actuating coalesce/verify_chunk (drain-"
+                        "boundary setters) + tenant shed/weights off "
+                        "this scheduler's own stats")
+    c.add_argument("--autopilot-tick-s", type=float, default=1.0)
+    c.add_argument("--autopilot-knobs", default="",
+                   help="per-knob min/max clamp spec "
+                        "(control/autopilot.py parse_knob_specs)")
 
     c = sub.add_parser("chaincode", help="run a sample ccaas chaincode server")
     c.add_argument("--name", required=True)

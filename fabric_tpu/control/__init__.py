@@ -11,6 +11,8 @@ from fabric_tpu.control.autopilot import (  # noqa: F401
     KnobSpecError,
     Signals,
     global_autopilot,
+    host_clamped_specs,
     parse_knob_specs,
+    resolve_host_workers_initial,
     set_global,
 )

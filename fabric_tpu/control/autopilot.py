@@ -24,6 +24,11 @@ setters:
   deep window is wasted (host stages are not hiding device_wait, so
   the extra in-flight state buys nothing but durability lag), back up
   when coverage is high;
+* **resize** ``host_stage_workers`` up when the trailing prefetch
+  (host parse + staging) p99 grows — the feeder is slower than its
+  device, exactly the case the staging pool exists for — and back
+  toward serial staging when the feeder runs comfortably ahead
+  (HostStagePool.set_workers: drain-and-rebuild at a task boundary);
 * **re-weight or BUSY-shed** tenants on fast burn: a tenant whose
   latency budget burns past the shed band is put in *shed mode* —
   the scheduler answers its arrivals with typed BUSY + retry-after
@@ -42,8 +47,8 @@ a knob out of its validated range:
   :data:`DEFAULT_COOLDOWN_S`), so one slow signal cannot ratchet a
   knob across its whole range inside one incident;
 * **max one step per tick** — rules are evaluated in priority order
-  (shed > re-weight > coalesce > chunk > depth > restore) and the
-  first eligible actuation wins the tick;
+  (shed > re-weight > coalesce > chunk > depth > host workers >
+  restore) and the first eligible actuation wins the tick;
 * **hard clamps** — knob values move along a per-knob ladder derived
   from the operator's min/max spec; the ladder ends ARE the clamp,
   there is no code path that steps past them.
@@ -54,7 +59,8 @@ Knob bounds ride a faults-style spec string (the nodeconfig
     name[:min=..][:max=..][:cool=..] [; more knobs]
 
 known names: ``coalesce_blocks``, ``verify_chunk``,
-``pipeline_depth``, ``weight``, ``shed`` (shed takes only ``cool=``).
+``pipeline_depth``, ``host_stage_workers``, ``weight``, ``shed``
+(shed takes only ``cool=``).
 Omitting a knob from the spec keeps its default bounds
 (:data:`DEFAULT_KNOB_SPECS`); an empty spec means all defaults.
 
@@ -83,13 +89,14 @@ _log = logging.getLogger("fabric_tpu.control.autopilot")
 #: knob names the spec parser accepts — an operator typo must be a
 #: config error, not a silently-ignored bound
 KNOWN_KNOBS = ("coalesce_blocks", "verify_chunk", "pipeline_depth",
-               "weight", "shed")
+               "host_stage_workers", "weight", "shed")
 
 #: default per-knob bounds (overridable per knob via the spec string)
 DEFAULT_KNOB_SPECS = (
     "coalesce_blocks:min=0:max=8;"
     "verify_chunk:min=512:max=4096;"
     "pipeline_depth:min=2:max=4;"
+    "host_stage_workers:min=0:max=4;"
     "weight:min=0.125:max=8;"
     "shed"
 )
@@ -109,6 +116,9 @@ DEFAULT_BANDS = {
     "launch_lo_ms": 50.0,   # below → grow it back
     "coverage_lo": 0.25,   # overlap coverage below → depth down
     "coverage_hi": 0.85,   # above → depth up
+    "prefetch_hi_ms": 150.0,  # prefetch (host parse) p99 above →
+                              # host_stage_workers up
+    "prefetch_lo_ms": 20.0,   # below → back toward serial staging
     "burn_hi": 1.5,        # tenant burn above → halve its weight
     "burn_lo": 0.5,        # below → restore toward its hello weight
     "shed_hi": 4.0,        # tenant fast burn above → shed mode ON
@@ -149,6 +159,14 @@ class KnobSpec:
             return tuple(out)
         if self.name == "pipeline_depth":
             return tuple(range(int(self.lo), int(self.hi) + 1))
+        if self.name == "host_stage_workers":
+            # 0 = serial staging (pool off); 1 is meaningless (a
+            # 1-worker pool is queue overhead — resolve_host_pool
+            # returns None below 2), so the ladder jumps 0 → 2
+            return (int(self.lo),) + tuple(
+                n for n in range(max(2, int(self.lo) + 1),
+                                 int(self.hi) + 1)
+            )
         return ()  # weight/shed are not ladder knobs
 
 
@@ -216,6 +234,17 @@ def parse_knob_specs(spec: str | None) -> dict[str, KnobSpec]:
                     "min must be >= 2 (depth 1 is the serial oracle, "
                     "not a runtime target)"
                 )
+            elif name == "host_stage_workers" and ks.lo == 1:
+                # a 1-worker pool is queue overhead with no
+                # parallelism (resolve_host_pool returns None below
+                # 2), and a ladder rung at 1 would actuate the
+                # serial-close path while reporting a pool of one
+                raise KnobSpecError(
+                    f"autopilot knob spec {part!r}: "
+                    "host_stage_workers min must be 0 (serial "
+                    "staging) or >= 2 — a 1-worker pool does not "
+                    "exist"
+                )
             elif name == "weight" and ks.lo <= 0:
                 raise KnobSpecError(
                     f"autopilot knob spec {part!r}: weight min must "
@@ -228,6 +257,45 @@ def parse_knob_specs(spec: str | None) -> dict[str, KnobSpec]:
                 )
             out[name] = ks
     return out
+
+
+def host_clamped_specs(knob_specs: dict, cores: int | None = None,
+                       ) -> dict:
+    """Clamp the ``host_stage_workers`` ladder to the machine: the
+    pool itself clamps resizes to the core count, so ladder rungs
+    above it would charge cooldowns and log decisions for actuations
+    that can never change anything.  Returns the dict with that one
+    spec replaced (a ≤1-rung result leaves the knob structurally
+    inert — correct on a 1-core host)."""
+    if cores is None:
+        import os
+
+        cores = os.cpu_count() or 1
+    spec = knob_specs.get("host_stage_workers")
+    if spec is None or spec.hi <= cores:
+        return knob_specs
+    out = dict(knob_specs)
+    out["host_stage_workers"] = KnobSpec(
+        name="host_stage_workers", lo=min(spec.lo, float(cores)),
+        hi=float(cores), cooldown_s=spec.cooldown_s,
+    )
+    return out
+
+
+def resolve_host_workers_initial(configured: int,
+                                 cores: int | None = None) -> int:
+    """The configured ``host_stage_workers`` knob → the worker count
+    the validator actually resolved (mirrors ``resolve_host_pool``:
+    −1 = one per core, clamped to cores, < 2 = serial/0) — the value
+    the controller's ladder snap must start from.  Passing the raw
+    −1 would snap to 0 and INVERT the knob: the first slow-feeder
+    'up' step would shrink a per-core pool to 2 workers."""
+    if cores is None:
+        import os
+
+        cores = os.cpu_count() or 1
+    n = cores if configured < 0 else min(int(configured), cores)
+    return n if n >= 2 else 0
 
 
 @dataclass
@@ -253,6 +321,10 @@ class Signals:
     busy_rate: dict = field(default_factory=dict)
     launch_p99_ms: float | None = None
     overlap_coverage: float | None = None
+    #: trailing prefetch-span (host parse + staging) p99 ms — the
+    #: host_stage_workers signal: a feeder slower than its device
+    #: shows up here, not in launch_p99
+    prefetch_p99_ms: float | None = None
     clock_s: float = 0.0
 
     def tenant_burn(self, tenant: str) -> float | None:
@@ -426,6 +498,11 @@ class Autopilot:
                 if c.name == "launch" and c.t1 is not None
             )
             s.launch_p99_ms = _p99(launches)
+            prefetches = sorted(
+                c.dur * 1000.0 for r in roots for c in r.children
+                if c.name == "prefetch" and c.t1 is not None
+            )
+            s.prefetch_p99_ms = _p99(prefetches)
             depth = int(self.values.get("pipeline_depth", 2) or 2)
             try:
                 from fabric_tpu.observe import coverage_from_roots
@@ -454,6 +531,19 @@ class Autopilot:
             d = self._decide(s, now)
             if d is not None:
                 self._actuate(d, now)
+        if d is not None and d.knob == "shed" and d.direction == "on":
+            # incident edge: a shed decision IS an incident — freeze
+            # the trailing series, decision log and scheduler stats so
+            # the overload attributes itself.  OUTSIDE the controller
+            # lock: the bundle reads this controller's own report()
+            # (rare branch; the import costs nothing on ordinary
+            # ticks).
+            from fabric_tpu.observe import blackbox
+
+            blackbox.notify(
+                "autopilot_shed", tenant=d.tenant,
+                burn=d.value, threshold=d.threshold,
+            )
         return d
 
     def _cool(self, knob: str, tenant: str, now: float) -> bool:
@@ -620,7 +710,36 @@ class Autopilot:
                         value=s.overlap_coverage,
                         threshold=b["coverage_hi"],
                     )
-        # 6) recovery: restore a halved weight toward its hello value
+        # 6) slow feeder: more host staging workers when the prefetch
+        #    (host parse + staging) p99 grows — the ROADMAP-named PR-10
+        #    follow-up, actuatable now that the pool can resize at a
+        #    task boundary; back toward serial staging when the feeder
+        #    is comfortably ahead of the device
+        if ("host_stage_workers" in self.values
+                and s.prefetch_p99_ms is not None):
+            if (s.prefetch_p99_ms > b["prefetch_hi_ms"]
+                    and self._cool("host_stage_workers", "", now)):
+                step = self._step("host_stage_workers", +1)
+                if step is not None:
+                    return Decision(
+                        t=now, knob="host_stage_workers",
+                        direction="up", old=step[0], new=step[1],
+                        signal="prefetch_p99_ms",
+                        value=s.prefetch_p99_ms,
+                        threshold=b["prefetch_hi_ms"],
+                    )
+            elif (s.prefetch_p99_ms < b["prefetch_lo_ms"]
+                    and self._cool("host_stage_workers", "", now)):
+                step = self._step("host_stage_workers", -1)
+                if step is not None:
+                    return Decision(
+                        t=now, knob="host_stage_workers",
+                        direction="down", old=step[0], new=step[1],
+                        signal="prefetch_p99_ms",
+                        value=s.prefetch_p99_ms,
+                        threshold=b["prefetch_lo_ms"],
+                    )
+        # 7) recovery: restore a halved weight toward its hello value
         if self.set_weight is not None and "weight" in self.specs:
             spec = self.specs["weight"]
             for tenant, cur in sorted(self._weights.items()):
@@ -637,7 +756,7 @@ class Autopilot:
                         value=burn, threshold=b["burn_lo"],
                         tenant=tenant,
                     )
-        # 7) recovery: lift shed once the burn cleared and the queue
+        # 8) recovery: lift shed once the burn cleared and the queue
         #    drained (a shed tenant produces few latency samples, so
         #    an aged-out window — burn None — also counts as clear;
         #    CURRENT depth is the drain signal — trailing ages keep
@@ -768,6 +887,7 @@ class Autopilot:
                 "busy_rate": dict(sorted(sigs.busy_rate.items())),
                 "launch_p99_ms": sigs.launch_p99_ms,
                 "overlap_coverage": sigs.overlap_coverage,
+                "prefetch_p99_ms": sigs.prefetch_p99_ms,
                 "clock_s": round(sigs.clock_s, 3),
             }
         return out

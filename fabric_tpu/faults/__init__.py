@@ -10,7 +10,9 @@ from fabric_tpu.faults.plan import (  # noqa: F401
     configure,
     fire,
     install,
+    on_crash,
     plan,
+    remove_crash_hook,
     reset,
     shield,
 )

@@ -254,7 +254,17 @@ class FaultPlan:
                 f"injected truncated stream at {point}"
             )
         # crash: hard process death with NOTHING flushed — the
-        # crash-consistency tests run this in a child process
+        # crash-consistency tests run this in a child process.
+        # ``os._exit`` skips atexit by design (that is the point of the
+        # fault), so pre-crash hooks (the black-box flight recorder's
+        # last-gasp incident dump, observe/blackbox.py) run HERE, each
+        # contained — a broken hook must not save the process from its
+        # injected death
+        for hook in list(_crash_hooks):
+            try:
+                hook(point)
+            except Exception:  # fabtpu: noqa(FT005)
+                pass  # dying anyway; the crash semantics win
         os._exit(86)
 
     def stats(self) -> dict:
@@ -291,6 +301,25 @@ def _injected_counter():
 
 _plan: FaultPlan | None = None
 _tl = threading.local()
+
+#: pre-crash hooks: run (contained) right before a ``crash``-kind
+#: fault's ``os._exit`` — the one edge atexit cannot see.  The
+#: black-box recorder registers its incident dump here.
+_crash_hooks: list = []
+
+
+def on_crash(fn) -> None:
+    """Register ``fn(point)`` to run immediately before an injected
+    ``crash`` fault hard-exits the process.  Idempotent."""
+    if fn not in _crash_hooks:
+        _crash_hooks.append(fn)
+
+
+def remove_crash_hook(fn) -> None:
+    try:
+        _crash_hooks.remove(fn)
+    except ValueError:
+        pass  # already removed — detach is idempotent
 
 
 def _shielded() -> bool:

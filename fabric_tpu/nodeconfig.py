@@ -166,6 +166,26 @@ class PeerConfig:
     # The engine rides the tracer, so trace_ring_blocks=0 silences
     # SLOs too.  FABTPU_SLOS overrides like any scalar.
     slos: str = ""
+    # flight-data recorder (fabric_tpu/observe/timeseries.py +
+    # blackbox.py): with vitals_interval_s > 0, a daemon sampler walks
+    # the metrics registry every interval and keeps per-metric bounded
+    # rings of (t, value) points — delta-aware for counters and
+    # histograms — served at /vitals on the operations server and
+    # frozen into black-box incident bundles when an incident edge
+    # fires (degrade latch, autopilot shed, SLO fast burn, pipeline
+    # fail-closed, injected crash).  0 = recorder OFF (the default):
+    # no sampler thread exists and every incident hook is one global
+    # read.  vitals_retention bounds each series ring.
+    vitals_interval_s: float = 0.0
+    vitals_retention: int = 240
+    # black-box bundle directory: each incident writes one bounded
+    # JSON bundle here (blackbox-<seq>-<kind>.json) in addition to the
+    # in-memory index /vitals serves.  "" keeps bundles in memory only
+    # (still served at /vitals?incident=K while the recorder is
+    # armed).  Setting blackbox_dir WITHOUT vitals_interval_s arms the
+    # incident recorder alone — bundles then carry trace/SLO/autopilot
+    # context but no metric trails.
+    blackbox_dir: str = ""
     # device-lane degradation (peer/degrade.py DeviceLaneGuard): after
     # device_fail_threshold CONSECUTIVE device-verify failures the
     # validator latches a degraded CPU mode (ops/p256.verify_host +
@@ -471,6 +491,16 @@ def _load(cls, source, environ=None):
         raise ConfigError(
             f"key 'host_stage_mode': must be 'thread' or 'process', "
             f"got {cfg.host_stage_mode!r}"
+        )
+    if isinstance(cfg, PeerConfig) and cfg.vitals_interval_s < 0:
+        raise ConfigError(
+            f"key 'vitals_interval_s': must be >= 0 seconds (0 = "
+            f"recorder off), got {cfg.vitals_interval_s}"
+        )
+    if isinstance(cfg, PeerConfig) and cfg.vitals_retention < 1:
+        raise ConfigError(
+            f"key 'vitals_retention': must be >= 1 points per series, "
+            f"got {cfg.vitals_retention}"
         )
     if isinstance(cfg, PeerConfig) and cfg.autopilot_tick_s <= 0:
         raise ConfigError(

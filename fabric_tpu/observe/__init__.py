@@ -1,6 +1,8 @@
 """fabric_tpu.observe — block-commit span tracing (tracer.py), the
-latency/error SLO burn-rate engine (slo.py), and the pipeline
-overlap-coverage analyzer (overlap.py)."""
+latency/error SLO burn-rate engine (slo.py), the pipeline
+overlap-coverage analyzer (overlap.py), and the flight-data recorder:
+metrics time-series trails (timeseries.py) + black-box incident
+bundles (blackbox.py), served at ``/vitals``."""
 
 from fabric_tpu.observe.overlap import (  # noqa: F401
     coverage_from_roots,

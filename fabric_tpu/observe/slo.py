@@ -285,6 +285,16 @@ class SloEngine:
             )
         if fast_burn is not None:
             self._fast_ctr.add(1, slo=objective.name, channel=channel)
+            # incident edge: the fast-burn WARN is rate-limited to one
+            # per window already, so the black-box hook inherits that
+            # cadence (plus its own per-kind limit)
+            from fabric_tpu.observe import blackbox
+
+            blackbox.notify(
+                "slo_fast_burn", slo=objective.name, channel=channel,
+                burn=round(fast_burn, 4),
+                window_s=objective.windows[0],
+            )
             _log.warning(
                 "SLO %s fast burn on channel %r: burn rate %.1f over "
                 "the %s window (threshold %.1f, budget %.2f%%) — the "

@@ -183,6 +183,14 @@ class Registry:
         with self._lock:
             return self._metrics.get(name)
 
+    def metrics(self) -> list[tuple[str, object]]:
+        """Sorted copy of the live metric table (the flight-data
+        recorder's sampler walks this, then reads each instrument
+        through its own locked ``snapshot()`` — the registry lock is
+        held only for the table copy, exactly like ``render``)."""
+        with self._lock:
+            return sorted(self._metrics.items())
+
     def render(self) -> str:
         # take the registry lock only to copy the metric table; each
         # instrument's snapshot() then takes the (same, non-reentrant)

@@ -47,7 +47,8 @@ class OperationsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  registry: Registry | None = None,
                  health: HealthRegistry | None = None,
-                 tracer=None, slo=None, autopilot=None):
+                 tracer=None, slo=None, autopilot=None,
+                 vitals=None, blackbox=None):
         self.host, self.port = host, port
         self.registry = registry or global_registry()
         self.health = health or HealthRegistry()
@@ -65,6 +66,11 @@ class OperationsServer:
         # process-global handle lazily per request, so a controller
         # armed after the ops server starts is still served)
         self.autopilot = autopilot
+        # /vitals: the flight-data recorder — metrics time-series
+        # sampler + black-box incident index (both default to lazy
+        # process-global resolution, like /autopilot)
+        self.vitals = vitals
+        self.blackbox = blackbox
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self):
@@ -174,6 +180,8 @@ class OperationsServer:
             return 200, "application/json", json.dumps(
                 {"configured": True, **ap.report()}
             ).encode()
+        if path == "/vitals" or path.startswith("/vitals?"):
+            return self._route_vitals(path)
         if path.startswith("/debug/"):
             return self._route_debug(path)
         return 404, "application/json", b'{"error": "not found"}'
@@ -254,6 +262,56 @@ class OperationsServer:
         }
         if ns:
             payload["ns"] = ns
+        return 200, "application/json", json.dumps(payload).encode()
+
+    def _route_vitals(self, path: str):
+        """Flight-data recorder surface (fabric_tpu.observe.timeseries
+        + .blackbox): ``/vitals`` serves the sampler's sparkline-style
+        summaries next to the black-box incident index;
+        ``?metric=NAME`` the full trailing series of one metric (every
+        label variant); ``?incident=K`` one incident bundle in full.
+        Unarmed (the default) answers honestly: enabled false, no
+        series, no thread."""
+        from urllib.parse import parse_qs, urlparse
+
+        sampler = self.vitals
+        if sampler is None:
+            from fabric_tpu.observe import timeseries
+
+            sampler = timeseries.global_sampler()
+        bb = self.blackbox
+        if bb is None:
+            from fabric_tpu.observe import blackbox as _blackbox
+
+            bb = _blackbox.global_blackbox()
+        q = parse_qs(urlparse(path).query)
+        if "incident" in q:
+            try:
+                seq = int(q["incident"][0])
+            except ValueError:
+                return 400, "application/json", b'{"error": "bad incident"}'
+            bundle = bb.bundle(seq) if bb is not None else None
+            if bundle is None:
+                return 404, "application/json", json.dumps(
+                    {"error": f"incident {seq} not in the black box"}
+                ).encode()
+            return 200, "application/json", json.dumps(bundle).encode()
+        if "metric" in q:
+            name = q["metric"][0]
+            series = (
+                sampler.series(metric=name) if sampler is not None else {}
+            )
+            if not series:
+                return 404, "application/json", json.dumps(
+                    {"error": f"no recorded series for metric {name!r}"}
+                ).encode()
+            return 200, "application/json", json.dumps(
+                {"metric": name, "series": series[name]}
+            ).encode()
+        payload: dict = {"enabled": sampler is not None}
+        if sampler is not None:
+            payload.update(sampler.report())
+        payload["incidents"] = bb.bundles() if bb is not None else []
         return 200, "application/json", json.dumps(payload).encode()
 
     def _route_debug(self, path: str):

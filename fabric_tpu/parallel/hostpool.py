@@ -43,6 +43,16 @@ from collections import deque
 from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 
 
+def clamp_workers(n: int, cores: int | None = None) -> int:
+    """The ONE resize-clamp rule (shared by ``set_workers`` and the
+    validator's post-swap size prediction, so the two can never
+    drift): a pool runs at least 2 workers and at most the core
+    count — dropping below 2 is a close, not a resize."""
+    if cores is None:
+        cores = os.cpu_count() or 1
+    return max(2, min(int(n), max(2, cores)))
+
+
 def _pool_hist():
     from fabric_tpu.ops_metrics import global_registry
 
@@ -121,6 +131,15 @@ class HostStagePool:
         self._durs: deque = deque(maxlen=1024)
         self._lock = threading.Lock()
         self._tasks = 0
+        # runtime resize (the autopilot's host_stage_workers
+        # actuator): set_workers latches a target; the swap happens at
+        # a TASK BOUNDARY — the next submit that finds the pool idle
+        # (no in-flight tasks) drains the old executor and rebuilds.
+        # ``_active`` counts in-flight tasks; both are guarded by the
+        # same lock as the telemetry so a submitter can never hand a
+        # task to an executor mid-teardown.
+        self._active = 0
+        self._pending_workers: int | None = None
 
     # -- submission --------------------------------------------------------
 
@@ -164,21 +183,78 @@ class HostStagePool:
                 self._observe(stage, worker, time.perf_counter() - t0)
         return run
 
+    # -- runtime resize (autopilot actuator) -------------------------------
+
+    def set_workers(self, n: int) -> None:
+        """Request a new worker count, applied drain-and-rebuild at
+        the next task boundary: the first ``submit`` that finds the
+        pool IDLE swaps in a fresh executor (the old one, empty, shuts
+        down instantly).  In-flight tasks always finish on the
+        executor that started them — a resize can never strand or
+        interleave a shard.  ``n`` clamps via :func:`clamp_workers`
+        (a pool below 2 workers is not a pool; dropping to 0 is a
+        close, not a resize)."""
+        n = clamp_workers(n)
+        with self._lock:
+            self._pending_workers = None if n == self.workers else n
+
+    def _maybe_resize_locked(self):
+        """Caller holds the lock.  Returns the executor a new task
+        must be submitted to (post-swap when a pending resize applies
+        at this idle boundary)."""
+        n = self._pending_workers
+        if n is None or self._active > 0:
+            return self._ex
+        self._pending_workers = None
+        old = self._ex
+        if self.mode == "process":
+            import multiprocessing as mp
+
+            self._ex = ProcessPoolExecutor(
+                n, mp_context=mp.get_context("spawn")
+            )
+        else:
+            self._ex = ThreadPoolExecutor(
+                n, thread_name_prefix="fabtpu-hoststage"
+            )
+        self.workers = n
+        # idle by the _active==0 guard: shutdown returns immediately
+        old.shutdown(wait=False)
+        return self._ex
+
+    def _task_done(self, _fut) -> None:
+        with self._lock:
+            self._active -= 1
+
     def submit(self, fn, *args, stage: str = "task", **kwargs):
         """Submit one task; returns a Future.  Thread mode times the
         task inside its worker; process mode times submit→done in the
         parent (the child's registry is not this process's)."""
-        if self.mode == "process":
-            t0 = time.perf_counter()
-            fut = self._ex.submit(fn, *args, **kwargs)
-            fut.add_done_callback(
-                lambda f: self._observe(stage, "proc",
-                                        time.perf_counter() - t0)
-            )
-            return fut
-        return self._ex.submit(
-            self._timed(fn, stage, self._trc.current()), *args, **kwargs
-        )
+        with self._lock:
+            ex = self._maybe_resize_locked()
+            # counted BEFORE the lock releases: a concurrent resize
+            # check can never see the pool idle while this task is on
+            # its way to ``ex``
+            self._active += 1
+        try:
+            if self.mode == "process":
+                t0 = time.perf_counter()
+                fut = ex.submit(fn, *args, **kwargs)
+                fut.add_done_callback(
+                    lambda f: self._observe(stage, "proc",
+                                            time.perf_counter() - t0)
+                )
+            else:
+                fut = ex.submit(
+                    self._timed(fn, stage, self._trc.current()),
+                    *args, **kwargs
+                )
+        except BaseException:
+            with self._lock:
+                self._active -= 1
+            raise
+        fut.add_done_callback(self._task_done)
+        return fut
 
     def map(self, fn, items, stage: str = "task") -> list:
         """Ordered parallel map: fan every item out, gather in order.
@@ -233,12 +309,15 @@ class HostStagePool:
         with self._lock:
             durs = sorted(self._durs)
             tasks = self._tasks
+            pending = self._pending_workers
         p50 = durs[len(durs) // 2] if durs else 0.0
         return {
             "workers": self.workers,
             "mode": self.mode,
             "tasks": tasks,
             "per_shard_p50_ms": round(p50 * 1000.0, 3),
+            **({"pending_workers": pending} if pending is not None
+               else {}),
         }
 
     def shutdown(self) -> None:

@@ -148,6 +148,17 @@ class DeviceLaneGuard:
                 "fallback; recovery probe every %.1fs",
                 self.channel or "validator", n, err, self.recovery_s,
             )
+            # incident edge: the latch is exactly the moment the
+            # flight-data recorder should freeze the trailing story
+            # (import inside the rare branch — the unarmed fast path
+            # never pays it)
+            from fabric_tpu.observe import blackbox
+
+            blackbox.notify(
+                "degrade_latch", channel=self.channel,
+                consecutive_failures=n,
+                error=str(err) if err is not None else None,
+            )
 
     def record_success(self) -> None:
         with self._lock:

@@ -246,6 +246,12 @@ class PeerChannel:
             fn = getattr(self.validator, "set_verify_chunk", None)
             if fn is not None:
                 fn(int(value))
+        elif knob == "host_stage_workers":
+            # block-boundary pool resize (validator latch →
+            # HostStagePool.set_workers drain-and-rebuild)
+            fn = getattr(self.validator, "set_host_stage_workers", None)
+            if fn is not None:
+                fn(int(value))
         elif knob == "coalesce_blocks":
             # the deliver driver reads this attribute per iteration,
             # so the new group size takes effect on the next drain
@@ -1082,6 +1088,9 @@ class PeerNode:
                  trace_ring_blocks: int | None = None,
                  trace_slow_factor: float | None = None,
                  slos: str = "",
+                 vitals_interval_s: float = 0.0,
+                 vitals_retention: int = 240,
+                 blackbox_dir: str = "",
                  autopilot: bool = False,
                  autopilot_tick_s: float = 1.0,
                  autopilot_knobs: str = "",
@@ -1117,6 +1126,15 @@ class PeerNode:
         # tracer knobs — a constructor side effect would let a second
         # node silently wipe the first's engine state
         self.slos = slos
+        # flight-data recorder knobs (nodeconfig ``vitals_interval_s``
+        # / ``vitals_retention`` / ``blackbox_dir``): armed at start(),
+        # like the SLO engine — interval 0 (the default) builds no
+        # sampler thread and leaves every incident hook a no-op
+        self.vitals_interval_s = float(vitals_interval_s)
+        self.vitals_retention = int(vitals_retention)
+        self.blackbox_dir = blackbox_dir
+        self.vitals = None
+        self.blackbox = None
         # traffic autopilot (nodeconfig ``autopilot`` / ``autopilot_
         # tick_s`` / ``autopilot_knobs``): built and started at
         # start() — OFF by default, so tier-1/CPU hosts never even
@@ -1395,7 +1413,10 @@ class PeerNode:
             # process serves one) + the tracer's flight recorder, and
             # actuates every joined channel's runtime setters.  All
             # knobs stay inside the operator's validated clamp spec.
-            from fabric_tpu.control import Autopilot, set_global
+            from fabric_tpu.control import (
+                Autopilot, host_clamped_specs, parse_knob_specs,
+                resolve_host_workers_initial, set_global,
+            )
             from fabric_tpu.observe.slo import global_engine
 
             def _apply(knob, value):
@@ -1403,11 +1424,25 @@ class PeerNode:
                 # join_channel mutates the dict on the event loop
                 for ch in list(self.channels.values()):
                     ch.apply_knob(knob, value)
+                # a colocated sidecar server shares the coalescing
+                # pressure signal (its scheduler's queue ages drive
+                # the rule), so the cross-tenant dispatch cap follows
+                # the same actuation through its drain-boundary setter
+                if (knob == "coalesce_blocks"
+                        and self.sidecar_server is not None):
+                    self.sidecar_server.set_coalesce(int(value))
 
             sched = (self.sidecar_server.scheduler
                      if self.sidecar_server is not None else None)
             self.autopilot_ctl = Autopilot(
-                self.autopilot_knobs or None, _apply,
+                # the host-workers ladder clamps to this machine's
+                # cores (rungs the pool cannot take must not charge
+                # cooldowns or log phantom decisions), and its
+                # starting value is the RESOLVED pool size, not the
+                # raw config (−1 would snap to 0 and invert the knob)
+                host_clamped_specs(
+                    parse_knob_specs(self.autopilot_knobs or None)
+                ), _apply,
                 set_weight=(sched.set_weight if sched else None),
                 set_shed=(sched.set_shed if sched else None),
                 slo=global_engine(), scheduler=sched,
@@ -1416,12 +1451,40 @@ class PeerNode:
                     "coalesce_blocks": self.coalesce_blocks,
                     "verify_chunk": self.verify_chunk,
                     "pipeline_depth": self.pipeline_depth,
+                    "host_stage_workers": resolve_host_workers_initial(
+                        self.host_stage_workers
+                    ),
                 },
             )
             if self.sidecar_server is not None:
                 self.sidecar_server.autopilot = self.autopilot_ctl
             set_global(self.autopilot_ctl)
             self.autopilot_ctl.start()
+        if self.vitals_interval_s > 0 or self.blackbox_dir:
+            # flight-data recorder: the sampler keeps trailing metric
+            # series (/vitals) and the black-box recorder freezes them
+            # — plus trace trees, the autopilot decision log, scheduler
+            # stats, SLO burn and fault stats — into one bundle per
+            # incident edge.  Armed only here: the default config
+            # builds neither the thread nor the recorder.
+            from fabric_tpu.observe import blackbox as _blackbox
+            from fabric_tpu.observe import timeseries as _timeseries
+
+            # REFCOUNTED arming: colocated nodes share one sampler
+            # and one recorder, and only the LAST stop() disarms —
+            # neither the creator nor a later arriver stopping first
+            # can strand the survivor (acquire/release in the observe
+            # modules; a second acquire reuses the live instances)
+            if self.vitals_interval_s > 0:
+                self.vitals = _timeseries.acquire(
+                    interval_s=self.vitals_interval_s,
+                    retention=self.vitals_retention,
+                )
+            self.blackbox = _blackbox.acquire(
+                out_dir=self.blackbox_dir,
+                scheduler=(self.sidecar_server.scheduler
+                           if self.sidecar_server is not None else None),
+            )
         self.operations = None
         if operations_port is not None:
             from fabric_tpu.opsserver import HealthRegistry, OperationsServer
@@ -1464,11 +1527,24 @@ class PeerNode:
                 )
             self.operations = await OperationsServer(
                 port=operations_port, health=health,
-                autopilot=self.autopilot_ctl,
+                autopilot=self.autopilot_ctl, vitals=self.vitals,
+                blackbox=self.blackbox,
             ).start()
         return self
 
     async def stop(self):
+        if self.vitals is not None:
+            # refcounted: the shared sampler stops only when the last
+            # colocated holder releases (see start())
+            from fabric_tpu.observe import timeseries as _timeseries
+
+            _timeseries.release()
+            self.vitals = None
+        if self.blackbox is not None:
+            from fabric_tpu.observe import blackbox as _blackbox
+
+            _blackbox.release()
+            self.blackbox = None
         if self.autopilot_ctl is not None:
             # disable BEFORE stopping so /autopilot (and the gauge)
             # never reads a dead control loop as live, and release the

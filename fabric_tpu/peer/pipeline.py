@@ -378,6 +378,17 @@ class CommitPipeline:
         if self._closed:
             return
         self._closed = True
+        # incident edge: a quarantined block is the attribution case
+        # the flight-data recorder exists for — bundle the trailing
+        # series + trace trees before the in-flight state is dropped
+        from fabric_tpu.observe import blackbox
+
+        failure = self.last_failure
+        blackbox.notify(
+            "pipeline_fail_closed", channel=self.channel,
+            block=failure[0] if failure else None,
+            stage=failure[1] if failure else None,
+        )
         self._pre = None
         self._launched = None
         self._launched_root = None

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import hashlib
 import logging
+import threading
 import time
 from dataclasses import dataclass, field
 from functools import cached_property
@@ -338,8 +339,12 @@ class BlockValidator:
         # device compute overlaps chunk k+1's host staging.  0 = one
         # monolithic launch (nodeconfig ``verify_chunk``).
         self.verify_chunk = int(verify_chunk)
-        # latched by set_verify_chunk (the autopilot actuator), applied
-        # at the next block boundary
+        # latched by set_verify_chunk / set_host_stage_workers (the
+        # autopilot actuators), applied at the next block boundary.
+        # The latch is locked: the controller thread sets while the
+        # prefetch thread applies, and a bare read-then-clear would
+        # drop a step landing between the read and the None store.
+        self._knob_lock = threading.Lock()
         self._pending_verify_chunk: int | None = None
         # device-mesh sharding of the production dispatch (nodeconfig
         # ``mesh_devices``): batch lanes of the verify kernel AND the
@@ -454,13 +459,52 @@ class BlockValidator:
         ``preprocess_many``, where this block's verify dispatch has
         not started) — a block's chunked launch always runs under one
         chunk size, never a mid-window mix.  0 = monolithic."""
-        self._pending_verify_chunk = max(0, int(n))
+        with self._knob_lock:
+            self._pending_verify_chunk = max(0, int(n))
+
+    def set_host_stage_workers(self, n: int) -> None:
+        """Request a new host staging pool size (the autopilot's
+        ``host_stage_workers`` actuator), applied at the next block
+        boundary: ``n >= 2`` resizes the live pool (HostStagePool.
+        set_workers — drain-and-rebuild at a task boundary) or builds
+        one where none existed; ``n < 2`` closes the pool back to
+        serial staging.  Bit-equal either way — pooled ≡ serial is
+        pinned, so the knob only moves time."""
+        with self._knob_lock:
+            self._pending_host_workers = max(0, int(n))
 
     def _apply_pending_knobs(self) -> None:
-        n = getattr(self, "_pending_verify_chunk", None)
+        with self._knob_lock:
+            n, self._pending_verify_chunk = (
+                self._pending_verify_chunk, None,
+            )
+            w = getattr(self, "_pending_host_workers", None)
+            self._pending_host_workers = None
         if n is not None:
-            self._pending_verify_chunk = None
             self.verify_chunk = n
+        if w is not None:
+            if w < 2:
+                pool, self.host_pool = self.host_pool, None
+                self.host_stage_workers = 0
+                if pool is not None:
+                    pool.shutdown()
+            elif self.host_pool is not None:
+                from fabric_tpu.parallel.hostpool import clamp_workers
+
+                self.host_pool.set_workers(w)
+                # report the clamped TARGET (what the pool will be
+                # after its idle-boundary swap) — pool.workers still
+                # reads the pre-swap count here, and nothing would
+                # ever write the attribute back after the swap
+                self.host_stage_workers = clamp_workers(w)
+            else:
+                from fabric_tpu.parallel.hostpool import resolve_host_pool
+
+                self.host_pool = resolve_host_pool(w)
+                self.host_stage_workers = (
+                    self.host_pool.workers
+                    if self.host_pool is not None else 0
+                )
 
     def _t(self, key: str, t0: float) -> float:
         t1 = time.perf_counter()

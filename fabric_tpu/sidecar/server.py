@@ -58,6 +58,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import threading
 from concurrent.futures import ThreadPoolExecutor
 
 from fabric_tpu import faults as _faults
@@ -146,6 +147,50 @@ class SidecarServer:
         self._conns = 0
         self._req_counter = 0  # tracer "block" numbers for requests
         self._stopped = False
+        # runtime re-knobbing (the sidecar-local autopilot's
+        # actuators): latched here, applied at the next
+        # dispatcher-drain boundary — a coalesced group is always
+        # built AND dispatched under one knob vector.  The latch is
+        # LOCKED: a bare read-then-clear would drop a set_* landing
+        # from the controller thread between the dispatcher's read
+        # and its None store, leaving the controller's knob state and
+        # the live dispatch permanently disagreeing.
+        self._knob_lock = threading.Lock()
+        self._pending_coalesce: int | None = None
+        self._pending_verify_chunk: int | None = None
+
+    # -- runtime re-knobbing (autopilot actuators) -------------------------
+
+    def set_coalesce(self, n: int) -> None:
+        """Request a new cross-tenant coalescing cap, applied at the
+        next dispatcher-drain boundary (before the next
+        ``next_batch`` pop — never between a batch's pop and its
+        dispatch).  Values < 1 clamp to 1 (a dispatch always carries
+        at least one request)."""
+        with self._knob_lock:
+            self._pending_coalesce = max(1, int(n))
+
+    def set_verify_chunk(self, n: int) -> None:
+        """Request a new device microbatch chunk for the sidecar's OWN
+        dispatch, applied at the same drain boundary.  0 =
+        monolithic."""
+        with self._knob_lock:
+            self._pending_verify_chunk = max(0, int(n))
+
+    def _apply_pending_knobs(self) -> None:
+        with self._knob_lock:
+            c, self._pending_coalesce = self._pending_coalesce, None
+            v, self._pending_verify_chunk = (
+                self._pending_verify_chunk, None,
+            )
+        if c is not None and c != self.coalesce:
+            _log.info("sidecar coalesce re-knobbed %d -> %d",
+                      self.coalesce, c)
+            self.coalesce = c
+        if v is not None and v != self.verify_chunk:
+            _log.info("sidecar verify_chunk re-knobbed %d -> %d",
+                      self.verify_chunk, v)
+            self.verify_chunk = v
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -336,6 +381,11 @@ class SidecarServer:
             await self._work.wait()
             self._work.clear()
             while True:
+                # drain boundary: adopt any latched knob values before
+                # the next batch is built (set_coalesce /
+                # set_verify_chunk — the sidecar-local autopilot's
+                # actuation point)
+                self._apply_pending_knobs()
                 batch = self.scheduler.next_batch(self.coalesce)
                 if not batch:
                     break

@@ -1,0 +1,253 @@
+#!/usr/bin/env python3
+"""Text postmortem for black-box incident bundles — read a crash in a
+terminal, no browser, no dashboard.
+
+Input: one bundle JSON written by the black-box recorder
+(``blackbox-<seq>-<kind>.json`` from the ``blackbox_dir`` knob, or
+``curl :PORT/vitals?incident=K > bundle.json``).
+
+Output, in postmortem reading order:
+
+* the incident header (kind, detail, clocks),
+* the trailing metric trails as ASCII sparklines (counters as
+  per-interval deltas, gauges as levels, histograms as interval
+  p99s) so the minutes BEFORE the incident are visible,
+* the autopilot decision log as a timeline relative to the incident,
+* the SLO burn snapshot and scheduler per-tenant rows,
+* the fault-injection stats (what the chaos plan actually did), and
+* the captured trace trees, rendered through scripts/traceview.py's
+  waterfall.
+
+Usage:
+  python scripts/blackbox_view.py bundle.json [--series N] [--no-traces]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def spark(values: list) -> str:
+    """Numbers → a sparkline string (empty-safe; None points gap)."""
+    nums = [v for v in values if isinstance(v, (int, float))]
+    if not nums:
+        return ""
+    lo, hi = min(nums), max(nums)
+    span = (hi - lo) or 1.0
+    out = []
+    for v in values:
+        if not isinstance(v, (int, float)):
+            out.append(" ")
+            continue
+        i = int((v - lo) / span * (len(_SPARK) - 1))
+        out.append(_SPARK[i])
+    return "".join(out)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def render_header(b: dict) -> list[str]:
+    lines = [
+        "=" * 72,
+        "BLACK-BOX BUNDLE #%s — incident: %s" % (
+            b.get("seq", "?"), b.get("kind", "?")),
+        "=" * 72,
+        "  t_s (monotonic): %s   wall_s: %s" % (
+            b.get("t_s", "?"), b.get("wall_s", "?")),
+    ]
+    for k, v in sorted((b.get("detail") or {}).items()):
+        lines.append(f"  {k}: {_fmt(v)}")
+    if b.get("truncated"):
+        lines.append(
+            "  ! truncated sections (size bound): "
+            + ", ".join(b["truncated"])
+        )
+    return lines
+
+
+def render_vitals(vitals: dict, limit: int | None = None) -> list[str]:
+    lines = ["", "-- metric trails (newest right) " + "-" * 38]
+    shown = 0
+    for metric in sorted(vitals):
+        for labels, series in sorted(vitals[metric].items()):
+            pts = series.get("points", [])
+            kind = series.get("kind", "?")
+            if kind == "histogram":
+                vals = [
+                    (p[1] or {}).get("p99") for p in pts
+                ]
+                unit = "interval p99"
+            else:
+                vals = [p[1] for p in pts]
+                unit = "delta/interval" if kind == "counter" else "level"
+            nums = [v for v in vals if isinstance(v, (int, float))]
+            if not nums:
+                continue
+            shown += 1
+            if limit is not None and shown > limit:
+                lines.append("  ... (more series; --series 0 for all)")
+                return lines
+            lines.append(
+                "  %-44s %s" % (
+                    f"{metric}{{{labels}}}"[:44], spark(vals[-48:]))
+            )
+            lines.append(
+                "  %-44s last %s  min %s  max %s  (%s, %d pts)" % (
+                    "", _fmt(nums[-1]), _fmt(min(nums)),
+                    _fmt(max(nums)), unit, len(pts),
+                )
+            )
+    if shown == 0:
+        lines.append("  (no series — sampler was not armed)")
+    return lines
+
+
+def render_autopilot(ap: dict, t_incident: float | None) -> list[str]:
+    lines = ["", "-- autopilot " + "-" * 57]
+    knobs = ap.get("knobs", {})
+    if knobs:
+        lines.append("  knob vector: " + "  ".join(
+            f"{k}={v.get('value')}" for k, v in sorted(knobs.items())
+        ))
+    tenants = ap.get("tenants", {})
+    if tenants.get("shed"):
+        lines.append("  SHED tenants: " + ", ".join(tenants["shed"]))
+    if tenants.get("weights"):
+        lines.append("  weights: " + "  ".join(
+            f"{t}={w}" for t, w in sorted(tenants["weights"].items())
+        ))
+    decisions = ap.get("decisions", [])
+    if decisions:
+        lines.append("  decision log (dt = seconds before incident):")
+        for d in decisions:
+            dt = ""
+            if t_incident is not None and isinstance(
+                    d.get("t_s"), (int, float)):
+                dt = "%+8.1fs " % (d["t_s"] - t_incident)
+            tenant = f" tenant={d['tenant']}" if d.get("tenant") else ""
+            lines.append(
+                "    %s%-18s %-4s %s -> %s  (%s=%s > %s)%s" % (
+                    dt, d.get("knob"), d.get("direction"),
+                    d.get("from"), d.get("to"), d.get("signal"),
+                    _fmt(d.get("value")), _fmt(d.get("threshold")),
+                    tenant,
+                )
+            )
+    return lines
+
+
+def render_slo(slo: dict) -> list[str]:
+    lines = ["", "-- slo burn snapshot " + "-" * 49]
+    for o in slo.get("objectives", []):
+        for channel, row in sorted(o.get("channels", {}).items()):
+            lines.append(
+                "  %-20s %-18s %-10s burns %s  (%d events, %d bad)" % (
+                    o.get("name"), channel or "-",
+                    row.get("status", "?"),
+                    " ".join(
+                        f"{w}={_fmt(v) if v is not None else '-'}"
+                        for w, v in sorted(
+                            (row.get("burn") or {}).items())
+                    ),
+                    row.get("events", 0), row.get("bad", 0),
+                )
+            )
+    return lines
+
+
+def render_scheduler(sched: dict) -> list[str]:
+    lines = ["", "-- scheduler tenants " + "-" * 49]
+    for name, r in sorted(sched.items()):
+        age = r.get("queue_age_ms") or {}
+        lines.append(
+            "  %-12s w=%-5s depth=%-3s share=%-7s busy_rate=%-7s "
+            "shed=%s age p99=%sms" % (
+                name, r.get("weight"), r.get("depth"),
+                r.get("share"), r.get("busy_rate"),
+                r.get("shed"), age.get("p99"),
+            )
+        )
+    return lines
+
+
+def render_faults(stats: dict) -> list[str]:
+    lines = ["", "-- fault plan " + "-" * 56]
+    for point, rules in sorted(stats.items()):
+        for r in rules:
+            lines.append(
+                "  %-32s %-12s arrivals=%-5d fired=%d" % (
+                    point, r.get("kind"), r.get("arrivals", 0),
+                    r.get("fired", 0),
+                )
+            )
+    return lines
+
+
+def render_traces(traces: dict) -> list[str]:
+    import os
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.abspath(__file__))
+    )
+    import traceview
+
+    lines = ["", "-- trace trees " + "-" * 55]
+    for ns in sorted(traces):
+        trees = traces[ns] or []
+        if not trees:
+            continue
+        lines.append(f"  [namespace {ns}]")
+        for tree in trees:
+            lines.extend(
+                "  " + ln for ln in
+                traceview.render_tree(tree).splitlines()
+            )
+            lines.append("")
+    return lines
+
+
+def render_bundle(b: dict, series_limit: int | None = 24,
+                  traces: bool = True) -> str:
+    lines = render_header(b)
+    if "vitals" in b:
+        lines += render_vitals(b["vitals"], limit=series_limit)
+    if "autopilot" in b:
+        lines += render_autopilot(b["autopilot"], b.get("t_s"))
+    if "slo" in b:
+        lines += render_slo(b["slo"])
+    if "scheduler" in b:
+        lines += render_scheduler(b["scheduler"])
+    if "faults" in b:
+        lines += render_faults(b["faults"])
+    if traces and "traces" in b:
+        lines += render_traces(b["traces"])
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="black-box bundle JSON")
+    ap.add_argument("--series", type=int, default=24,
+                    help="max metric series rendered (0 = all)")
+    ap.add_argument("--no-traces", action="store_true",
+                    help="skip the trace-tree waterfalls")
+    args = ap.parse_args(argv)
+    with open(args.path) as f:
+        bundle = json.load(f)
+    print(render_bundle(
+        bundle, series_limit=args.series or None,
+        traces=not args.no_traces,
+    ))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,6 +103,7 @@ class TestKnobSpecs:
         "verify_chunk:min",            # not k=v
         "verify_chunk:min=abc",        # unparsable
         "shed:cool=-1",                # negative cooldown
+        "host_stage_workers:min=1",    # a 1-worker pool does not exist
     ])
     def test_malformed_specs_raise(self, bad):
         with pytest.raises(KnobSpecError):
@@ -220,6 +221,108 @@ class TestController:
         d = ap.tick(Signals(overlap_coverage=0.95, clock_s=60.0))
         assert (d.knob, d.direction, d.new) == ("pipeline_depth",
                                                 "up", 4)
+
+    def test_host_stage_workers_ladder_and_defaults(self):
+        ks = parse_knob_specs("")
+        # 1 is meaningless (resolve_host_pool returns None below 2):
+        # the ladder jumps serial → 2 workers
+        assert ks["host_stage_workers"].ladder() == (0, 2, 3, 4)
+        ks = parse_knob_specs("host_stage_workers:min=2:max=6")
+        assert ks["host_stage_workers"].ladder() == (2, 3, 4, 5, 6)
+
+    def test_host_workers_initial_resolution_never_inverts(self):
+        """Raw −1 (one worker per core) must reach the ladder snap as
+        the RESOLVED pool size — snapping it to 0 would make the
+        first slow-feeder 'up' step SHRINK a per-core pool."""
+        from fabric_tpu.control import resolve_host_workers_initial
+
+        assert resolve_host_workers_initial(-1, cores=8) == 8
+        assert resolve_host_workers_initial(-1, cores=1) == 0
+        assert resolve_host_workers_initial(0, cores=8) == 0
+        assert resolve_host_workers_initial(1, cores=8) == 0
+        assert resolve_host_workers_initial(3, cores=8) == 3
+        assert resolve_host_workers_initial(16, cores=2) == 2
+
+    def test_host_workers_ladder_clamps_to_cores(self):
+        """Rungs above the core count would charge cooldowns and log
+        decisions the pool can never act on — the spec clamps to the
+        machine before the controller is built."""
+        from fabric_tpu.control import host_clamped_specs
+
+        specs = host_clamped_specs(parse_knob_specs(""), cores=3)
+        assert specs["host_stage_workers"].ladder() == (0, 2, 3)
+        # other knobs untouched
+        assert specs["pipeline_depth"].ladder() == (2, 3, 4)
+        # a 1-core host leaves the knob structurally inert (1 rung)
+        one = host_clamped_specs(parse_knob_specs(""), cores=1)
+        assert one["host_stage_workers"].ladder() == (0,)
+        clk = Clock(0.0)
+        ap, acts = _pilot(clk, specs=one)
+        assert ap.tick(Signals(prefetch_p99_ms=900.0,
+                               clock_s=20.0)) is None
+        assert acts == []
+        # already inside the machine: the spec passes through as-is
+        ok = parse_knob_specs("")
+        assert host_clamped_specs(ok, cores=16) is ok
+
+    def test_host_stage_workers_steps_on_prefetch_p99(self):
+        """The PR-10 follow-up knob: a slow feeder (prefetch p99 over
+        the band) grows the staging pool; a comfortably-ahead feeder
+        walks it back toward serial."""
+        clk = Clock(0.0)
+        ap, acts = _pilot(clk)
+        d = ap.tick(Signals(prefetch_p99_ms=500.0, clock_s=20.0))
+        assert (d.knob, d.direction, d.new) == ("host_stage_workers",
+                                                "up", 2)
+        clk.advance(60.0)
+        d = ap.tick(Signals(prefetch_p99_ms=500.0, clock_s=80.0))
+        assert (d.knob, d.new) == ("host_stage_workers", 3)
+        clk.advance(60.0)
+        d = ap.tick(Signals(prefetch_p99_ms=1.0, clock_s=140.0))
+        assert (d.knob, d.direction, d.new) == ("host_stage_workers",
+                                                "down", 2)
+        # dead band holds — no flap between the thresholds
+        clk.advance(60.0)
+        assert ap.tick(Signals(prefetch_p99_ms=80.0,
+                               clock_s=200.0)) is None
+        assert [v for k, v in acts if k == "host_stage_workers"] == [
+            2, 3, 2
+        ]
+
+    def test_host_stage_workers_actuates_a_real_validator_pool(self):
+        """Pinned end-to-end actuation: decision → apply_knob →
+        BlockValidator.set_host_stage_workers → HostStagePool built/
+        resized at the block boundary (what preprocess() runs first)."""
+        import os
+
+        if (os.cpu_count() or 1) < 2:
+            pytest.skip("needs 2 cores")
+        # validator imports the MSP stack (seed condition on this host)
+        pytest.importorskip("cryptography")
+        from fabric_tpu.peer.validator import BlockValidator
+
+        v = BlockValidator(None, object(), MemVersionedDB())
+        try:
+            clk = Clock(0.0)
+            ap, _ = _pilot(clk)
+            ap.apply_knob = lambda k, val: (
+                v.set_host_stage_workers(int(val))
+                if k == "host_stage_workers" else None
+            )
+            d = ap.tick(Signals(prefetch_p99_ms=500.0, clock_s=20.0))
+            assert d.knob == "host_stage_workers" and d.new == 2
+            assert v.host_pool is None          # latched, block boundary
+            v._apply_pending_knobs()            # what preprocess() runs
+            assert v.host_pool is not None
+            assert v.host_pool.workers == 2
+            # recovery: the loop can walk the pool away again
+            clk.advance(60.0)
+            d = ap.tick(Signals(prefetch_p99_ms=1.0, clock_s=80.0))
+            assert (d.knob, d.new) == ("host_stage_workers", 0)
+            v._apply_pending_knobs()
+            assert v.host_pool is None
+        finally:
+            v.close()
 
     def test_shed_then_recover_round_trip(self):
         clk = Clock(0.0)

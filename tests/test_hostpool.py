@@ -126,6 +126,120 @@ def test_telemetry_labels():
     assert 'stage="unit_probe"' in text
 
 
+def _wait_idle(p, timeout=5.0):
+    """Done-callbacks (the in-flight decrement) can lag ``result()``
+    by a beat; the resize contract is 'the next submit that finds the
+    pool idle', so the tests wait for genuine idleness first."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with p._lock:
+            if p._active == 0:
+                return
+        time.sleep(0.005)
+    raise AssertionError("pool never drained")
+
+
+def test_set_workers_resizes_at_idle_task_boundary():
+    """The autopilot's host_stage_workers actuator: a latched resize
+    applies drain-and-rebuild at the next submit that finds the pool
+    idle — ordered results stay exact across the swap, and the live
+    worker count follows."""
+    with HostStagePool(2) as p:
+        assert p.map(lambda x: x + 1, range(8), stage="rs") == list(
+            range(1, 9)
+        )
+        cores = os.cpu_count() or 1
+        want = max(2, min(3, cores))
+        p.set_workers(want)
+        # latched, not yet applied (no submit happened)
+        assert p.stats().get("pending_workers") in (want, None)
+        _wait_idle(p)
+        assert p.map(lambda x: x * 2, range(8), stage="rs") == [
+            2 * x for x in range(8)
+        ]
+        assert p.workers == want
+        assert p.stats().get("pending_workers") is None
+        # shrink back down; clamps below 2 (a pool below 2 workers is
+        # a close, not a resize)
+        p.set_workers(1)
+        _wait_idle(p)
+        p.map(lambda x: x, range(4), stage="rs")
+        assert p.workers == 2
+
+
+def test_set_workers_same_value_is_a_noop():
+    with HostStagePool(2) as p:
+        p.set_workers(2)
+        assert p.stats().get("pending_workers") is None
+        assert p.map(lambda x: x, range(4)) == [0, 1, 2, 3]
+        assert p.workers == 2
+
+
+def test_set_workers_never_strands_inflight_tasks():
+    """A resize requested while tasks are in flight applies only once
+    the pool drains — every in-flight shard completes on the executor
+    that started it."""
+    import threading
+    import time
+
+    gate = threading.Event()
+
+    def slow(x):
+        gate.wait(5.0)
+        return x * 10
+
+    with HostStagePool(2) as p:
+        futs = [p.submit(slow, i, stage="slow") for i in range(4)]
+        p.set_workers(3)
+        # mid-flight submit must NOT trigger the swap (pool busy)
+        extra = p.submit(slow, 99, stage="slow")
+        assert p.workers == 2
+        gate.set()
+        assert [f.result(timeout=10) for f in futs] == [0, 10, 20, 30]
+        assert extra.result(timeout=10) == 990
+        # first idle submit adopts the resize
+        _wait_idle(p)
+        assert p.map(lambda x: x, range(4), stage="slow") == [0, 1, 2, 3]
+        assert p.workers == max(2, min(3, os.cpu_count() or 1))
+
+
+def test_validator_set_host_stage_workers_latches_at_block_boundary():
+    """BlockValidator's actuator seam: latch → applied at the next
+    ``_apply_pending_knobs`` (what preprocess() runs first) — build,
+    resize, and close-to-serial transitions."""
+    if (os.cpu_count() or 1) < 2:
+        pytest.skip("needs 2 cores")
+    pytest.importorskip("cryptography")  # validator imports the MSP stack
+    from fabric_tpu.ledger.statedb import MemVersionedDB
+    from fabric_tpu.peer.validator import BlockValidator
+
+    class _NoPolicies:
+        pass
+
+    v = BlockValidator(None, _NoPolicies(), MemVersionedDB())
+    try:
+        assert v.host_pool is None
+        # build a pool where none existed
+        v.set_host_stage_workers(2)
+        assert v.host_pool is None          # latched only
+        v._apply_pending_knobs()
+        assert v.host_pool is not None and v.host_pool.workers == 2
+        assert v.host_stage_workers == 2
+        pool = v.host_pool
+        # resize the live pool (applies at ITS next idle submit)
+        v.set_host_stage_workers(2)
+        v._apply_pending_knobs()
+        assert v.host_pool is pool          # same pool, no rebuild
+        # close back to serial staging
+        v.set_host_stage_workers(0)
+        v._apply_pending_knobs()
+        assert v.host_pool is None and v.host_stage_workers == 0
+    finally:
+        v.close()
+
+
 @pytest.mark.skipif((os.cpu_count() or 1) < 2, reason="needs 2 cores")
 def test_process_mode_smoke():
     # spawn-context children re-import task functions by qualified

@@ -342,6 +342,87 @@ class TestLoopback:
             link.close()
             loop_thread.run(srv.stop())
 
+    def test_set_coalesce_applies_at_drain_boundary(self, loop_thread):
+        """The PR-10 follow-up actuator: a latched coalesce re-knob
+        applies before the NEXT batch pop, never between a pop and its
+        dispatch — pinned by gating the device lane while the backlog
+        builds and the knob changes."""
+        gate = threading.Event()
+        sizes = []
+
+        def gated_verify(itemsets):
+            sizes.append(len(itemsets))
+            if len(sizes) == 1:
+                gate.wait(10.0)
+            return toy_verify(itemsets)
+
+        srv = make_server(loop_thread, verify_fn=gated_verify,
+                          coalesce=4, queue_blocks=8)
+        link = make_link(srv)
+        try:
+            # first batch pops alone and wedges the dispatcher on the
+            # gate; four more queue up behind it
+            handles = [link.submit([(1, 1, 0, 0, 0)])]
+            deadline = time.monotonic() + 5.0
+            while not sizes and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert sizes == [1]
+            handles += [
+                link.submit([(i, 1, 0, 0, 0)]) for i in range(2, 6)
+            ]
+            deadline = time.monotonic() + 5.0
+            while srv.scheduler.pending() < 4 and (
+                    time.monotonic() < deadline):
+                time.sleep(0.01)
+            srv.set_coalesce(2)          # latched mid-backlog
+            srv.set_verify_chunk(1024)   # rides the same boundary
+            assert srv.coalesce == 4     # not yet applied
+            gate.set()
+            assert [h.fetch() for h in handles] == [[True]] * 5
+            # the drain boundary adopted both knobs; the backlog went
+            # out in groups of the NEW size
+            assert srv.coalesce == 2 and srv.verify_chunk == 1024
+            assert sizes == [1, 2, 2]
+        finally:
+            link.close()
+            loop_thread.run(srv.stop())
+
+    def test_sidecar_local_autopilot_actuates_coalesce(
+        self, loop_thread
+    ):
+        """Server-side knob actuation off the sidecar's OWN scheduler
+        stats: a queue-age signal drives the local controller, whose
+        decision lands on the live dispatch via set_coalesce."""
+        from fabric_tpu.control import Autopilot, Signals
+        from fabric_tpu.observe import Tracer
+
+        srv = make_server(loop_thread, coalesce=4)
+        ap = Autopilot(
+            None,
+            lambda k, v: (srv.set_coalesce(int(v))
+                          if k == "coalesce_blocks" else None),
+            set_weight=srv.scheduler.set_weight,
+            set_shed=srv.scheduler.set_shed,
+            scheduler=srv.scheduler,
+            tracer=Tracer(ring_blocks=4, slow_factor=0),
+            registry=Registry(),
+            initial={"coalesce_blocks": 4},
+        )
+        link = make_link(srv)
+        try:
+            d = ap.tick(Signals(queue_age_p99_ms={"chan": 500.0},
+                                clock_s=20.0))
+            assert (d.knob, d.direction, d.new) == (
+                "coalesce_blocks", "up", 5
+            )
+            assert srv._pending_coalesce == 5   # latched on the server
+            # one round trip crosses a drain boundary → applied
+            assert link.submit([(1, 1, 0, 0, 0)]).fetch() == [True]
+            assert srv.coalesce == 5
+        finally:
+            link.close()
+            loop_thread.run(srv.stop())
+
     def test_rpc_frame_fault_cuts_the_link_then_reattaches(
         self, loop_thread
     ):

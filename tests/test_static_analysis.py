@@ -1678,6 +1678,126 @@ class TestPvtdataPurgeRace:
         assert got == []
 
 
+# -- FT013 metric-label-cardinality ------------------------------------------
+
+BAD_LABELS = """\
+class Server:
+    def __init__(self, registry):
+        self._ctr = registry.counter("requests_total", "reqs")
+
+    def handle(self, req, block):
+        self._ctr.add(1, txid=req.txid)
+        self._ctr.add(1, block=block.header.number)
+
+
+def chained(registry, tx):
+    registry.counter("seen_total", "x").add(1, tx_id=tx.tx_id)
+
+
+def via_local(registry, req):
+    ctr = registry.counter("done_total", "x")
+    request_id = req.request_id
+    ctr.add(1, req=request_id)
+
+
+def wrapped(registry, block):
+    h = registry.histogram("lat_seconds", "x")
+    h.observe(0.1, block=str(block.header.number))
+
+
+def fstring(registry, ptx):
+    g = registry.gauge("height", "x")
+    g.set(1, key=f"blk-{ptx.txid}")
+"""
+
+CLEAN_LABELS = """\
+class Server:
+    def __init__(self, registry):
+        self._ctr = registry.counter("requests_total", "reqs")
+        self._other = object()
+
+    def handle(self, req, channel):
+        self._ctr.add(1, channel=channel, status="ok")
+        # not a registry instrument: receiver unproven
+        self._other.add(1, txid=req.txid)
+
+
+def closed_sets(registry, tenant, stage):
+    h = registry.histogram("lat_seconds", "x")
+    h.observe(0.1, tenant=tenant, stage=stage)
+
+
+def unknown_names_stay_silent(registry, thing):
+    ctr = registry.counter("x_total", "x")
+    ctr.add(1, label=thing.some_field)
+
+
+def not_a_metric_ctor(queue, req):
+    # .counter() without a literal metric name is not a registration
+    c = queue.counter(req)
+    c.add(1, txid=req.txid)
+
+
+def positional_value_only(registry, req):
+    registry.counter("y_total", "x").add(2)
+"""
+
+
+class TestMetricLabelCardinality:
+    def test_flags_per_request_label_values(self, tmp_path):
+        from fabric_tpu.analysis.rules.metric_label_cardinality import (
+            MetricLabelCardinalityRule,
+        )
+
+        got = run_rule(tmp_path, MetricLabelCardinalityRule(),
+                       {"mod.py": BAD_LABELS})
+        assert [(f.rule, f.line) for f in got] == [
+            ("FT013", 6),    # self-attr counter, txid label
+            ("FT013", 7),    # self-attr counter, block number label
+            ("FT013", 11),   # chained ctor call, tx_id label
+            ("FT013", 17),   # local metric + local assigned from req id
+            ("FT013", 22),   # str()-wrapped block number
+            ("FT013", 27),   # f-string carrying a txid
+        ]
+        assert "label variant" in got[0].message
+
+    def test_clean_shapes_never_flag(self, tmp_path):
+        from fabric_tpu.analysis.rules.metric_label_cardinality import (
+            MetricLabelCardinalityRule,
+        )
+
+        got = run_rule(tmp_path, MetricLabelCardinalityRule(),
+                       {"mod.py": CLEAN_LABELS})
+        assert got == []
+
+    def test_test_code_exempt(self, tmp_path):
+        from fabric_tpu.analysis.rules.metric_label_cardinality import (
+            MetricLabelCardinalityRule,
+        )
+
+        got = run_rule(tmp_path, MetricLabelCardinalityRule(), {
+            "test_mod.py": BAD_LABELS,
+            "tests/helper.py": BAD_LABELS,
+            "conftest.py": BAD_LABELS,
+        })
+        assert got == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        from fabric_tpu.analysis.rules.metric_label_cardinality import (
+            MetricLabelCardinalityRule,
+        )
+
+        src = "\n".join([
+            "def f(registry, req):",
+            "    c = registry.counter('x_total', 'x')",
+            "    c.add(1, txid=req.txid)  # fabtpu: noqa(FT013)",
+            "",
+        ])
+        got = run_rule(tmp_path, MetricLabelCardinalityRule(),
+                       {"mod.py": src})
+        assert got == []
+
+
 def test_rule_battery_registered():
     from fabric_tpu.analysis import all_rules
 
@@ -1695,4 +1815,5 @@ def test_rule_battery_registered():
         "FT010": "unfinished-span",
         "FT011": "device-buffer-lifetime",
         "FT012": "pvtdata-purge-race",
+        "FT013": "metric-label-cardinality",
     }
