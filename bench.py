@@ -79,6 +79,117 @@ def _bench_p256_verify():
     }
 
 
+def _bench_endorse_sign():
+    """Endorsement SIGNING: proposals/s at 1000-proposal batches — the
+    upstream half of the transaction flow (ISSUE 13).
+
+    CPU baseline: the production ``crypto/identity.py`` serial signing
+    path (OpenSSL ECDSA via `cryptography`, one sign per proposal —
+    what every endorsement pays today).  Device lane: RFC 6979 nonces
+    + the fixed-base batch sign kernel (ops/p256sign), measured both
+    as one raw 1000-lane dispatch and through the SignBatcher ingest
+    path with 8 concurrent feeder threads (the gateway shape), with
+    the batcher's occupancy/wait stats in extras.
+
+    ``FABTPU_BENCH_SIGN=0`` reports the CPU baseline only (knob in
+    extras); default 1 measures the device lane.  Skips cleanly
+    without `cryptography` (main() gates it with the other
+    crypto-dependent scenarios)."""
+    import os
+    import threading
+
+    import numpy as np
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec as cec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature, encode_dss_signature,
+    )
+
+    from fabric_tpu.crypto import ec_ref
+    from fabric_tpu.ops import p256sign
+    from fabric_tpu.peer import signlane
+
+    B = 1000
+    sign_on = os.environ.get("FABTPU_BENCH_SIGN", "1") == "1"
+    rng = np.random.default_rng(13)
+    key = cec.generate_private_key(cec.SECP256R1())
+    d = key.private_numbers().private_value
+    msgs = [b"proposal-response-payload-%d-" % i + rng.bytes(192)
+            for i in range(B)]
+    digests = [ec_ref.digest_int(m) for m in msgs]
+
+    # CPU baseline: the serial identity.py path (sign + low-S + DER)
+    t0 = time.perf_counter()
+    for m in msgs:
+        der = key.sign(m, cec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        if s > ec_ref.HALF_N:
+            s = ec_ref.N - s
+        encode_dss_signature(r, s)
+    cpu_s = time.perf_counter() - t0
+    cpu_rate = B / cpu_s
+
+    result = {
+        "metric": "endorse_sign_proposals_per_sec_batch1000",
+        "unit": "proposals/s",
+        "extras": {"sign_device": int(sign_on), "cpu_serial_per_sec":
+                   round(cpu_rate, 1)},
+    }
+    if not sign_on:
+        result["value"] = round(cpu_rate, 1)
+        result["vs_baseline"] = 1.0
+        return result
+
+    # raw device lane: one 1000-proposal batch per dispatch
+    out = p256sign.sign_digests(digests, d)  # compile + correctness
+    oracle = ec_ref.SigningKey(d)
+    for e, (r, s) in zip(digests[:8], out[:8]):
+        assert (r, s) == oracle.sign_digest(e), "device ≠ RFC6979 oracle"
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        p256sign.sign_digests(digests, d)
+    dev_s = (time.perf_counter() - t0) / reps
+    dev_rate = B / dev_s
+
+    # ingest path: 8 concurrent feeders through the SignBatcher (the
+    # gateway's concurrent-client shape) — includes digest + DER +
+    # coalescing overhead, occupancy observable in stats()
+    batcher = signlane.SignBatcher(
+        signlane.device_sign_backend(d),
+        batch_max=int(os.environ.get("FABTPU_BENCH_SIGN_BATCH", "256")),
+        wait_ms=2.0,
+    ).start()
+    feeders = 8
+    per = B // feeders
+
+    def feed(lo):
+        for m in msgs[lo:lo + per]:
+            batcher.sign(m)
+
+    t0 = time.perf_counter()
+    ths = [threading.Thread(target=feed, args=(i * per,))
+           for i in range(feeders)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    ingest_s = time.perf_counter() - t0
+    st = batcher.stats()
+    batcher.stop()
+
+    result["value"] = round(dev_rate, 1)
+    result["vs_baseline"] = round(dev_rate / cpu_rate, 3)
+    result["extras"].update({
+        "ingest_proposals_per_sec": round(feeders * per / ingest_s, 1),
+        "sign_batch_occupancy": st["occupancy"],
+        "sign_batch_wait_ms": st["wait_ms"],
+        "sign_batches_total": st["batches_total"],
+        "sign_busy_total": st["busy_total"],
+    })
+    return result
+
+
 def _bench_sha256():
     """Batched block-payload hashing vs hashlib single-thread."""
     import hashlib
@@ -1636,6 +1747,11 @@ _BENCHES = {
     # elimination acceptance numbers (sig_prepare packed single-pass
     # vs two-phase; state_fill fused column gather vs dict path)
     "host_stage_micro": _bench_host_stage_micro,
+    # ISSUE 13 endorsement story: device-batched ECDSA SIGNING
+    # (fixed-base comb + RFC 6979) vs the serial OpenSSL signer, raw
+    # batch AND through the SignBatcher ingest path with concurrent
+    # feeders — FABTPU_BENCH_SIGN=0/1, occupancy in extras
+    "endorse_sign": _bench_endorse_sign,
     "p256_verify": _bench_p256_verify,
     "sha256": _bench_sha256,
 }
@@ -1656,7 +1772,7 @@ def main():
     if name in ("block_commit", "block_commit_mixed",
                 "block_commit_sustained", "block_commit_chaos",
                 "block_commit_sidecar", "block_commit_bursty",
-                "p256_verify"):
+                "p256_verify", "endorse_sign"):
         # these benches need the `cryptography` package for the
         # OpenSSL CPU baseline and the cert-based test network — on
         # containers without it, report a skip instead of crashing at

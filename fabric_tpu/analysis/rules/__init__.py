@@ -16,6 +16,7 @@ them); slugs are the human-facing names:
     FT011 device-buffer-lifetime  packed uploads pinned past their fetch
     FT012 pvtdata-purge-race     store writers racing the BTL purge walk
     FT013 metric-label-cardinality  per-request ids as metric labels
+    FT014 nonce-reuse-hazard     random k nonces reaching sign calls
 """
 
 from fabric_tpu.analysis.rules import (  # noqa: F401
@@ -27,6 +28,7 @@ from fabric_tpu.analysis.rules import (  # noqa: F401
     kernel_dtype,
     lock_discipline,
     metric_label_cardinality,
+    nonce_reuse,
     pvtdata_purge_race,
     retrace_hazard,
     swallowed_exception,

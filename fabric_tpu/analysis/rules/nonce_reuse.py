@@ -1,0 +1,242 @@
+"""FT014 nonce-reuse-hazard: non-deterministic k reaching a sign call.
+
+An ECDSA nonce k that repeats (or is even biased) across two
+signatures leaks the private key outright — the Sony PS3 / Android
+SecureRandom class of break.  This repo's signing contract is
+RFC 6979 (``crypto/ec_ref.rfc6979_candidates``): k is a deterministic
+function of (d, digest), derived INSIDE ``sign_digest`` when the
+caller passes no nonce, and the device batch-sign lane
+(``ops/p256sign``) inherits the same derivation.  A call site that
+passes its own ``k`` from a randomness source steps outside that
+contract: the caller now owns uniqueness across every signature the
+key will ever make, silently, with no replay story — exactly the
+hazard the deterministic default exists to remove.  (Explicit k is
+legitimate ONLY for pinned test vectors, and test code is exempt
+below.)
+
+Mechanics (strictly under-approximating, per the FT003..FT013
+contract — a finding is always real):
+
+1. **Sign call sites** — calls whose callee name (attribute or bare)
+   is ``sign_digest`` or ``sign`` AND that pass a nonce argument: the
+   ``k=`` keyword, or the second positional argument of
+   ``sign_digest``.  (Receivers are not resolved — ANY sign-family
+   call passing a random k is a hazard worth a look; the randomness
+   requirement below is what keeps findings real.)
+2. **Randomness provenance, import-aware** (the FT003 lesson — a
+   same-named local helper never matches):
+
+   * module-attr calls whose root is an alias of ``secrets``
+     (``randbelow``/``randbits``/``token_bytes``), ``random``
+     (``randrange``/``randint``/``getrandbits``/``random``), or
+     ``os`` (``urandom``), with ``import m as a`` tracked;
+   * bare calls whose name was from-imported from those modules
+     (renames tracked);
+   * ``SystemRandom`` method chains: ``SystemRandom().randrange(n)``
+     with the ctor resolved the same way.
+
+   The nonce expression is random if it IS such a call, or reaches
+   one through ``int(...)`` / ``int.from_bytes(...)`` wrappers,
+   unary/binary arithmetic (the ``% n`` / ``+ 1`` range-fitting
+   idioms), or ONE same-scope single-assignment local.  Anything
+   else — constants, loop counters, function parameters — stays
+   silent: those may still be wrong, but the rule cannot prove it.
+3. **Test code is exempt** (``tests/``, ``test_*.py``,
+   ``conftest.py``) — pinned RFC vectors and edge-scalar
+   differentials pass explicit k on purpose.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import (
+    Finding,
+    ModuleCtx,
+    Rule,
+    register,
+    walk_functions,
+)
+
+_SIGN_NAMES = {"sign_digest", "sign"}
+
+#: per-module randomness attributes (module alias → flagged attrs)
+_MOD_ATTRS = {
+    "secrets": {"randbelow", "randbits", "token_bytes"},
+    "random": {"randrange", "randint", "getrandbits", "random"},
+    "os": {"urandom"},
+}
+
+
+def _bindings(tree: ast.Module):
+    """Import map: ({local alias → canonical module}, {bare name →
+    canonical module.attr}, {SystemRandom ctor names})."""
+    mod_alias: dict[str, str] = {}
+    bare: dict[str, str] = {}
+    sysrand: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in _MOD_ATTRS:
+                    mod_alias[a.asname or a.name] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod not in _MOD_ATTRS and mod != "random":
+                continue
+            for a in node.names:
+                name = a.asname or a.name
+                if mod in _MOD_ATTRS and a.name in _MOD_ATTRS[mod]:
+                    bare[name] = f"{mod}.{a.name}"
+                if mod == "random" and a.name == "SystemRandom":
+                    sysrand.add(name)
+    return mod_alias, bare, sysrand
+
+
+class _Scope:
+    """One function scope's single-assignment locals.  EVERY other
+    binding form — tuple/starred unpacking, aug/ann assignment, for
+    targets, comprehensions, walrus, ``with ... as`` — poisons the
+    name: its value is then unprovable and the rule stays silent (the
+    under-approximation contract; a k rebound by ``k, tag = ...``
+    after a random seed must NOT count as the random value)."""
+
+    def __init__(self, fn: ast.AST):
+        counts: dict[str, int] = {}
+        values: dict[str, ast.expr] = {}
+
+        def poison(target):
+            for sub in ast.walk(target):
+                if isinstance(sub, ast.Name):
+                    counts[sub.id] = counts.get(sub.id, 0) + 99
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                if (len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    t = node.targets[0]
+                    counts[t.id] = counts.get(t.id, 0) + 1
+                    values[t.id] = node.value
+                else:
+                    for t in node.targets:
+                        poison(t)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign,
+                                   ast.For, ast.AsyncFor,
+                                   ast.comprehension, ast.NamedExpr)):
+                poison(node.target)
+            elif isinstance(node, ast.withitem):
+                if node.optional_vars is not None:
+                    poison(node.optional_vars)
+        self.single: dict[str, ast.expr] = {
+            n: v for n, v in values.items() if counts.get(n) == 1
+        }
+
+
+@register
+class NonceReuseHazardRule(Rule):
+    id = "FT014"
+    name = "nonce-reuse-hazard"
+    severity = "error"
+    description = (
+        "sign/sign_digest call passing a k nonce derived from a "
+        "randomness source — nonces must be RFC 6979 deterministic "
+        "(omit k) or provably single-use; a repeated k leaks the key"
+    )
+
+    def check_module(self, ctx: ModuleCtx) -> list[Finding]:
+        rel = ctx.relpath.replace("\\", "/")
+        base = rel.rsplit("/", 1)[-1]
+        if ("tests/" in rel or rel.startswith("tests")
+                or base.startswith("test_") or base == "conftest.py"):
+            return []
+        mod_alias, bare, sysrand = _bindings(ctx.tree)
+        if not (mod_alias or bare or sysrand):
+            return []  # no randomness source in scope at all
+        out: list[Finding] = []
+        for fn in walk_functions(ctx.tree):
+            scope = _Scope(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = (node.func.attr
+                          if isinstance(node.func, ast.Attribute)
+                          else node.func.id
+                          if isinstance(node.func, ast.Name) else None)
+                if callee not in _SIGN_NAMES:
+                    continue
+                k_arg = None
+                for kw in node.keywords:
+                    if kw.arg == "k":
+                        k_arg = kw.value
+                if (k_arg is None and callee == "sign_digest"
+                        and len(node.args) >= 2):
+                    k_arg = node.args[1]
+                if k_arg is None:
+                    continue
+                src = self._random_source(
+                    k_arg, scope, mod_alias, bare, sysrand, depth=0
+                )
+                if src is None:
+                    continue
+                if ctx.suppressed(self, node.lineno):
+                    continue
+                out.append(self.finding(
+                    ctx, node.lineno, node.col_offset,
+                    f"{callee}() receives a k nonce derived from "
+                    f"{src} — a random per-call nonce has no "
+                    f"uniqueness or replay guarantee (one repeat "
+                    f"leaks the private key); omit k for the "
+                    f"RFC 6979 deterministic derivation",
+                ))
+        out.sort(key=lambda f: (f.line, f.col))
+        return out
+
+    # -- provenance --------------------------------------------------------
+
+    def _random_source(self, node, scope, mod_alias, bare, sysrand,
+                       depth: int):
+        """The randomness source name if ``node`` provably derives
+        from one, else None."""
+        if depth > 6:
+            return None
+        rec = lambda n: self._random_source(
+            n, scope, mod_alias, bare, sysrand, depth + 1
+        )
+        if isinstance(node, ast.Call):
+            f = node.func
+            # secrets.randbelow(...) / rnd.urandom(...) module attrs
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)):
+                mod = mod_alias.get(f.value.id)
+                if mod is not None and f.attr in _MOD_ATTRS[mod]:
+                    return f"{mod}.{f.attr}"
+            # SystemRandom().randrange(...)
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Call)):
+                ctor = f.value.func
+                if ((isinstance(ctor, ast.Name) and ctor.id in sysrand)
+                        or (isinstance(ctor, ast.Attribute)
+                            and isinstance(ctor.value, ast.Name)
+                            and mod_alias.get(ctor.value.id) == "random"
+                            and ctor.attr == "SystemRandom")):
+                    return f"random.SystemRandom().{f.attr}"
+            # from-imported bare names (renames tracked)
+            if isinstance(f, ast.Name) and f.id in bare:
+                return bare[f.id]
+            # int(x) / int.from_bytes(x, ...) wrappers
+            if ((isinstance(f, ast.Name) and f.id == "int")
+                    or (isinstance(f, ast.Attribute)
+                        and f.attr == "from_bytes"
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "int")):
+                if node.args:
+                    return rec(node.args[0])
+            return None
+        if isinstance(node, ast.BinOp):  # k0 % n, k0 + 1, ...
+            return rec(node.left) or rec(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return rec(node.operand)
+        if isinstance(node, ast.Name):  # one single-assignment local
+            val = scope.single.get(node.id)
+            if val is not None:
+                return rec(val)
+        return None

@@ -157,6 +157,10 @@ async def _run_peer(cfg):
         autopilot=cfg.autopilot,
         autopilot_tick_s=cfg.autopilot_tick_s,
         autopilot_knobs=cfg.autopilot_knobs,
+        sign_device=cfg.sign_device,
+        sign_batch_max=cfg.sign_batch_max,
+        sign_batch_wait_ms=cfg.sign_batch_wait_ms,
+        sign_self_check=cfg.sign_self_check,
         device_fail_threshold=cfg.device_fail_threshold,
         device_retries=cfg.device_retries,
         device_recovery_s=cfg.device_recovery_s,

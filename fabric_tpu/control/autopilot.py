@@ -29,6 +29,11 @@ setters:
   device, exactly the case the staging pool exists for — and back
   toward serial staging when the feeder runs comfortably ahead
   (HostStagePool.set_workers: drain-and-rebuild at a task boundary);
+* **grow** ``sign_batch_max`` when the endorsement sign lane bounces
+  requests with BUSY (trailing busy rate above its band) — bigger
+  batches per device flush absorb the arrival rate — and back down
+  when the lane is quiet and draining fast (small batches keep the
+  first-proposal latency tight);
 * **re-weight or BUSY-shed** tenants on fast burn: a tenant whose
   latency budget burns past the shed band is put in *shed mode* —
   the scheduler answers its arrivals with typed BUSY + retry-after
@@ -89,7 +94,7 @@ _log = logging.getLogger("fabric_tpu.control.autopilot")
 #: knob names the spec parser accepts — an operator typo must be a
 #: config error, not a silently-ignored bound
 KNOWN_KNOBS = ("coalesce_blocks", "verify_chunk", "pipeline_depth",
-               "host_stage_workers", "weight", "shed")
+               "host_stage_workers", "sign_batch_max", "weight", "shed")
 
 #: default per-knob bounds (overridable per knob via the spec string)
 DEFAULT_KNOB_SPECS = (
@@ -97,6 +102,7 @@ DEFAULT_KNOB_SPECS = (
     "verify_chunk:min=512:max=4096;"
     "pipeline_depth:min=2:max=4;"
     "host_stage_workers:min=0:max=4;"
+    "sign_batch_max:min=64:max=4096;"
     "weight:min=0.125:max=8;"
     "shed"
 )
@@ -119,6 +125,11 @@ DEFAULT_BANDS = {
     "prefetch_hi_ms": 150.0,  # prefetch (host parse) p99 above →
                               # host_stage_workers up
     "prefetch_lo_ms": 20.0,   # below → back toward serial staging
+    "sign_busy_hi": 0.05,   # sign-lane BUSY rate above → batch up
+    "sign_busy_lo": 0.005,  # below (and waits short) → batch down
+    "sign_wait_lo_ms": 5.0,  # waits must also sit below this for a
+                             # step down (a draining lane, not a
+                             # momentarily idle one)
     "burn_hi": 1.5,        # tenant burn above → halve its weight
     "burn_lo": 0.5,        # below → restore toward its hello weight
     "shed_hi": 4.0,        # tenant fast burn above → shed mode ON
@@ -167,6 +178,17 @@ class KnobSpec:
                 n for n in range(max(2, int(self.lo) + 1),
                                  int(self.hi) + 1)
             )
+        if self.name == "sign_batch_max":
+            # doubling rungs min → max ("up" = bigger sign batches per
+            # device flush); the max is always a rung so the operator
+            # cap is reachable exactly
+            out = []
+            c = int(self.lo)
+            while c < int(self.hi):
+                out.append(c)
+                c *= 2
+            out.append(int(self.hi))
+            return tuple(out)
         return ()  # weight/shed are not ladder knobs
 
 
@@ -244,6 +266,12 @@ def parse_knob_specs(spec: str | None) -> dict[str, KnobSpec]:
                     "host_stage_workers min must be 0 (serial "
                     "staging) or >= 2 — a 1-worker pool does not "
                     "exist"
+                )
+            elif name == "sign_batch_max" and ks.lo < 1:
+                raise KnobSpecError(
+                    f"autopilot knob spec {part!r}: sign_batch_max "
+                    "min must be >= 1 (a 0-lane sign batch does not "
+                    "exist)"
                 )
             elif name == "weight" and ks.lo <= 0:
                 raise KnobSpecError(
@@ -325,6 +353,13 @@ class Signals:
     #: host_stage_workers signal: a feeder slower than its device
     #: shows up here, not in launch_p99
     prefetch_p99_ms: float | None = None
+    #: sign-lane signals (SignBatcher.stats()): trailing BUSY bounce
+    #: rate and submit→flush wait p99 — the sign_batch_max knob's
+    #: pressure/drain pair.  None = no sign lane armed: the rule
+    #: skips, so a sign-less peer charges no cooldowns and logs no
+    #: phantom decisions.
+    sign_busy_rate: float | None = None
+    sign_wait_p99_ms: float | None = None
     clock_s: float = 0.0
 
     def tenant_burn(self, tenant: str) -> float | None:
@@ -392,7 +427,8 @@ class Autopilot:
 
     def __init__(self, knob_specs=None, apply_knob=None, *,
                  set_weight=None, set_shed=None, slo=None,
-                 scheduler=None, tracer=None, initial=None,
+                 scheduler=None, tracer=None, sign_source=None,
+                 initial=None,
                  tick_s: float = 1.0, clock=time.monotonic,
                  registry=None, enabled: bool = True, bands=None):
         if knob_specs is None or isinstance(knob_specs, str):
@@ -403,6 +439,9 @@ class Autopilot:
         self.set_shed = set_shed
         self.slo = slo
         self.scheduler = scheduler
+        # anything with the SignBatcher stats() shape (busy_rate +
+        # wait_ms percentiles) — None on peers without a sign lane
+        self.sign_source = sign_source
         if tracer is None:
             from fabric_tpu.observe import global_tracer
 
@@ -487,6 +526,15 @@ class Autopilot:
             except Exception as e:
                 _log.debug("autopilot: scheduler signal read failed: %s",
                            e)
+        if self.sign_source is not None:
+            try:
+                st = self.sign_source.stats()
+                s.sign_busy_rate = float(st.get("busy_rate", 0.0))
+                wait = st.get("wait_ms") or {}
+                if wait.get("n"):
+                    s.sign_wait_p99_ms = float(wait.get("p99") or 0.0)
+            except Exception as e:
+                _log.debug("autopilot: sign signal read failed: %s", e)
         try:
             roots = self.tracer.recent_roots()
         except Exception as e:
@@ -738,6 +786,38 @@ class Autopilot:
                         signal="prefetch_p99_ms",
                         value=s.prefetch_p99_ms,
                         threshold=b["prefetch_lo_ms"],
+                    )
+        # 6b) sign-lane pressure: BUSY bounces mean the admission
+        #     window (2 × batch_max) is too small for the endorsement
+        #     arrival rate — bigger batches per flush absorb it; step
+        #     back down only when the lane is both quiet (busy ≈ 0)
+        #     AND draining fast (wait p99 under its band), so a
+        #     momentarily idle lane doesn't shrink into the next burst
+        if ("sign_batch_max" in self.values
+                and s.sign_busy_rate is not None):
+            if (s.sign_busy_rate > b["sign_busy_hi"]
+                    and self._cool("sign_batch_max", "", now)):
+                step = self._step("sign_batch_max", +1)
+                if step is not None:
+                    return Decision(
+                        t=now, knob="sign_batch_max", direction="up",
+                        old=step[0], new=step[1],
+                        signal="sign_busy_rate",
+                        value=s.sign_busy_rate,
+                        threshold=b["sign_busy_hi"],
+                    )
+            elif (s.sign_busy_rate < b["sign_busy_lo"]
+                    and s.sign_wait_p99_ms is not None
+                    and s.sign_wait_p99_ms < b["sign_wait_lo_ms"]
+                    and self._cool("sign_batch_max", "", now)):
+                step = self._step("sign_batch_max", -1)
+                if step is not None:
+                    return Decision(
+                        t=now, knob="sign_batch_max",
+                        direction="down", old=step[0], new=step[1],
+                        signal="sign_busy_rate",
+                        value=s.sign_busy_rate,
+                        threshold=b["sign_busy_lo"],
                     )
         # 7) recovery: restore a halved weight toward its hello value
         if self.set_weight is not None and "weight" in self.specs:

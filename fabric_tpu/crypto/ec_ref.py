@@ -13,6 +13,7 @@ Python ints only; NOT constant-time; verify-only paths don't need to be.
 from __future__ import annotations
 
 import hashlib
+import hmac
 import secrets
 from dataclasses import dataclass
 
@@ -79,6 +80,96 @@ def pt_mul(k: int, pt):
 G = (GX, GY)
 
 
+# ---------------------------------------------------------------------------
+# RFC 6979 deterministic nonce derivation (HMAC-SHA256, qlen = 256).
+#
+# This is the nonce contract shared by the serial signer below and the
+# device batch-sign lane (fabric_tpu.ops.p256sign): both derive k from
+# (d, e) with the exact HMAC_DRBG construction of RFC 6979 §3.2, so a
+# signature is a pure function of (key, digest) — seeded replay works,
+# and the device lane has a bit-equal CPU oracle to diff against.
+# Pinned against the RFC's published A.2.5 P-256/SHA-256 vectors in
+# tests/test_p256sign.py.
+
+_QLEN_BYTES = 32  # qlen = 256 bits; SHA-256 ⇒ holen = 32 too
+
+
+def rfc6979_candidates(d: int, e: int):
+    """Successive RFC 6979 §3.2 nonce candidates for P-256/SHA-256.
+
+    ``d``: private scalar in [1, n−1].  ``e``: the message digest as a
+    256-bit integer (``digest_int``) — re-serialized to the 32 bytes
+    H(m) so the derivation matches the RFC byte for byte.  With
+    qlen == hlen == 256, bits2int is the identity and bits2octets is
+    one reduction mod n.  Yields k values in [1, n−1]; the caller
+    advances past a candidate only when it degenerates (r or s zero,
+    the RFC's step h.3 retry — probability ≈ 2⁻²⁵⁶)."""
+    if not (1 <= d < N):
+        raise ValueError("private scalar out of range")
+    x_oct = int(d).to_bytes(_QLEN_BYTES, "big")          # int2octets(x)
+    h_oct = (int(e) % N).to_bytes(_QLEN_BYTES, "big")    # bits2octets
+    V = b"\x01" * 32
+    K = b"\x00" * 32
+    mac = lambda key, msg: hmac.new(key, msg, hashlib.sha256).digest()
+    K = mac(K, V + b"\x00" + x_oct + h_oct)
+    V = mac(K, V)
+    K = mac(K, V + b"\x01" + x_oct + h_oct)
+    V = mac(K, V)
+    while True:
+        V = mac(K, V)
+        k = int.from_bytes(V, "big")  # T is exactly qlen bits
+        if 1 <= k < N:
+            yield k
+        K = mac(K, V + b"\x00")
+        V = mac(K, V)
+
+
+def rfc6979_k(d: int, e: int) -> int:
+    """First RFC 6979 nonce candidate — THE deterministic k for
+    (d, e) in every practical case (later candidates exist only for
+    the 2⁻²⁵⁶ degenerate-signature retry)."""
+    return next(rfc6979_candidates(d, e))
+
+
+# ---------------------------------------------------------------------------
+# Minimal DER (r, s) codec — the SW BCCSP signature wire form, pure
+# Python so the sign lane (and its tests) run without `cryptography`.
+# P-256 r/s are < 2^256, so every length fits the short form.
+
+
+def _der_int(v: int) -> bytes:
+    b = int(v).to_bytes((v.bit_length() + 8) // 8 or 1, "big")
+    return b"\x02" + bytes([len(b)]) + b
+
+
+def der_encode_sig(r: int, s: int) -> bytes:
+    """(r, s) → DER ECDSA-Sig-Value (SEQUENCE of two INTEGERs)."""
+    if not (0 < r < N and 0 < s < N):
+        raise ValueError("r/s out of range")
+    body = _der_int(r) + _der_int(s)
+    return b"\x30" + bytes([len(body)]) + body
+
+
+def der_decode_sig(der: bytes) -> tuple[int, int]:
+    """DER ECDSA-Sig-Value → (r, s); strict short-form parse."""
+    if len(der) < 8 or der[0] != 0x30 or der[1] != len(der) - 2:
+        raise ValueError("bad DER signature envelope")
+    out = []
+    off = 2
+    for _ in range(2):
+        if off + 2 > len(der) or der[off] != 0x02:
+            raise ValueError("bad DER integer tag")
+        ln = der[off + 1]
+        off += 2
+        if ln == 0 or off + ln > len(der) or ln > 33:
+            raise ValueError("bad DER integer length")
+        out.append(int.from_bytes(der[off:off + ln], "big"))
+        off += ln
+    if off != len(der):
+        raise ValueError("trailing DER bytes")
+    return out[0], out[1]
+
+
 @dataclass(frozen=True)
 class SigningKey:
     d: int  # private scalar in [1, n-1]
@@ -92,23 +183,28 @@ class SigningKey:
         return cls(d=secrets.randbelow(N - 1) + 1)
 
     def sign_digest(self, e: int, k: int | None = None) -> tuple[int, int]:
-        """ECDSA sign; returns low-S normalized (r, s)."""
-        while True:
-            kk = k if k is not None else secrets.randbelow(N - 1) + 1
+        """ECDSA sign; returns low-S normalized (r, s).
+
+        ``k`` None derives the nonce DETERMINISTICALLY per RFC 6979
+        (``rfc6979_k``) — a signature is then a pure function of
+        (d, e): replayable, and the bit-equal oracle the device batch
+        signer (fabric_tpu.ops.p256sign) is diffed against.  An
+        explicit ``k`` is for tests/vectors only; r == 0 or s == 0
+        with a fixed k raises instead of looping."""
+        fixed = k is not None
+        cands = iter([k]) if fixed else rfc6979_candidates(self.d, e)
+        for kk in cands:
             x1, _ = pt_mul(kk, G)
             r = x1 % N
-            if r == 0:
-                if k is not None:
+            s = (pow(kk, -1, N) * (e + r * self.d)) % N if r else 0
+            if r == 0 or s == 0:
+                if fixed:
                     raise ValueError("bad fixed k")
-                continue
-            s = (pow(kk, -1, N) * (e + r * self.d)) % N
-            if s == 0:
-                if k is not None:
-                    raise ValueError("bad fixed k")
-                continue
+                continue  # RFC 6979 step h.3: next candidate
             if s > HALF_N:
                 s = N - s  # low-S normalization (bccsp/sw/ecdsa.go ToLowS)
             return r, s
+        raise ValueError("bad fixed k")  # exhausted the fixed candidate
 
     def sign(self, msg: bytes) -> tuple[int, int]:
         return self.sign_digest(digest_int(msg))

@@ -247,6 +247,26 @@ class PeerConfig:
     # pipeline_depth:min=2:max=4;weight:min=0.125:max=8'.  Empty =
     # the validated defaults; named knobs override per-key.
     autopilot_knobs: str = ""
+    # device-batched endorsement signing (peer/signlane.py SignBatcher
+    # + ops/p256sign.py): with sign_device on, concurrent ESCC sign
+    # requests from the Endorse RPC and the gateway coalesce into ONE
+    # padded device sign dispatch (fixed-base k·G comb ladder, RFC 6979
+    # deterministic nonces — bit-equal to the serial signer).  A full
+    # admission queue answers a typed BUSY (429 proposal response with
+    # a retry hint) instead of buffering.  Default OFF: CPU/tier-1
+    # hosts keep the exact serial crypto/identity.py signing path.
+    sign_device: bool = False
+    # most sign requests coalesced per device flush (the autopilot's
+    # `sign_batch_max` knob actuates this at flush boundaries)
+    sign_batch_max: int = 256
+    # ms the flusher lingers after the first pending request before
+    # dispatching a partial batch (0 = dispatch immediately)
+    sign_batch_wait_ms: float = 2.0
+    # verify-after-sign self-check: every fresh sign batch re-verifies
+    # through the device verify lane (ops/p256v3.verify_launch) before
+    # any signature leaves the peer — one extra device dispatch per
+    # sign batch buys a hard guarantee against corrupt signatures
+    sign_self_check: bool = False
     # chaos fault plan (fabric_tpu/faults): spec string arming named
     # injection points, e.g.
     # 'validator.verify_launch:raise:n=3;deliver.read:disconnect:n=1'.
@@ -501,6 +521,16 @@ def _load(cls, source, environ=None):
         raise ConfigError(
             f"key 'vitals_retention': must be >= 1 points per series, "
             f"got {cfg.vitals_retention}"
+        )
+    if isinstance(cfg, PeerConfig) and cfg.sign_batch_max < 1:
+        raise ConfigError(
+            f"key 'sign_batch_max': must be >= 1 sign request per "
+            f"device flush, got {cfg.sign_batch_max}"
+        )
+    if isinstance(cfg, PeerConfig) and cfg.sign_batch_wait_ms < 0:
+        raise ConfigError(
+            f"key 'sign_batch_wait_ms': must be >= 0 ms (0 = flush "
+            f"immediately), got {cfg.sign_batch_wait_ms}"
         )
     if isinstance(cfg, PeerConfig) and cfg.autopilot_tick_s <= 0:
         raise ConfigError(

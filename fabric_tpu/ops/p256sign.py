@@ -1,0 +1,420 @@
+"""Batched ECDSA P-256 SIGNING on device — the endorsement lane.
+
+The verify kernel (ops/p256v3) accelerates the validate/commit half of
+execute-order-validate; this module opens the other half: the endorser
+ECDSA-signs every proposal response before ordering ever sees it, and
+at millions of clients that signing is the upstream bottleneck.
+
+Signing is the EASY half of the ladder machinery v3 already has:
+
+* ``R = k·G`` is a FIXED-BASE scalar multiplication — the base point
+  never changes, so the 64 × [4 doublings + table add] verify ladder
+  collapses to 64 MIXED ADDS against a host-precomputed comb table
+  ``T[j][d] = d · 16^(63−j) · G`` staged once in Montgomery-RNS form
+  (affine coordinates, so every step is one ``pt_add_mixed`` — no
+  in-kernel doubling at all, ~6× fewer Montgomery rounds per step
+  than verify).
+* Everything else is host arithmetic the verify path already
+  amortizes: the RFC 6979 nonce derivation (HMAC-SHA256 — see
+  ``crypto/ec_ref.rfc6979_candidates``: deterministic, so the device
+  lane has a bit-equal serial CPU oracle), ONE Montgomery batch
+  inversion for the whole batch's ``k⁻¹`` lane (the ``prepare_cols``
+  trick, here mod n), and a second batch inversion mod p to
+  affinize the device's projective outputs.
+
+Division of labor per batch of B digests:
+
+  host:   k_i = RFC6979(d_i, e_i);  k⁻¹ batch-inverted mod n;
+          k → [B, 16] int16 big-endian limbs (the verify wire form)
+  device: R_i = k_i·G over the comb table → projective (X̃ : Ỹ : Z̃)
+          in Montgomery-RNS; X̃, Z̃ ship back ([B, 2, 2n] int32)
+  host:   CRT-reconstruct X̃, Z̃; x = X̃·Z̃⁻¹ mod p (Montgomery factors
+          cancel in the ratio — no from_mont needed); r = x mod n;
+          s = k⁻¹(e + r·d) mod n; low-S normalization
+
+The accept-set contract: (r, s) is BIT-EQUAL to
+``ec_ref.SigningKey(d).sign_digest(e)`` for every lane (pinned across
+edge scalars by tests/test_p256sign.py), and an optional
+verify-after-sign lane routes each fresh signature back through
+``p256v3.verify_launch`` before it leaves the peer.
+
+Batches pad to the same ``MIN_BUCKET``/``_bucket`` family as verify —
+pad lanes carry k = 1 (a real scalar: the comb table has no ∞ row to
+gather) — so the ``chunk``/``mesh``/``pool`` knobs compose exactly as
+they do on the verify side.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from fabric_tpu.crypto import ec_ref
+from fabric_tpu.ops import rns
+from fabric_tpu.ops.p256v3 import (
+    MIN_BUCKET,
+    STEPS,
+    _BND_STATE,
+    _bucket,
+    _chunk_bounds,
+    _clamp,
+    _const_rv,
+    _ctx,
+    _dev_ann,
+    _limbs16,
+    _shard,
+    _MONT_ONE,
+    device_recode_windows,
+    pt_add_mixed,
+)
+
+P = ec_ref.P
+N = ec_ref.N
+GX, GY = ec_ref.GX, ec_ref.GY
+HALF_N = ec_ref.HALF_N
+
+# ---------------------------------------------------------------------------
+# Fixed-base comb table: T[j][d] = d · 16^(63−j) · G, affine, Montgomery
+# form, j indexed MSB-first to match the _windows/_limbs16 digit order.
+# Slot d = 0 is unused (digit-0 steps skip the add — same ∞-avoidance
+# as the verify ladder's T_G lane).  Built lazily on first sign (≈1k
+# ec_ref point adds of host Python, double-checked-locked) and cached
+# for the process lifetime — it is a pure constant of the curve.
+
+_FB: np.ndarray | None = None
+_FB_LOCK = threading.Lock()
+
+
+def _fb_table() -> np.ndarray:
+    """[STEPS, 16, 2, 2n] int32 — the comb table described above."""
+    global _FB
+    tab = _FB
+    if tab is not None:
+        return tab
+    with _FB_LOCK:
+        if _FB is not None:
+            return _FB
+        tab = np.zeros((STEPS, 16, 2, 2 * rns.N_CH), np.int32)
+        base = (GX, GY)  # weight 16^0 → step index STEPS−1 (LSB digit)
+        for step in range(STEPS - 1, -1, -1):
+            pts = []
+            p_ = base
+            for _d in range(1, 16):
+                pts.append(p_)
+                p_ = ec_ref.pt_add(p_, base)
+            # after the loop p_ = 16·base: the next (more significant)
+            # step's base point
+            tab[step, 1:, 0] = rns.ints_to_rns(
+                [(pt[0] * rns.M_A) % P for pt in pts]
+            )
+            tab[step, 1:, 1] = rns.ints_to_rns(
+                [(pt[1] * rns.M_A) % P for pt in pts]
+            )
+            base = p_
+        _FB = tab
+    return tab
+
+
+# ---------------------------------------------------------------------------
+# Device kernel
+
+
+def sign_batch_limbs(limbs):
+    """[B, 16] int16 big-endian nonce limbs → [B, 2, 2n] int32: the
+    projective (X̃, Z̃) Montgomery-RNS coordinates of R = k·G.
+
+    64 ladder steps of ONE complete mixed add each against the comb
+    table — no doublings (the table carries the 16^j weights), no
+    in-kernel window table build (the base is constant).  Digit-0
+    steps keep the running point unchanged (``pt_add_mixed`` requires
+    an affine, non-∞ addend, exactly like the verify ladder's u1·G
+    lane).  k ∈ [1, n−1] ⇒ R ≠ ∞, so Z̃ is never ≡ 0 for real lanes.
+    """
+    ctx = _ctx()
+    b_m = _const_rv((ec_ref.B * rns.M_A) % P)
+    w = device_recode_windows(limbs)  # [B, 64] int32 digits, MSB-first
+    tg = jnp.asarray(_fb_table())     # [64, 16, 2, 2n] constants
+    B = limbs.shape[0]
+    zero = jnp.zeros((B, 2 * rns.N_CH), jnp.int32)
+    one_m = jnp.broadcast_to(
+        jnp.asarray(rns._to_res(_MONT_ONE, rns.BASE_A + rns.BASE_B)),
+        zero.shape,
+    )
+
+    def body(i, state):
+        X, Y, Z = state
+        R = (rns.RV(X, _BND_STATE), rns.RV(Y, _BND_STATE),
+             rns.RV(Z, _BND_STATE))
+        d = jax.lax.dynamic_index_in_dim(w, i, axis=1, keepdims=False)
+        tgi = jax.lax.dynamic_index_in_dim(tg, i, axis=0, keepdims=False)
+        sel = jnp.take_along_axis(
+            tgi[None], d[:, None, None, None], axis=-3
+        )[..., 0, :, :]  # [B, 2, 2n]
+        Rg = pt_add_mixed(
+            R, rns.RV(sel[..., 0, :], P), rns.RV(sel[..., 1, :], P),
+            b_m, ctx,
+        )
+        Rg = tuple(_clamp(c, _BND_STATE) for c in Rg)
+        skip = (d == 0)[:, None]
+        return (
+            jnp.where(skip, X, Rg[0].arr),
+            jnp.where(skip, Y, Rg[1].arr),
+            jnp.where(skip, Z, Rg[2].arr),
+        )
+
+    X, _Y, Z = jax.lax.fori_loop(0, STEPS, body, (zero, one_m, zero))
+    return jnp.stack([X, Z], axis=-2)
+
+
+sign_batch_limbs_jit = jax.jit(sign_batch_limbs)
+
+
+# ---------------------------------------------------------------------------
+# Host side: CRT reconstruction, batch inversions, the (r, s) algebra
+
+_CRT_COEFFS: list[int] | None = None
+_M_ALL = rns.M_A * rns.M_B
+
+
+def _crt_coeffs() -> list[int]:
+    """Cached CRT basis over all 2n channels: c_i = (M/m_i)·
+    ((M/m_i)⁻¹ mod m_i) — ``rns.rv_to_ints`` recomputes these per
+    call; the sign fetch path runs per block, so cache once."""
+    global _CRT_COEFFS
+    if _CRT_COEFFS is None:
+        primes = rns.BASE_A + rns.BASE_B
+        _CRT_COEFFS = [
+            (_M_ALL // m) * pow(_M_ALL // m, -1, m) for m in primes
+        ]
+    return _CRT_COEFFS
+
+
+def _rows_to_ints_mod_p(arr: np.ndarray) -> list[int]:
+    """[B, 2n] canonical residues (values < 9p « M) → [B] ints mod p."""
+    coeffs = _crt_coeffs()
+    out = []
+    for row in np.asarray(arr, np.int64):
+        v = 0
+        for r, c in zip(row, coeffs):
+            v += int(r) * c
+        out.append(v % _M_ALL % P)
+    return out
+
+
+def _batch_inv(xs: list[int], mod: int) -> list[int]:
+    """Montgomery's simultaneous inversion mod ``mod`` (one pow(·,−1)
+    for the whole batch) — ``prepare_cols``' trick, reused for the
+    k⁻¹ lane (mod n) and the projective-Z affinization (mod p)."""
+    B = len(xs)
+    pref = [1] * (B + 1)
+    for i, x in enumerate(xs):
+        pref[i + 1] = (pref[i] * x) % mod
+    inv_all = pow(pref[B], -1, mod)
+    out = [0] * B
+    for i in range(B - 1, -1, -1):
+        out[i] = (pref[i] * inv_all) % mod
+        inv_all = (inv_all * xs[i]) % mod
+    return out
+
+
+def _lanes_hist():
+    from fabric_tpu.ops_metrics import global_registry
+
+    return global_registry().histogram(
+        "device_sign_lanes_per_launch",
+        "signature lanes (incl. bucket padding) per sign dispatch",
+        buckets=(16, 64, 256, 1024, 3072, float("inf")),
+    )
+
+
+def derive_nonces(digests, ds, pool=None) -> list[int]:
+    """Per-lane RFC 6979 nonces for (digest, scalar) pairs.  The HMAC
+    walk is ~6 SHA-256 per lane — the one host stage worth sharding,
+    so a ``parallel.hostpool`` pool splits the lane range exactly like
+    the verify staging does."""
+    ks: list[int | None] = [None] * len(digests)
+
+    def stage(lo, hi):
+        for i in range(lo, hi):
+            ks[i] = ec_ref.rfc6979_k(ds[i], digests[i])
+
+    if pool is not None and len(digests) >= 2 * MIN_BUCKET:
+        pool.map_slices(len(digests), stage, stage="sign_nonce",
+                        align=MIN_BUCKET)
+    else:
+        stage(0, len(digests))
+    return ks  # type: ignore[return-value]
+
+
+class SignHandle:
+    """An in-flight sign batch: the device-resident (X̃, Z̃) plus the
+    host context needed to finish the algebra at fetch time.  Mirrors
+    ``VerifyHandle`` — the dispatch is async, so the caller's host
+    thread keeps staging while the device walks the comb ladder."""
+
+    __slots__ = ("device_out", "n_real", "es", "ds", "k_invs",
+                 "verify_after")
+
+    def __init__(self, device_out, n_real: int, es, ds, k_invs,
+                 verify_after: bool = False):
+        self.device_out = device_out
+        self.n_real = n_real
+        self.es = es
+        self.ds = ds
+        self.k_invs = k_invs
+        self.verify_after = verify_after
+
+    def fetch(self) -> list[tuple[int, int]]:
+        """→ [(r, s)] low-S normalized, bit-equal to the serial
+        RFC 6979 oracle."""
+        if not self.n_real:
+            return []
+        out = np.asarray(self.device_out)[: self.n_real]
+        xs = _rows_to_ints_mod_p(out[:, 0])
+        zs = _rows_to_ints_mod_p(out[:, 1])
+        # k ∈ [1, n−1] ⇒ R ≠ ∞ ⇒ Z ≢ 0; guard anyway so one corrupt
+        # lane poisons its own signature, not the whole batch's
+        # prefix products
+        z_safe = [z if z else 1 for z in zs]
+        z_inv = _batch_inv(z_safe, P)
+        sigs: list[tuple[int, int]] = []
+        for e, d, kinv, X, Z, zi in zip(
+            self.es, self.ds, self.k_invs, xs, zs, z_inv
+        ):
+            if Z == 0:
+                raise ValueError("device sign lane returned ∞")
+            x_aff = (X * zi) % P
+            r = x_aff % N
+            s = (kinv * (e + r * d)) % N
+            if r == 0 or s == 0:
+                # 2⁻²⁵⁶ territory — the serial oracle walks to the
+                # next RFC 6979 candidate; delegate the lane to it so
+                # both lanes stay bit-equal even here
+                r, s = ec_ref.SigningKey(d).sign_digest(e)
+            elif s > HALF_N:
+                s = N - s
+            sigs.append((r, s))
+        if self.verify_after:
+            _self_check(self.es, self.ds, sigs)
+        return sigs
+
+    def __call__(self) -> list[tuple[int, int]]:
+        return self.fetch()
+
+
+_PUB_CACHE: dict[int, tuple[int, int]] = {}
+
+
+def _pub_of(d: int) -> tuple[int, int]:
+    pub = _PUB_CACHE.get(d)
+    if pub is None:
+        if len(_PUB_CACHE) > 64:  # a peer signs with a handful of keys
+            _PUB_CACHE.clear()
+        pub = _PUB_CACHE[d] = ec_ref.pt_mul(d, ec_ref.G)
+    return pub
+
+
+def _self_check(es, ds, sigs) -> None:
+    """Verify-after-sign: route the fresh batch back through the
+    existing device verify lane (p256v3.verify_launch) and refuse to
+    release a batch with any rejected lane — a bit-flip anywhere in
+    the sign path is caught before a signature leaves the peer."""
+    from fabric_tpu.ops import p256v3
+
+    items = [
+        (e, r, s, *_pub_of(d)) for e, d, (r, s) in zip(es, ds, sigs)
+    ]
+    ok = p256v3.verify_launch(items)()
+    if not all(ok):
+        bad = [i for i, v in enumerate(ok) if not v]
+        raise RuntimeError(
+            f"verify-after-sign rejected lanes {bad[:8]} "
+            f"({len(bad)}/{len(items)} bad)"
+        )
+
+
+def sign_launch(digests, key, ks=None, chunk: int | None = None,
+                mesh=None, pool=None,
+                verify_after: bool = False) -> SignHandle:
+    """Asynchronously dispatch a sign batch; returns a SignHandle
+    (callable as a zero-arg fetch for [(r, s)]).
+
+    ``digests``: [B] digest ints (``ec_ref.digest_int`` values).
+    ``key``: the private scalar d, or a [B] list for per-lane keys
+    (the fixed-base table only bakes in G, so d is free per lane).
+    ``ks``: explicit nonces (tests/vectors ONLY — production nonces
+    are RFC 6979, derived here when None).  ``chunk``/``mesh``/
+    ``pool`` compose exactly like ``verify_launch``: microbatched
+    back-to-back dispatches, axis-0 mesh sharding, host-pool-sharded
+    nonce derivation.  ``verify_after`` routes the finished batch
+    through the device verify lane before fetch() returns it."""
+    digests = [int(e) for e in digests]
+    B0 = len(digests)
+    if not B0:
+        return SignHandle(None, 0, [], [], [])
+    ds = ([int(key)] * B0 if isinstance(key, int)
+          else [int(d) for d in key])
+    if len(ds) != B0:
+        raise ValueError("per-lane key list length mismatch")
+    for d in ds:
+        if not (1 <= d < N):
+            raise ValueError("private scalar out of range")
+    if ks is None:
+        ks = derive_nonces(digests, ds, pool=pool)
+    else:
+        ks = [int(k) for k in ks]
+        if len(ks) != B0:
+            raise ValueError("explicit nonce list length mismatch")
+        for k in ks:
+            if not (1 <= k < N):
+                raise ValueError("nonce out of range")
+    k_invs = _batch_inv(ks, N)
+
+    total = _bucket(B0)
+    limbs = np.zeros((total, 16), np.int16)
+    limbs[:B0] = _limbs16(ks)
+    limbs[B0:, -1] = 1  # pad lanes sign with k = 1 (discarded rows)
+
+    chunk = max(int(chunk), MIN_BUCKET) if chunk else 0
+    _lanes_hist().observe(total)
+
+    def dispatch(rows):
+        with _dev_ann("fabtpu.sign_dispatch"):
+            return sign_batch_limbs_jit(_shard(mesh, rows))
+
+    if chunk and B0 > chunk:
+        outs = []
+        for lo, _hi, pad in _chunk_bounds(B0, chunk):
+            # rows [lo, lo+pad) of the prepadded limb frame: exact
+            # chunks hold the verify chunker's index invariant, the
+            # tail absorbs the bucket padding rows
+            outs.append(dispatch(limbs[lo:lo + pad]))
+        dev = jnp.concatenate(outs)
+    else:
+        dev = dispatch(limbs)
+    if hasattr(dev, "copy_to_host_async"):
+        dev.copy_to_host_async()
+    return SignHandle(dev, B0, digests, ds, k_invs,
+                      verify_after=verify_after)
+
+
+def sign_digests(digests, key, **kw) -> list[tuple[int, int]]:
+    """Synchronous convenience: ``sign_launch(...).fetch()``."""
+    return sign_launch(digests, key, **kw).fetch()
+
+
+def sign_host(digests, key) -> list[tuple[int, int]]:
+    """The serial CPU oracle: per-lane RFC 6979 `ec_ref` signing with
+    the same interface as ``sign_digests`` — the bit-equal fallback
+    the device lane is diffed against (and the CPU backend the
+    SignBatcher uses when ``sign_device`` is off)."""
+    digests = [int(e) for e in digests]
+    ds = ([int(key)] * len(digests) if isinstance(key, int)
+          else [int(d) for d in key])
+    return [
+        ec_ref.SigningKey(d).sign_digest(e)
+        for e, d in zip(digests, ds)
+    ]

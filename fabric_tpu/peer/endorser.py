@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 
 from fabric_tpu import protoutil
 from fabric_tpu.peer.chaincode import ChaincodeError, ChaincodeRuntime
+from fabric_tpu.peer.signlane import SignBusy
 from fabric_tpu.peer.simulator import TxSimulator
 from fabric_tpu.protos import common_pb2, proposal_pb2
 
@@ -30,7 +31,11 @@ class EndorseResult:
 class Endorser:
     def __init__(self, msp_manager, signer, state_db,
                  runtime: ChaincodeRuntime, acl_check=None):
-        """signer: the peer's SigningIdentity (ESCC key).
+        """signer: the peer's ESCC signing PROVIDER — a
+        SigningIdentity, or a signlane.BatchedSigner routing ``sign``
+        through the device-batched sign lane (same ``sign`` +
+        ``serialized`` surface; a provider answering SignBusy maps to
+        a 429 proposal response below).
         acl_check(channel, creator_bytes, message, signature) -> bool
         (the peer/Propose Writers-policy gate, aclmgmt)."""
         self.msp = msp_manager
@@ -96,10 +101,17 @@ class Endorser:
         # assemble + ESCC-sign
         from fabric_tpu.peer import txassembly as txa
 
-        pr = txa.create_proposal_response(
-            prop, rwset_bytes, self.signer, cc_name,
-            response_payload=resp.payload, events=events, status=resp.status,
-        )
+        try:
+            pr = txa.create_proposal_response(
+                prop, rwset_bytes, self.signer, cc_name,
+                response_payload=resp.payload, events=events,
+                status=resp.status,
+            )
+        except SignBusy as e:
+            # typed overflow from a full sign batcher: the simulation
+            # ran but no signature leaves — 429 tells the client (and
+            # the gateway layout loop) to back off and retry
+            return self._err(429, str(e))
         return EndorseResult(response=pr, pvt_cleartext=pvt_clear, tx_id=ch.tx_id)
 
     @staticmethod

@@ -59,8 +59,14 @@ class Gateway:
         return signed, prop, ch, ext.chaincode_id.name, chan
 
     async def _endorse_local(self, chan, signed):
+        # endorse_signer: the node's batched ESCC sign provider when
+        # sign_device armed one (peer/signlane) — concurrent client
+        # streams then fill device sign lanes; the serial signer
+        # otherwise (bit-equal signatures either way, RFC 6979)
         endorser = chan.make_endorser(
-            self.node.msp, self.node.signer, self.node.runtime
+            self.node.msp,
+            getattr(self.node, "endorse_signer", None) or self.node.signer,
+            self.node.runtime,
         )
         loop = asyncio.get_event_loop()
         async with chan.commit_lock.reader():
@@ -69,19 +75,29 @@ class Gateway:
             )
 
     async def _endorse_remote(self, host, port, req: bytes):
-        cli = RpcClient(
-            host, port,
-            ssl_ctx=self.node.tls.client_ctx()
-            if getattr(self.node, "tls", None) else None,
-        )
-        await cli.connect()
+        """One remote Endorse RPC; transport/parse failures surface as
+        a retryable GatewayError(503) so the layout loop fails over to
+        the next layout instead of tearing the whole Endorse down."""
         try:
-            raw = await cli.unary("Endorse", req)
-        finally:
-            await cli.close()
-        pr = proposal_pb2.ProposalResponse()
-        pr.ParseFromString(raw)
-        return pr
+            cli = RpcClient(
+                host, port,
+                ssl_ctx=self.node.tls.client_ctx()
+                if getattr(self.node, "tls", None) else None,
+            )
+            await cli.connect()
+            try:
+                raw = await cli.unary("Endorse", req)
+            finally:
+                await cli.close()
+            pr = proposal_pb2.ProposalResponse()
+            pr.ParseFromString(raw)
+            return pr
+        except GatewayError:
+            raise
+        except Exception as e:
+            raise GatewayError(
+                503, f"remote endorse {host}:{port} failed: {e}"
+            ) from e
 
     # -- service methods ---------------------------------------------------
 
@@ -102,7 +118,14 @@ class Gateway:
 
     async def endorse(self, req: bytes) -> bytes:
         """Collect endorsements per the discovery layout; return the
-        PREPARED transaction payload for the client to sign."""
+        PREPARED transaction payload for the client to sign.
+
+        Endorsement failures (simulation errors, a 429 from a full
+        sign batcher, remote transport failures wrapped as 503) fail
+        the CURRENT layout and the loop tries the next one; when no
+        layout survives, the last error propagates — a 429 tells the
+        client to back off briefly and retry, a 503 to try another
+        gateway peer."""
         signed, prop, ch, cc_name, chan = self._parse_proposal(req)
         info = chan.validator.policies.info(cc_name)
         if info is None:

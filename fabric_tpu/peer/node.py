@@ -1094,6 +1094,10 @@ class PeerNode:
                  autopilot: bool = False,
                  autopilot_tick_s: float = 1.0,
                  autopilot_knobs: str = "",
+                 sign_device: bool = False,
+                 sign_batch_max: int = 256,
+                 sign_batch_wait_ms: float = 2.0,
+                 sign_self_check: bool = False,
                  device_fail_threshold: int = 0,
                  device_retries: int = 2,
                  device_recovery_s: float = 30.0,
@@ -1143,6 +1147,15 @@ class PeerNode:
         self.autopilot_tick_s = float(autopilot_tick_s)
         self.autopilot_knobs = autopilot_knobs
         self.autopilot_ctl = None
+        # device-batched ESCC signing (peer/signlane.py): OFF keeps
+        # the serial crypto/identity.py signer — batcher + provider
+        # are built at start() so a never-started node owns no thread
+        self.sign_device = bool(sign_device)
+        self.sign_batch_max = int(sign_batch_max)
+        self.sign_batch_wait_ms = float(sign_batch_wait_ms)
+        self.sign_self_check = bool(sign_self_check)
+        self.sign_batcher = None
+        self.sign_signer = None
         # device-lane degradation knobs (peer/degrade.py): threshold 0
         # keeps the guard off — the safe default everywhere
         self.device_fail_threshold = int(device_fail_threshold)
@@ -1407,6 +1420,33 @@ class PeerNode:
                 coalesce=self.sidecar_coalesce,
                 ssl_ctx=self.tls.server_ctx() if self.tls else None,
             ).start()
+        if self.sign_device:
+            # device-batched ESCC signing: concurrent Endorse/gateway
+            # sign requests coalesce into one padded fixed-base device
+            # dispatch (ops/p256sign), RFC 6979 nonces — bit-equal to
+            # the serial signer the OFF path keeps
+            from fabric_tpu.peer import signlane
+
+            try:
+                d = signlane.private_scalar(self.signer)
+            except ValueError as e:
+                _log.warning(
+                    "sign_device requested but %s — keeping the "
+                    "serial signing path", e,
+                )
+            else:
+                self.sign_batcher = signlane.SignBatcher(
+                    signlane.device_sign_backend(
+                        d, chunk=self.verify_chunk,
+                        mesh_devices=self.mesh_devices,
+                        verify_after=self.sign_self_check,
+                    ),
+                    batch_max=self.sign_batch_max,
+                    wait_ms=self.sign_batch_wait_ms,
+                ).start()
+                self.sign_signer = signlane.BatchedSigner(
+                    self.signer, self.sign_batcher
+                )
         if self.autopilot:
             # close the adaptive-control loop: the controller reads
             # the global SLO engine + the sidecar scheduler (when this
@@ -1431,6 +1471,11 @@ class PeerNode:
                 if (knob == "coalesce_blocks"
                         and self.sidecar_server is not None):
                     self.sidecar_server.set_coalesce(int(value))
+                # the sign batcher is node-level (one ESCC key, one
+                # lane) — actuated here, not per channel
+                if (knob == "sign_batch_max"
+                        and self.sign_batcher is not None):
+                    self.sign_batcher.set_batch_max(int(value))
 
             sched = (self.sidecar_server.scheduler
                      if self.sidecar_server is not None else None)
@@ -1446,6 +1491,7 @@ class PeerNode:
                 set_weight=(sched.set_weight if sched else None),
                 set_shed=(sched.set_shed if sched else None),
                 slo=global_engine(), scheduler=sched,
+                sign_source=self.sign_batcher,
                 tick_s=self.autopilot_tick_s,
                 initial={
                     "coalesce_blocks": self.coalesce_blocks,
@@ -1454,6 +1500,7 @@ class PeerNode:
                     "host_stage_workers": resolve_host_workers_initial(
                         self.host_stage_workers
                     ),
+                    "sign_batch_max": self.sign_batch_max,
                 },
             )
             if self.sidecar_server is not None:
@@ -1532,7 +1579,20 @@ class PeerNode:
             ).start()
         return self
 
+    @property
+    def endorse_signer(self):
+        """The ESCC signing provider endorsements flow through: the
+        batched device lane when ``sign_device`` armed one, else the
+        serial signer — same ``sign``/``serialized`` surface either
+        way (peer/signlane.BatchedSigner)."""
+        return (self.sign_signer if self.sign_signer is not None
+                else self.signer)
+
     async def stop(self):
+        if self.sign_batcher is not None:
+            self.sign_batcher.stop()
+            self.sign_batcher = None
+            self.sign_signer = None
         if self.vitals is not None:
             # refcounted: the shared sampler stops only when the last
             # colocated holder releases (see start())
@@ -1577,7 +1637,9 @@ class PeerNode:
             pr.response.status = 404
             pr.response.message = f"not joined to {ch_hdr.channel_id}"
             return pr.SerializeToString()
-        endorser = chan.make_endorser(self.msp, self.signer, self.runtime)
+        endorser = chan.make_endorser(
+            self.msp, self.endorse_signer, self.runtime
+        )
         loop = asyncio.get_event_loop()
         async with chan.commit_lock.reader():  # stable height; parallel
             # off the event loop: ECDSA verify + chaincode execution

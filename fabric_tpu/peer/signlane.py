@@ -1,0 +1,421 @@
+"""Sign-batch ingest: coalescing concurrent ESCC sign requests into
+device sign batches — the gateway-side twin of the sidecar's verify
+coalescing.
+
+Every proposal the endorser simulates ends in ONE ECDSA signature over
+``prp_bytes ‖ endorser`` (txassembly.create_proposal_response).  With
+concurrent gateway clients those signatures arrive as a stream of
+independent 1-item requests; the device lane (ops/p256sign) only pays
+off when they dispatch as one padded batch.  The :class:`SignBatcher`
+sits between them:
+
+* endorser threads call :meth:`SignBatcher.sign` (blocking, like the
+  serial ``SigningIdentity.sign`` call it replaces),
+* a flusher thread drains up to ``batch_max`` pending digests per
+  flush, waiting at most ``wait_ms`` after the first arrival (the
+  max-batch / max-wait coalescing contract the sidecar dispatcher
+  uses),
+* a full admission queue answers a typed :class:`SignBusy` instead of
+  buffering unboundedly — the endorser maps it to a 429 proposal
+  response and the gateway to a retryable ``GatewayError`` (the
+  scheduler/BUSY pattern from the sidecar, PR 7–8),
+* per-batch occupancy/wait/backend-time histograms plus a
+  :meth:`stats` snapshot feed the bench extras and the autopilot's
+  ``sign_batch_max`` knob.
+
+Nonces are RFC 6979 (``crypto/ec_ref``) in BOTH backends, so batched
+device signing and the serial CPU path produce BIT-EQUAL signatures —
+the concurrency differential (N async clients ≡ N serial endorsements)
+is pinned by tests/test_signlane.py.
+
+Module-level imports are stdlib + pure-Python crypto only; the device
+backend imports jax lazily, so CPU-only hosts constructing a serial
+batcher never touch it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from collections import deque
+
+from fabric_tpu.crypto import ec_ref
+from fabric_tpu.utils.stats import nearest_rank
+
+_log = logging.getLogger("fabric_tpu.signlane")
+
+#: retry hint a BUSY answer carries (ms) — longer than the sidecar's
+#: queue-full 20 ms: a full sign queue means a whole batch must flush
+#: first, which includes a device round trip
+SIGN_RETRY_MS = 50
+
+#: admission bound, in batches: one batch signing on device + one
+#: accumulating behind it.  Beyond that, buffering only grows latency
+#: — answer BUSY and let the client retry against a drained queue.
+_QUEUE_BATCHES = 2
+
+#: seconds the busy-rate / wait-percentile windows look back.  The
+#: signals are TIME-windowed, not count-windowed: a burst of BUSY
+#: bounces followed by silence must DECAY (an idle lane reads
+#: busy_rate 0.0 and wait n=0), or the autopilot would keep
+#: ratcheting ``sign_batch_max`` up on a dead lane off a frozen
+#: trailing count.
+_SIGNAL_WINDOW_S = 30.0
+
+
+class SignBusy(Exception):
+    """Typed overflow answer from a full sign batcher."""
+
+    def __init__(self, depth: int, cap: int,
+                 retry_ms: int = SIGN_RETRY_MS):
+        super().__init__(
+            f"sign batcher full ({depth}/{cap} pending); "
+            f"retry in {retry_ms} ms"
+        )
+        self.depth = depth
+        self.cap = cap
+        self.retry_ms = retry_ms
+
+
+class _Pending:
+    __slots__ = ("digest", "event", "result", "error", "t_submit")
+
+    def __init__(self, digest: int, t_submit: float):
+        self.digest = digest
+        self.event = threading.Event()
+        self.result: tuple[int, int] | None = None
+        self.error: BaseException | None = None
+        self.t_submit = t_submit
+
+
+def _metrics(registry):
+    if registry is None:
+        from fabric_tpu.ops_metrics import global_registry
+
+        registry = global_registry()
+    return (
+        registry.histogram(
+            "sign_batch_lanes",
+            "sign requests coalesced per batch flush",
+            buckets=(1, 4, 16, 64, 256, 1024, float("inf")),
+        ),
+        registry.histogram(
+            "sign_batch_wait_seconds",
+            "submit → batch-dispatch wait per sign request (s)",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                     0.05, 0.1, float("inf")),
+        ),
+        registry.histogram(
+            "sign_batch_backend_seconds",
+            "backend sign time per batch flush (s)",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
+                     float("inf")),
+        ),
+        registry.counter(
+            "sign_requests_total", "sign requests admitted"
+        ),
+        registry.counter(
+            "sign_busy_total", "sign requests bounced with BUSY"
+        ),
+    )
+
+
+class SignBatcher:
+    """See module docstring.  ``sign_many``: the backend —
+    ``list[digest_int] → list[(r, s)]`` (``device_sign_backend`` /
+    ``cpu_sign_backend`` below, or any test double)."""
+
+    def __init__(self, sign_many, batch_max: int = 256,
+                 wait_ms: float = 2.0, registry=None,
+                 clock=time.monotonic):
+        if batch_max < 1:
+            raise ValueError("batch_max must be >= 1")
+        if wait_ms < 0:
+            raise ValueError("wait_ms must be >= 0")
+        self.sign_many = sign_many
+        self.clock = clock
+        self._cond = threading.Condition()
+        self._pending: deque[_Pending] = deque()
+        self._batch_max = int(batch_max)
+        self._wait_ms = float(wait_ms)
+        self._stopped = False
+        self._thread: threading.Thread | None = None
+        (self._lanes_h, self._wait_h, self._backend_h,
+         self._req_ctr, self._busy_ctr) = _metrics(registry)
+        # trailing-window admission record for stats()/autopilot:
+        # (t, True = admitted | False = BUSY); bounded by count AND
+        # aged out by _SIGNAL_WINDOW_S at read time
+        self._recent: deque[tuple[float, bool]] = deque(maxlen=256)
+        self._wait_samples: deque[tuple[float, float]] = deque(
+            maxlen=256
+        )  # (t, wait ms)
+        self._occupancy: deque[int] = deque(maxlen=64)
+        self._signed_total = 0
+        self._busy_total = 0
+        self._batches_total = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "SignBatcher":
+        if self._thread is None:
+            self._stopped = False
+            self._thread = threading.Thread(
+                target=self._run, name="fabtpu-signlane", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        t = self._thread
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        if t is not None:
+            t.join(timeout=10.0)
+            self._thread = None
+        # fail any stragglers loudly rather than stranding their waits
+        with self._cond:
+            while self._pending:
+                p = self._pending.popleft()
+                p.error = RuntimeError("sign batcher stopped")
+                p.event.set()
+
+    # -- runtime knobs (autopilot actuation) -------------------------------
+
+    @property
+    def batch_max(self) -> int:
+        return self._batch_max
+
+    def set_batch_max(self, n: int) -> None:
+        """Latched under the condition lock; the flusher reads it at
+        each drain, so the new cap applies from the next flush."""
+        n = max(1, int(n))
+        with self._cond:
+            if n != self._batch_max:
+                self._batch_max = n
+                self._cond.notify_all()
+
+    def set_wait_ms(self, ms: float) -> None:
+        ms = max(0.0, float(ms))
+        with self._cond:
+            if ms != self._wait_ms:
+                self._wait_ms = ms
+                self._cond.notify_all()
+
+    # -- the request side --------------------------------------------------
+
+    def sign_digest(self, digest: int,
+                    timeout_s: float = 120.0) -> tuple[int, int]:
+        """Block until the batch carrying ``digest`` flushes; →
+        (r, s).  Raises :class:`SignBusy` on admission overflow."""
+        now = self.clock()
+        with self._cond:
+            cap = self._batch_max * _QUEUE_BATCHES
+            if self._stopped:
+                raise RuntimeError("sign batcher stopped")
+            if len(self._pending) >= cap:
+                self._busy_total += 1
+                self._recent.append((now, False))
+                self._busy_ctr.add()
+                raise SignBusy(len(self._pending), cap)
+            p = _Pending(int(digest), now)
+            self._pending.append(p)
+            self._recent.append((now, True))
+            self._req_ctr.add()
+            self._cond.notify_all()
+        deadline = time.monotonic() + timeout_s
+        warn_at = time.monotonic() + 60.0
+        while not p.event.wait(timeout=1.0):
+            now_m = time.monotonic()
+            if now_m >= deadline:
+                raise TimeoutError("sign batch never flushed")
+            if now_m >= warn_at:
+                _log.warning("sign request waiting > 60s on batcher")
+                warn_at = now_m + 60.0
+        if p.error is not None:
+            raise p.error
+        assert p.result is not None
+        return p.result
+
+    def sign(self, message: bytes) -> bytes:
+        """The drop-in ``SigningIdentity.sign`` form: SHA-256 the
+        message, batch-sign, return the DER-encoded low-S (r, s)."""
+        e = int.from_bytes(hashlib.sha256(message).digest(), "big")
+        r, s = self.sign_digest(e)
+        return ec_ref.der_encode_sig(r, s)
+
+    # -- the flusher -------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            self._flush(batch)
+
+    def _collect(self) -> list[_Pending] | None:
+        """Wait for the first pending request, then linger up to
+        ``wait_ms`` (or until ``batch_max`` fills) before draining —
+        the max-batch / max-wait coalescing window."""
+        with self._cond:
+            while not self._pending and not self._stopped:
+                self._cond.wait(timeout=0.5)
+            if self._stopped:
+                return None
+            first = self._pending[0].t_submit
+            deadline = first + self._wait_ms / 1000.0
+            while (len(self._pending) < self._batch_max
+                   and not self._stopped):
+                remaining = deadline - self.clock()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=min(remaining, 0.05))
+            k = min(len(self._pending), self._batch_max)
+            return [self._pending.popleft() for _ in range(k)]
+
+    def _flush(self, batch: list[_Pending]) -> None:
+        t0 = self.clock()
+        with self._cond:
+            # recorded under the lock: stats() iterates these deques
+            # while holding it, and a lock-free append from here would
+            # raise "deque mutated during iteration" mid-snapshot
+            for p in batch:
+                self._wait_samples.append(
+                    (t0, max(0.0, (t0 - p.t_submit) * 1000.0))
+                )
+            self._occupancy.append(len(batch))
+        for p in batch:
+            self._wait_h.observe(max(0.0, t0 - p.t_submit))
+        self._lanes_h.observe(len(batch))
+        try:
+            sigs = self.sign_many([p.digest for p in batch])
+            if len(sigs) != len(batch):
+                raise RuntimeError(
+                    f"sign backend returned {len(sigs)} signatures "
+                    f"for {len(batch)} digests"
+                )
+        except BaseException as e:  # the waiters get the real error
+            for p in batch:
+                p.error = e
+                p.event.set()
+            return
+        self._backend_h.observe(self.clock() - t0)
+        with self._cond:
+            self._batches_total += 1
+            self._signed_total += len(batch)
+        for p, rs in zip(batch, sigs):
+            p.result = rs
+            p.event.set()
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """Snapshot for bench extras and the autopilot's sign knob:
+        trailing busy rate, wait percentiles, batch occupancy."""
+        now = self.clock()
+        horizon = now - _SIGNAL_WINDOW_S
+        with self._cond:
+            recent = [ok for t, ok in self._recent if t >= horizon]
+            waits = sorted(w for t, w in self._wait_samples
+                           if t >= horizon)
+            occ = sorted(self._occupancy)
+            depth = len(self._pending)
+            out = {
+                "depth": depth,
+                "cap": self._batch_max * _QUEUE_BATCHES,
+                "batch_max": self._batch_max,
+                "wait_ms_knob": self._wait_ms,
+                "signed_total": self._signed_total,
+                "busy_total": self._busy_total,
+                "batches_total": self._batches_total,
+            }
+        out["busy_rate"] = (
+            recent.count(False) / len(recent) if recent else 0.0
+        )
+        # nearest-rank, the SAME convention as the sidecar scheduler's
+        # queue ages — two stats surfaces feeding one autopilot must
+        # not disagree on what "p99" means
+        pct = lambda vals, q: nearest_rank(vals, q) if vals else None
+        out["wait_ms"] = {
+            "n": len(waits), "p50": pct(waits, 50), "p99": pct(waits, 99),
+        }
+        out["occupancy"] = {
+            "n": len(occ), "p50": pct(occ, 50),
+            "max": occ[-1] if occ else None,
+        }
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Backends and the provider wrapper
+
+
+def private_scalar(signer) -> int:
+    """Extract the raw P-256 private scalar d from a signer: an
+    ``ec_ref.SigningKey`` (``.d``), an ``identity.SigningIdentity``
+    (``.key.private_numbers().private_value``), or anything exposing
+    either shape."""
+    d = getattr(signer, "d", None)
+    if isinstance(d, int):
+        return d
+    key = getattr(signer, "key", None)
+    if key is not None:
+        pn = getattr(key, "private_numbers", None)
+        if pn is not None:
+            return int(pn().private_value)
+    raise ValueError(
+        f"cannot extract a P-256 private scalar from {type(signer).__name__}"
+    )
+
+
+def cpu_sign_backend(d: int):
+    """Serial RFC 6979 signing over `ec_ref` — the bit-equal oracle
+    backend (no jax import; pure Python)."""
+    key = ec_ref.SigningKey(int(d))
+    return lambda digests: [key.sign_digest(int(e)) for e in digests]
+
+
+def device_sign_backend(d: int, chunk: int = 0, mesh_devices: int = 0,
+                        verify_after: bool = False):
+    """Batched device signing via ops/p256sign — jax imported lazily
+    so merely constructing a CPU batcher never pulls the device
+    stack.  ``chunk``/``mesh_devices`` compose like the verify lane's
+    knobs; ``verify_after`` arms the self-check lane (each batch
+    re-verified on device before release)."""
+    d = int(d)
+    mesh_holder: list = [None, False]
+
+    def sign_many(digests):
+        from fabric_tpu.ops import p256sign
+
+        if mesh_devices and not mesh_holder[1]:
+            from fabric_tpu.parallel.mesh import resolve_mesh
+
+            mesh_holder[0] = resolve_mesh(mesh_devices)
+            mesh_holder[1] = True
+        return p256sign.sign_digests(
+            digests, d, chunk=chunk or None, mesh=mesh_holder[0],
+            verify_after=verify_after,
+        )
+
+    return sign_many
+
+
+class BatchedSigner:
+    """The provider the Endorser consumes in place of its direct
+    signer: ``.sign`` routes through the batcher, everything else
+    (``serialized``, ``msp_id``, ``cert_pem``, ...) delegates to the
+    wrapped base signer — so ``txassembly.create_proposal_response``
+    and the MSP plumbing see an ordinary signing identity."""
+
+    def __init__(self, base, batcher: SignBatcher):
+        self._base = base
+        self.batcher = batcher
+
+    def sign(self, message: bytes) -> bytes:
+        return self.batcher.sign(message)
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)

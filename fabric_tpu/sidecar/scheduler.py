@@ -25,11 +25,12 @@ event loop drives it and tests drive it deterministically.
 
 from __future__ import annotations
 
-import math
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
+
+from fabric_tpu.utils.stats import nearest_rank
 
 #: default deficit credit per unit weight per round — roughly one
 #: 1000-tx block's 2-of-3 signature batch, so a weight-1 tenant moves
@@ -75,14 +76,9 @@ class _Tenant:
         self.ages: deque = deque(maxlen=256)
 
 
-def _pct(sorted_vals: list, q: float) -> float:
-    """Nearest-rank percentile of a pre-sorted list (0 < q <= 100):
-    rank = ceil(q/100 * n).  (round(x + 0.5) is NOT ceil — banker's
-    rounding sends exact .5 midpoints to the even rank.)"""
-    if not sorted_vals:
-        return 0.0
-    rank = math.ceil(q / 100.0 * len(sorted_vals))
-    return sorted_vals[max(0, min(len(sorted_vals) - 1, rank - 1))]
+# the ONE percentile convention every autopilot-read stats surface
+# shares (utils/stats.py) — kept under the historical local name
+_pct = nearest_rank
 
 
 class WeightedScheduler:

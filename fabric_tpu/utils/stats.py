@@ -1,0 +1,20 @@
+"""Tiny shared statistics helpers (stdlib-only).
+
+One percentile convention for every stats surface the autopilot
+reads: the sidecar scheduler's queue ages and the sign batcher's
+wait/occupancy windows must not disagree on what "p99" means.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def nearest_rank(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile of a pre-sorted list (0 < q <= 100):
+    rank = ceil(q/100 * n).  (round(x + 0.5) is NOT ceil — banker's
+    rounding sends exact .5 midpoints to the even rank.)"""
+    if not sorted_vals:
+        return 0.0
+    rank = math.ceil(q / 100.0 * len(sorted_vals))
+    return sorted_vals[max(0, min(len(sorted_vals) - 1, rank - 1))]

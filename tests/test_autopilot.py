@@ -958,3 +958,88 @@ def test_bursty_overload_differential(tmp_path):
     # and the storm lanes really were load-bearing: some MVCC rejects
     flat = [c for flt in f2 for c in flt]
     assert MiniValidator.MVCC in flat and MiniValidator.VALID in flat
+
+
+# ---------------------------------------------------------------------------
+# sign_batch_max: the endorsement sign-lane knob (ISSUE 13)
+
+
+class TestSignBatchKnob:
+    def test_spec_defaults_and_ladder(self):
+        ks = parse_knob_specs("")
+        assert ks["sign_batch_max"].ladder() == (
+            64, 128, 256, 512, 1024, 2048, 4096
+        )
+        # operator override reshapes the doubling ladder; max is
+        # always a reachable rung
+        ks = parse_knob_specs("sign_batch_max:min=32:max=100")
+        assert ks["sign_batch_max"].ladder() == (32, 64, 100)
+
+    def test_malformed_spec_raises(self):
+        with pytest.raises(KnobSpecError):
+            parse_knob_specs("sign_batch_max:min=0")
+
+    def test_up_on_busy_down_on_quiet_dead_band_holds(self):
+        clk = Clock()
+        pilot, acts = _pilot(
+            clk, initial={"sign_batch_max": 256},
+        )
+        # no sign lane → no signal → never a decision
+        assert pilot.tick(Signals(clock_s=clk.t)) is None
+        # busy above the band → one step up the doubling ladder
+        clk.advance(30)
+        d = pilot.tick(Signals(sign_busy_rate=0.2, clock_s=clk.t))
+        assert (d.knob, d.direction, d.old, d.new) == (
+            "sign_batch_max", "up", 256, 512
+        )
+        assert ("sign_batch_max", 512) in acts
+        # cooldown holds even under continued pressure
+        clk.advance(1)
+        assert pilot.tick(
+            Signals(sign_busy_rate=0.2, clock_s=clk.t)
+        ) is None
+        # dead band: moderate busy rate holds steady
+        clk.advance(30)
+        assert pilot.tick(
+            Signals(sign_busy_rate=0.02, clock_s=clk.t)
+        ) is None
+        # quiet AND draining fast → step back down
+        d = pilot.tick(Signals(
+            sign_busy_rate=0.0, sign_wait_p99_ms=1.0, clock_s=clk.t
+        ))
+        assert (d.knob, d.direction, d.new) == (
+            "sign_batch_max", "down", 256
+        )
+        # quiet but waits long (filling lane) → hold, don't shrink
+        clk.advance(30)
+        assert pilot.tick(Signals(
+            sign_busy_rate=0.0, sign_wait_p99_ms=50.0, clock_s=clk.t
+        )) is None
+
+    def test_sign_source_signal_to_real_batcher_actuation(self):
+        """read_signals() ingests the SignBatcher stats shape and the
+        decision lands on a REAL batcher through apply_knob — the
+        PeerNode wiring, minus the network."""
+        from types import SimpleNamespace
+
+        from fabric_tpu.peer.signlane import SignBatcher
+
+        batcher = SignBatcher(lambda d: [(1, 1)] * len(d),
+                              batch_max=256, wait_ms=0.0)
+        clk = Clock(100.0)
+        source = SimpleNamespace(stats=lambda: {
+            "busy_rate": 0.5, "wait_ms": {"n": 9, "p99": 80.0},
+        })
+        pilot = Autopilot(
+            None,
+            lambda k, v: (k == "sign_batch_max"
+                          and batcher.set_batch_max(int(v))),
+            sign_source=source, clock=clk, registry=Registry(),
+            initial={"sign_batch_max": 256},
+        )
+        s = pilot.read_signals()
+        assert s.sign_busy_rate == 0.5
+        assert s.sign_wait_p99_ms == 80.0
+        d = pilot.tick()
+        assert d is not None and d.knob == "sign_batch_max"
+        assert batcher.batch_max == 512

@@ -112,3 +112,30 @@ def test_env_overrides():
         load_peer_config(
             dict(PEER_MIN), environ={"FABTPU_TLS_BOGUS": "x"}
         )
+
+
+def test_sign_lane_knobs_flow_and_validate():
+    """ISSUE 13 knobs: defaults OFF (the serial signer path), values
+    flow like every prior knob, bad values are operator-grade
+    ConfigErrors, env overrides work."""
+    cfg = load_peer_config(dict(PEER_MIN))
+    assert cfg.sign_device is False
+    assert cfg.sign_batch_max == 256
+    assert cfg.sign_batch_wait_ms == 2.0
+    assert cfg.sign_self_check is False
+    cfg = load_peer_config({
+        **PEER_MIN, "sign_device": True, "sign_batch_max": 1024,
+        "sign_batch_wait_ms": 0.5, "sign_self_check": True,
+    })
+    assert (cfg.sign_device, cfg.sign_batch_max,
+            cfg.sign_batch_wait_ms, cfg.sign_self_check) == (
+        True, 1024, 0.5, True)
+    with pytest.raises(ConfigError, match="sign_batch_max"):
+        load_peer_config({**PEER_MIN, "sign_batch_max": 0})
+    with pytest.raises(ConfigError, match="sign_batch_wait_ms"):
+        load_peer_config({**PEER_MIN, "sign_batch_wait_ms": -1})
+    cfg = load_peer_config(
+        dict(PEER_MIN), environ={"FABTPU_SIGN_DEVICE": "1",
+                                 "FABTPU_SIGN_BATCH_MAX": "512"}
+    )
+    assert cfg.sign_device is True and cfg.sign_batch_max == 512

@@ -1,0 +1,205 @@
+"""Device batch-sign lane (ops/p256sign) vs the RFC 6979 serial
+oracle (crypto/ec_ref) — bit-equality across random and edge scalars,
+knob composition, and the verify-after-sign self-check.  Crypto-free:
+everything here runs on the pure-Python oracle + the jax CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+from fabric_tpu.crypto import ec_ref
+from fabric_tpu.ops import p256sign
+
+N = ec_ref.N
+P = ec_ref.P
+
+
+# -- RFC 6979 (satellite 1: the host oracle the device lane matches) --------
+
+# RFC 6979 A.2.5, P-256 + SHA-256 published vectors
+_X = 0xC9AFA9D845BA75166B5C215767B1D6934E50C3DB36E89B127B8A622B120F6721
+_VECTORS = [
+    (b"sample",
+     0xA6E3C57DD01ABE90086538398355DD4C3B17AA873382B0F24D6129493D8AAD60,
+     0xEFD48B2AACB6A8FD1140DD9CD45E81D69D2C877B56AAF991C34D0EA84EAF3716,
+     0xF7CB1C942D657C41D436C7A1B6E29F65F3E900DBB9AFF4064DC4AB2F843ACDA8),
+    (b"test",
+     0xD16B6AE827F17175E040871A1C7EC3500192C4C92677336EC2537ACAEE0008E0,
+     0xF1ABB023518351CD71D881567B1EA663ED3EFCF6C5132B354F28D3B0B7D38367,
+     0x019F4113742A2B14BD25926B49C649155F267E60D3814B4C0CC84250E46F0083),
+]
+
+
+def test_rfc6979_published_vectors():
+    for msg, want_k, want_r, want_s in _VECTORS:
+        e = ec_ref.digest_int(msg)
+        assert ec_ref.rfc6979_k(_X, e) == want_k
+        r, s = ec_ref.SigningKey(_X).sign_digest(e)
+        assert r == want_r
+        # the repo signs low-S (bccsp/sw ToLowS); the RFC publishes the
+        # raw s — equal directly when already low, else as n − s
+        assert s == (want_s if want_s <= ec_ref.HALF_N else N - want_s)
+        assert s <= ec_ref.HALF_N
+        assert ec_ref.verify_digest(
+            ec_ref.SigningKey(_X).public, e, r, s
+        )
+
+
+def test_sign_digest_default_is_deterministic():
+    key = ec_ref.SigningKey(_X)
+    e = ec_ref.digest_int(b"replay me")
+    assert key.sign_digest(e) == key.sign_digest(e)
+
+
+def test_rfc6979_rejects_bad_scalar():
+    with pytest.raises(ValueError):
+        ec_ref.rfc6979_k(0, 5)
+    with pytest.raises(ValueError):
+        ec_ref.rfc6979_k(N, 5)
+
+
+def test_der_codec_round_trip():
+    r, s = ec_ref.SigningKey(_X).sign_digest(ec_ref.digest_int(b"der"))
+    der = ec_ref.der_encode_sig(r, s)
+    assert ec_ref.der_decode_sig(der) == (r, s)
+    # tiny integers keep a minimal encoding and still round-trip
+    # (ranges permitting: encode rejects out-of-range r/s)
+    small = ec_ref.der_encode_sig(1, 2)
+    assert ec_ref.der_decode_sig(small) == (1, 2)
+    for bad in (b"", b"\x30\x00", der[:-1], der + b"\x00",
+                b"\x31" + der[1:]):
+        with pytest.raises(ValueError):
+            ec_ref.der_decode_sig(bad)
+    with pytest.raises(ValueError):
+        ec_ref.der_encode_sig(0, 2)
+    with pytest.raises(ValueError):
+        ec_ref.der_encode_sig(1, N)
+
+
+# -- device lane ≡ oracle ----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def warm():
+    """Compile the 16-lane sign kernel once for the whole module."""
+    p256sign.sign_digests([ec_ref.digest_int(b"warm")], _X)
+    return True
+
+
+def test_sign_batch_matches_oracle_random(warm):
+    rng = np.random.default_rng(29)
+    digests = [int.from_bytes(rng.bytes(32), "big") for _ in range(16)]
+    ds = [int.from_bytes(rng.bytes(32), "big") % (N - 1) + 1
+          for _ in range(16)]
+    assert p256sign.sign_digests(digests, ds) == p256sign.sign_host(
+        digests, ds
+    )
+
+
+def test_sign_edge_scalars(warm):
+    """The acceptance edge sweep: k and d near 0/1/n−1, high-bit and
+    over-n digests — every lane bit-equal to the fixed-k oracle."""
+    es = [0, 1, 1 << 255, N - 1, N, (1 << 256) - 1]
+    lanes = []
+    for d in (1, 2, N - 1):
+        for k in (1, 2, N - 2, N - 1):
+            lanes.append((es[len(lanes) % len(es)], d, k))
+    lanes = lanes[:16]
+    digests = [e for e, _, _ in lanes]
+    ds = [d for _, d, _ in lanes]
+    ks = [k for _, _, k in lanes]
+    got = p256sign.sign_digests(digests, ds, ks=ks)
+    want = [
+        ec_ref.SigningKey(d).sign_digest(e, k=k)
+        for e, d, k in lanes
+    ]
+    assert got == want
+
+
+def test_sign_nonbucket_batch_pads_clean(warm):
+    """5 lanes pad to the 16 bucket with k=1 pad rows; real lanes are
+    untouched and the handle returns exactly n_real results."""
+    digests = [ec_ref.digest_int(b"p%d" % i) for i in range(5)]
+    got = p256sign.sign_digests(digests, _X)
+    assert len(got) == 5
+    assert got == p256sign.sign_host(digests, _X)
+
+
+def test_sign_chunked_matches_oracle(warm):
+    """chunk=16 over 20 lanes: two 16-lane dispatches (the tail
+    absorbs the bucket padding) — same signatures as the oracle."""
+    rng = np.random.default_rng(31)
+    digests = [int.from_bytes(rng.bytes(32), "big") for _ in range(20)]
+    got = p256sign.sign_digests(digests, _X, chunk=16)
+    assert got == p256sign.sign_host(digests, _X)
+
+
+def test_sign_mesh_sharded_matches_oracle(warm):
+    from fabric_tpu.parallel.mesh import resolve_mesh
+
+    mesh = resolve_mesh(8)
+    assert mesh is not None  # conftest forces 8 host devices
+    digests = [ec_ref.digest_int(b"m%d" % i) for i in range(16)]
+    got = p256sign.sign_digests(digests, _X, mesh=mesh)
+    assert got == p256sign.sign_host(digests, _X)
+
+
+def test_sign_round_trips_through_verify_launch(warm):
+    """Acceptance: every device-signed (e, r, s) verifies through the
+    EXISTING device verify lane, and a tampered lane is rejected."""
+    from fabric_tpu.ops import p256v3
+
+    digests = [ec_ref.digest_int(b"rt%d" % i) for i in range(4)]
+    sigs = p256sign.sign_digests(digests, _X)
+    qx, qy = ec_ref.pt_mul(_X, ec_ref.G)
+    items = [(e, r, s, qx, qy) for e, (r, s) in zip(digests, sigs)]
+    assert p256v3.verify_launch(items)() == [True] * 4
+    # tamper one digest → only that lane flips
+    bad = list(items)
+    e0, r0, s0, x0, y0 = bad[1]
+    bad[1] = (e0 ^ 1, r0, s0, x0, y0)
+    assert p256v3.verify_launch(bad)() == [True, False, True, True]
+
+
+def test_verify_after_sign_self_check(warm):
+    digests = [ec_ref.digest_int(b"sc%d" % i) for i in range(3)]
+    # clean batch passes through the self-check lane unchanged
+    assert (p256sign.sign_digests(digests, _X, verify_after=True)
+            == p256sign.sign_host(digests, _X))
+    # a corrupted signature is refused before release
+    good = p256sign.sign_host(digests, _X)
+    r0, s0 = good[1]
+    good[1] = (r0 ^ 1, s0)
+    with pytest.raises(RuntimeError, match="verify-after-sign"):
+        p256sign._self_check(digests, [_X] * 3, good)
+
+
+def test_sign_launch_validation():
+    e = ec_ref.digest_int(b"v")
+    with pytest.raises(ValueError):
+        p256sign.sign_launch([e], 0)  # d out of range
+    with pytest.raises(ValueError):
+        p256sign.sign_launch([e], N)
+    with pytest.raises(ValueError):
+        p256sign.sign_launch([e], [_X, _X])  # per-lane length mismatch
+    with pytest.raises(ValueError):
+        p256sign.sign_launch([e], _X, ks=[0])  # nonce out of range
+    with pytest.raises(ValueError):
+        p256sign.sign_launch([e], _X, ks=[1, 2])  # nonce length
+    assert p256sign.sign_launch([], _X).fetch() == []
+
+
+def test_derive_nonces_pooled_matches_serial():
+    from fabric_tpu.parallel.hostpool import HostStagePool
+
+    digests = [ec_ref.digest_int(b"n%d" % i) for i in range(48)]
+    ds = [_X] * 48
+    serial = p256sign.derive_nonces(digests, ds)
+    assert serial == [
+        ec_ref.rfc6979_k(_X, e) for e in digests
+    ]
+    pool = HostStagePool(2)
+    try:
+        assert p256sign.derive_nonces(digests, ds, pool=pool) == serial
+    finally:
+        pool.shutdown()

@@ -1798,6 +1798,149 @@ class TestMetricLabelCardinality:
         assert got == []
 
 
+# -- FT014 nonce-reuse-hazard -------------------------------------------------
+
+BAD_NONCES = """\
+import os
+import secrets
+import random as rnd
+from secrets import randbelow as below
+from random import SystemRandom
+
+
+def direct(key, e, n):
+    key.sign_digest(e, k=secrets.randbelow(n - 1) + 1)
+
+
+def positional(key, e, n):
+    key.sign_digest(e, rnd.randrange(1, n))
+
+
+def via_local(key, e, n):
+    k = below(n - 1) + 1
+    key.sign_digest(e, k=k)
+
+
+def wrapped(key, e):
+    key.sign_digest(e, k=int.from_bytes(os.urandom(32), "big"))
+
+
+def sysrand(key, e, n):
+    key.sign_digest(e, k=SystemRandom().randrange(1, n))
+
+
+def bare_sign_kw(signer, msg, n):
+    signer.sign(msg, k=rnd.getrandbits(256) % n)
+"""
+
+CLEAN_NONCES = """\
+import secrets
+from fabric_tpu.crypto import ec_ref
+
+
+def deterministic(key, e):
+    key.sign_digest(e)  # RFC 6979 default — no k at all
+
+
+def pinned_vector(key, e, vec_k):
+    key.sign_digest(e, k=vec_k)  # provenance unknown: stays silent
+
+
+def counter_nonce(key, e, i):
+    key.sign_digest(e, k=i + 1)  # not provably random
+
+
+def other_arg_random(key, msgs, n):
+    # randomness NOT reaching a k argument
+    key.sign(msgs[secrets.randbelow(len(msgs))])
+
+
+def local_sign_helper(e, n):
+    # a same-named local def is still a sign-family call, but the k
+    # is a parameter — provenance unknown, silent
+    def sign_digest(e, k):
+        return (e, k)
+    return sign_digest(e, n - 1)
+
+
+def reassigned_local(key, e, n):
+    k = 1
+    k = k + 1  # NOT single-assignment: provenance unprovable
+    key.sign_digest(e, k=k)
+
+
+def tuple_rebound_local(key, e, rotate):
+    import secrets
+    k = secrets.randbelow(100) + 1
+    k, tag = rotate(e)  # tuple target REBINDS k: random seed is gone
+    key.sign_digest(e, k=k)
+
+
+def walrus_rebound_local(key, e, nxt):
+    import secrets
+    k = secrets.randbelow(100) + 1
+    if (k := nxt(e)):  # walrus rebinds: provenance unprovable
+        key.sign_digest(e, k=k)
+"""
+
+
+class TestNonceReuseHazard:
+    def test_flags_random_nonces(self, tmp_path):
+        from fabric_tpu.analysis.rules.nonce_reuse import (
+            NonceReuseHazardRule,
+        )
+
+        got = run_rule(tmp_path, NonceReuseHazardRule(),
+                       {"mod.py": BAD_NONCES})
+        assert [(f.rule, f.line) for f in got] == [
+            ("FT014", 9),    # secrets.randbelow keyword
+            ("FT014", 13),   # random positional arg 2
+            ("FT014", 18),   # through one single-assignment local
+            ("FT014", 22),   # int.from_bytes(os.urandom) wrapper
+            ("FT014", 26),   # SystemRandom().randrange chain
+            ("FT014", 30),   # .sign(k=getrandbits % n) BinOp
+        ]
+        assert "RFC 6979" in got[0].message
+
+    def test_clean_shapes_never_flag(self, tmp_path):
+        from fabric_tpu.analysis.rules.nonce_reuse import (
+            NonceReuseHazardRule,
+        )
+
+        got = run_rule(tmp_path, NonceReuseHazardRule(),
+                       {"mod.py": CLEAN_NONCES})
+        assert got == []
+
+    def test_test_code_exempt(self, tmp_path):
+        from fabric_tpu.analysis.rules.nonce_reuse import (
+            NonceReuseHazardRule,
+        )
+
+        got = run_rule(tmp_path, NonceReuseHazardRule(), {
+            "test_mod.py": BAD_NONCES,
+            "tests/helper.py": BAD_NONCES,
+            "conftest.py": BAD_NONCES,
+        })
+        assert got == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        from fabric_tpu.analysis.rules.nonce_reuse import (
+            NonceReuseHazardRule,
+        )
+
+        src = "\n".join([
+            "import secrets",
+            "",
+            "def f(key, e, n):",
+            "    key.sign_digest(e, k=secrets.randbelow(n))"
+            "  # fabtpu: noqa(FT014)",
+            "",
+        ])
+        got = run_rule(tmp_path, NonceReuseHazardRule(),
+                       {"mod.py": src})
+        assert got == []
+
+
 def test_rule_battery_registered():
     from fabric_tpu.analysis import all_rules
 
@@ -1816,4 +1959,5 @@ def test_rule_battery_registered():
         "FT011": "device-buffer-lifetime",
         "FT012": "pvtdata-purge-race",
         "FT013": "metric-label-cardinality",
+        "FT014": "nonce-reuse-hazard",
     }

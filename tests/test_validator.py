@@ -259,3 +259,46 @@ def test_config_tx_garbage_rejected(net, validator):
     env2.signature = bytes(len(env2.signature))
     flt, _, _ = validator.validate(_block([env2], num=1))
     assert list(flt) == [C.BAD_CREATOR_SIGNATURE]
+
+
+def test_device_signed_endorsements_validate_on_device(net, validator):
+    """ISSUE 13 acceptance: endorse-on-device, validate-on-device.
+
+    Proposal responses ESCC-signed by the batched device sign lane
+    (RFC 6979 nonces, fixed-base comb kernel, verify-after-sign armed)
+    flow through the UNCHANGED BlockValidator commit path and produce
+    the exact verdicts of the all-CPU OpenSSL signing path."""
+    from fabric_tpu.peer import signlane
+
+    batchers, providers = [], []
+    for peer in (net["p1"], net["p2"]):
+        d = signlane.private_scalar(peer)
+        b = signlane.SignBatcher(
+            signlane.device_sign_backend(d, verify_after=True),
+            batch_max=16, wait_ms=5.0,
+        ).start()
+        batchers.append(b)
+        providers.append(signlane.BatchedSigner(peer, b))
+    try:
+        # deterministic nonces: the SAME bytes sign to the SAME DER
+        assert (providers[0].sign(b"replay") ==
+                providers[0].sign(b"replay"))
+        env_ok, _ = _tx(net, providers, writes=[("dk1", b"v1")])
+        env_one, _ = _tx(net, [providers[0]], writes=[("dk2", b"v2")])
+        flt, batch, history = validator.validate(
+            _block([env_ok, env_one])
+        )
+        assert list(flt) == [C.VALID, C.ENDORSEMENT_POLICY_FAILURE]
+        assert (CC, "dk1") in batch.updates
+        # the all-CPU signing path agrees verdict for verdict
+        env_ok_cpu, _ = _tx(
+            net, [net["p1"], net["p2"]], writes=[("dk1", b"v1")]
+        )
+        env_one_cpu, _ = _tx(net, [net["p1"]], writes=[("dk2", b"v2")])
+        flt_cpu, _, _ = validator.validate(
+            _block([env_ok_cpu, env_one_cpu])
+        )
+        assert list(flt) == list(flt_cpu)
+    finally:
+        for b in batchers:
+            b.stop()
