@@ -234,7 +234,8 @@ def _bench_sha256():
 def _build_commit_network(n_tx: int, n_blocks: int = 1,
                           invalid_frac: float = 0.0,
                           validator_kwargs: dict | None = None,
-                          block_plan: list | None = None):
+                          block_plan: list | None = None,
+                          hot_readonly: bool = False):
     """3 orgs, 2-of-3 endorsement policy, a STREAM of ``n_blocks``
     blocks of n_tx signed txs each, reading seeded keys and writing
     fresh ones — the BASELINE.json config-#2 workload (1000-tx blocks
@@ -248,7 +249,14 @@ def _build_commit_network(n_tx: int, n_blocks: int = 1,
     ``block_plan``: optional per-block [(n_tx, invalid_frac)] — the
     bursty bench's mixed block sizes + seeded invalid-sig storms;
     overrides ``n_tx``/``n_blocks``/``invalid_frac`` and makes the
-    returned ``n_invalid`` a PER-BLOCK list."""
+    returned ``n_invalid`` a PER-BLOCK list.
+
+    ``hot_readonly`` (env ``FABTPU_BENCH_HOT=1``): the per-tx
+    read-only key becomes BLOCK-INDEPENDENT (``ro{i}`` instead of
+    ``ro{b}_{i}``) — a hot working set re-read by every block, the
+    realistic traffic shape the device-resident state cache
+    (``FABTPU_BENCH_RESIDENT=1``) exists for.  Run the resident A/B
+    with the SAME hot-workload setting on both sides."""
     from fabric_tpu import protoutil as pu
     from fabric_tpu.crypto import cryptogen, policy as pol
     from fabric_tpu.crypto.msp import MSPManager
@@ -287,7 +295,11 @@ def _build_commit_network(n_tx: int, n_blocks: int = 1,
     for b, (b_tx, _f) in enumerate(plan):
         for i in range(b_tx):
             seed.put(CC, f"seed{b}_{i:05d}", b"genesis", (1, 0))
-            seed.put(CC, f"ro{b}_{i:05d}", b"genesis", (1, 0))
+            if not hot_readonly:
+                seed.put(CC, f"ro{b}_{i:05d}", b"genesis", (1, 0))
+    if hot_readonly:
+        for i in range(max(t for t, _f in plan)):
+            seed.put(CC, f"ro{i:05d}", b"genesis", (1, 0))
 
     def _stride(frac):
         return math.inf if frac <= 0 else max(2, round(1 / frac))
@@ -316,7 +328,11 @@ def _build_commit_network(n_tx: int, n_blocks: int = 1,
                 ns.reads[f"seed{b}_{i:05d}"] = (9, 9)  # stale → conflict
             else:
                 ns.reads[f"seed{b}_{i:05d}"] = (1, 0)
-            ns.reads[f"ro{b}_{i:05d}"] = (1, 0)  # never written in-block
+            # never written in-block; hot mode re-reads ONE working
+            # set across every block (the residency cache's hit lane)
+            ro_key = (f"ro{i:05d}" if hot_readonly
+                      else f"ro{b}_{i:05d}")
+            ns.reads[ro_key] = (1, 0)
             ns.writes[f"w{b}_{i:05d}"] = b"value-%d" % i
             ns.writes[f"seed{b}_{i:05d}"] = b"updated"
             rw = tx.to_proto().SerializeToString()
@@ -358,6 +374,8 @@ def _build_commit_network(n_tx: int, n_blocks: int = 1,
             mesh_devices=k["mesh_devices"],
             host_stage_workers=k["host_stage_workers"],
             recode_device=bool(k["recode_device"]),
+            state_resident=bool(k["state_resident"]),
+            state_resident_mb=k["state_resident_mb"],
             **(validator_kwargs or {}),
         )
         created.append(v)  # the bench reads pool stats off the last one
@@ -392,6 +410,21 @@ def _bench_knobs() -> dict:
         # fsyncs, the real-TPU knob.  Sweep it (2, 3, 4) on accelerator
         # rounds so BENCH_*.json attributes the win to the depth.
         "pipeline_depth": int(os.environ.get("FABTPU_BENCH_DEPTH", "2")),
+        # device-resident MVCC state (fabric_tpu/state): 1 = the fused
+        # stage-2 reads committed versions from the resident LRU cache
+        # and the host state_fill shrinks to the miss set.  Measure it
+        # BOTH WAYS with FABTPU_BENCH_HOT=1 on both sides (a hot
+        # working set is what residency caches; the default per-block
+        # cold keys miss every time by construction).
+        "state_resident": int(
+            os.environ.get("FABTPU_BENCH_RESIDENT", "0")
+        ),
+        "state_resident_mb": int(
+            os.environ.get("FABTPU_BENCH_RESIDENT_MB", "64")
+        ),
+        # 1 = block-independent read-only working set (see
+        # _build_commit_network hot_readonly)
+        "hot_readonly": int(os.environ.get("FABTPU_BENCH_HOT", "0")),
     }
 
 
@@ -447,6 +480,20 @@ def _host_stage_extras(fresh_validator) -> dict | None:
     else:
         out["workers"] = 0
     return out
+
+
+def _resident_extras(fresh_validator) -> dict | None:
+    """Device-resident state sub-breakdown for the JSON extras (the
+    BENCH_r06 attribution numbers): hit rate, evictions, uploaded
+    state bytes — read off the last validator the run built; None
+    when the resident knob is off."""
+    created = getattr(fresh_validator, "created", None)
+    if not created:
+        return None
+    res = getattr(created[-1], "resident", None)
+    if res is None:
+        return None
+    return res.stats()
 
 
 def _close_validators(fresh_validator) -> None:
@@ -551,12 +598,14 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5,
     from fabric_tpu.peer.pipeline import CommitPipeline
     from fabric_tpu.protos import common_pb2
 
+    bk = _bench_knobs()
     (blocks, fresh_state, fresh_validator, mgr, prov, _,
      n_invalid) = _build_commit_network(
-        n_tx, n_blocks, invalid_frac=invalid_frac
+        n_tx, n_blocks, invalid_frac=invalid_frac,
+        hot_readonly=bool(bk["hot_readonly"]),
     )
     expected_valid = (n_tx - n_invalid) * n_blocks
-    depth = _bench_knobs()["pipeline_depth"]
+    depth = bk["pipeline_depth"]
 
     def copy_blocks():
         out = []
@@ -715,6 +764,7 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5,
     tpu_rate = total / tpu_s
     cpu_rate = total / cpu_s
     host_stage = _host_stage_extras(fresh_validator)
+    resident = _resident_extras(fresh_validator)
     _close_validators(fresh_validator)
     return {
         "metric": f"validated_tx_per_sec_block{n_tx}" + ("_mixed" if invalid_frac else ""),
@@ -723,6 +773,9 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5,
         "vs_baseline": round(tpu_rate / cpu_rate, 3),
         "per_block_ms": per_block_ms,
         "host_stage": host_stage,
+        # the resident A/B record: hit rate / evictions / uploaded
+        # state bytes next to the state_fill ms in per_block_ms
+        "resident_state": resident,
         "trace": trace_extras,
         "pipeline_overlap_coverage": overlap_cov,
     }
@@ -750,7 +803,9 @@ def _bench_block_commit_sustained(n_tx: int = 1000, n_blocks: int = 50):
 
     knobs = _bench_knobs()
     (blocks, fresh_state, fresh_validator, mgr, prov, _,
-     n_invalid) = _build_commit_network(n_tx, n_blocks)
+     n_invalid) = _build_commit_network(
+        n_tx, n_blocks, hot_readonly=bool(knobs["hot_readonly"])
+    )
     expected_valid = (n_tx - n_invalid) * n_blocks
 
     state = fresh_state()
@@ -809,6 +864,7 @@ def _bench_block_commit_sustained(n_tx: int = 1000, n_blocks: int = 50):
     overlap_cov.pop("per_block", None)
 
     host_stage = _host_stage_extras(fresh_validator)
+    resident = _resident_extras(fresh_validator)
     _close_validators(fresh_validator)
     # per-block commit latency; the first 3 blocks eat the compiles
     # and cache warms — excluded from the percentiles, stated as such
@@ -834,6 +890,7 @@ def _bench_block_commit_sustained(n_tx: int = 1000, n_blocks: int = 50):
             },
             "knobs": knobs,
             "host_stage": host_stage,
+            "resident_state": resident,
             "group_commit": group_commit,
             "pipeline_overlap_coverage": overlap_cov,
         },

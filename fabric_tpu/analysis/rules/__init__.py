@@ -17,6 +17,8 @@ them); slugs are the human-facing names:
     FT012 pvtdata-purge-race     store writers racing the BTL purge walk
     FT013 metric-label-cardinality  per-request ids as metric labels
     FT014 nonce-reuse-hazard     random k nonces reaching sign calls
+    FT015 resident-state-bypass  store writes skipping the residency
+                                 cache's invalidation hook
 """
 
 from fabric_tpu.analysis.rules import (  # noqa: F401
@@ -30,6 +32,7 @@ from fabric_tpu.analysis.rules import (  # noqa: F401
     metric_label_cardinality,
     nonce_reuse,
     pvtdata_purge_race,
+    resident_bypass,
     retrace_hazard,
     swallowed_exception,
     unfinished_span,

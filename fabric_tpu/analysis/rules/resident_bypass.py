@@ -1,0 +1,248 @@
+"""FT015 resident-state-bypass: committed-store writes that skip the
+residency cache's invalidation hook.
+
+The device-resident MVCC cache (``fabric_tpu/state/residency.py``)
+mirrors the committed version store in device memory.  The ONE
+coherence rule is that every write to the committed store must reach
+the cache — either as the commit-boundary delta scatter
+(``ResidencyManager.apply_batch``) or, when the delta is unknown, as
+an invalidation (``invalidate_keys`` / ``disable``).  A
+``state.apply_updates(...)`` that bypasses the hook leaves a STALE
+version resident: the next block's device compare judges reads
+against a world that no longer exists — a silent MVCC verdict
+corruption, the worst failure class this repo has (verdicts fork from
+the host oracle with no error anywhere).
+
+Mechanics (strictly under-approximating, per the FT003..FT014
+contract — a finding is always real):
+
+1. **A manager must be provably in hand.**  Two binding shapes count,
+   both import-aware (the FT003 lesson — a same-named local helper
+   never matches):
+
+   * a LOCAL assigned exactly once from ``ResidencyManager(...)`` or
+     ``resolve_residency(...)`` — bare from-imports of
+     ``fabric_tpu.state`` / ``fabric_tpu.state.residency`` (aliases
+     tracked) or dotted calls through a tracked module alias;
+   * a SELF-ATTR assigned from one of those ctors anywhere in the
+     same class (``self.resident = ResidencyManager(...)``).
+
+   A scope with no visible manager binding never flags — the rule
+   polices code that HAS the cache and forgets it, not code that has
+   never heard of it.
+2. **The writer**: any ``<recv>.apply_updates(...)`` call in that
+   scope (the ``VersionedDB`` committed-store writer — the method
+   name is specific enough that, combined with rule 1's manager
+   requirement, a false pairing requires a same-scope manager AND an
+   unrelated ``apply_updates`` — accepted residual risk: zero such
+   shapes exist in the repo).
+3. **The hook**: the finding is suppressed when the SAME scope also
+   touches the manager's coherence family — ``apply_batch``,
+   ``invalidate_keys`` or ``disable`` — on a bound manager (local or
+   class self-attr).
+4. **Test code is exempt** (``tests/``, ``test_*.py``,
+   ``conftest.py``) — differentials drive stale-cache shapes on
+   purpose.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import (
+    Finding,
+    ModuleCtx,
+    Rule,
+    dotted_name,
+    register,
+    walk_functions,
+)
+
+_CTORS = {"ResidencyManager", "resolve_residency"}
+_HOOKS = {"apply_batch", "invalidate_keys", "disable"}
+_WRITER = "apply_updates"
+_STATE_MODULES = ("fabric_tpu.state", "fabric_tpu.state.residency")
+
+
+def _bindings(tree: ast.Module):
+    """→ (bare ctor names, module aliases) from the module's imports.
+    A local def/class named like a ctor SHADOWS the bare import —
+    dropped from the bare set."""
+    bare: set[str] = set()
+    aliases: set[str] = set()
+    local_defs: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for a in node.names:
+                if mod in _STATE_MODULES and a.name in _CTORS:
+                    bare.add(a.asname or a.name)
+                elif mod == "fabric_tpu" and a.name == "state":
+                    aliases.add(a.asname or a.name)
+                elif mod == "fabric_tpu.state" and a.name == "residency":
+                    aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in _STATE_MODULES and a.asname:
+                    aliases.add(a.asname)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            local_defs.add(node.name)
+    return bare - local_defs, aliases
+
+
+def _is_mgr_ctor(call: ast.Call, bare: set, aliases: set) -> bool:
+    name = dotted_name(call.func)
+    if name is None:
+        return False
+    parts = name.split(".")
+    if len(parts) == 1:
+        return parts[0] in bare
+    return parts[0] in aliases and parts[-1] in _CTORS
+
+
+def _walk_own(scope: ast.AST):
+    """A scope's own nodes; nested defs are their own scopes."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _mgr_locals(scope: ast.AST, bare: set, aliases: set) -> set:
+    """Local names assigned EXACTLY once in the scope, from a manager
+    ctor — a reassigned name has unknown provenance and never counts
+    (the under-approximation contract)."""
+    assigns: dict[str, int] = {}
+    from_ctor: set[str] = set()
+    for node in _walk_own(scope):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            name = node.targets[0].id
+            assigns[name] = assigns.get(name, 0) + 1
+            if (isinstance(node.value, ast.Call)
+                    and _is_mgr_ctor(node.value, bare, aliases)):
+                from_ctor.add(name)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            t = node.target
+            if isinstance(t, ast.Name):
+                assigns[t.id] = assigns.get(t.id, 0) + 1
+    return {n for n in from_ctor if assigns.get(n) == 1}
+
+
+def _class_mgr_attrs(cls: ast.ClassDef, bare: set, aliases: set) -> set:
+    """self-attr names assigned from a manager ctor anywhere in the
+    class's methods."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"):
+            continue
+        if (isinstance(node.value, ast.Call)
+                and _is_mgr_ctor(node.value, bare, aliases)):
+            out.add(t.attr)
+    return out
+
+
+def _scan_scope(scope: ast.AST, mgr_recvs: set):
+    """→ (writer call lines, hook touched?) over one scope.  A hook
+    counts only on a bound manager receiver (a local manager name or
+    a ``self.<attr>`` the class assigned from a ctor)."""
+    writers: list[int] = []
+    hooked = False
+    for node in _walk_own(scope):
+        if not isinstance(node, ast.Attribute):
+            continue
+        if node.attr == _WRITER:
+            writers.append(node.lineno)
+        elif node.attr in _HOOKS:
+            recv = dotted_name(node.value)
+            if recv is not None and recv in mgr_recvs:
+                hooked = True
+    return writers, hooked
+
+
+@register
+class ResidentStateBypassRule(Rule):
+    id = "FT015"
+    name = "resident-state-bypass"
+    severity = "error"
+    description = (
+        "flags committed version-store writes (apply_updates) in a "
+        "scope that provably holds a residency manager "
+        "(fabric_tpu/state) yet never reaches its coherence hooks "
+        "(apply_batch / invalidate_keys / disable) — a stale resident "
+        "version silently corrupts MVCC verdicts"
+    )
+
+    def check_module(self, ctx: ModuleCtx) -> list[Finding]:
+        rel = ctx.relpath
+        base = rel.rsplit("/", 1)[-1]
+        if ("tests/" in rel or rel.startswith("tests")
+                or base.startswith("test_") or base == "conftest.py"):
+            return []
+        bare, aliases = _bindings(ctx.tree)
+        if not bare and not aliases:
+            return []  # the module never imports the subsystem
+        out: list[Finding] = []
+
+        def check(scope: ast.AST, mgr_recvs: set, where: str):
+            if not mgr_recvs:
+                return
+            writers, hooked = _scan_scope(scope, mgr_recvs)
+            if hooked:
+                return
+            names = ", ".join(sorted(mgr_recvs))
+            for line in writers:
+                out.append(self.finding(
+                    ctx, line, 0,
+                    f"committed-store write (apply_updates) in a "
+                    f"scope holding a residency manager ({names}, "
+                    f"{where}) without reaching its coherence hooks "
+                    "— the resident version table keeps serving the "
+                    "OLD version after this write lands, silently "
+                    "forking MVCC verdicts from the host oracle; "
+                    "apply the write-set via <mgr>.apply_batch(batch)"
+                    " at the commit boundary, or invalidate_keys/"
+                    "disable the cache",
+                ))
+
+        # class methods: self-attr managers (local managers inside the
+        # method count too); checked scopes are remembered so the
+        # function pass below never double-reports a method
+        seen: set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            attrs = _class_mgr_attrs(node, bare, aliases)
+            if not attrs:
+                continue
+            recvs = {f"self.{a}" for a in attrs}
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    seen.add(id(child))
+                    local = _mgr_locals(child, bare, aliases)
+                    check(child, recvs | local,
+                          f"class {node.name}")
+        # plain function scopes (and the module body): local managers
+        for scope in [ctx.tree] + list(walk_functions(ctx.tree)):
+            if id(scope) in seen:
+                continue
+            local = _mgr_locals(scope, bare, aliases)
+            if not local:
+                continue
+            where = (
+                "module scope" if isinstance(scope, ast.Module)
+                else f"function {getattr(scope, 'name', '?')}"
+            )
+            check(scope, local, where)
+        return out

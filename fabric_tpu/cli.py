@@ -165,6 +165,9 @@ async def _run_peer(cfg):
         device_retries=cfg.device_retries,
         device_recovery_s=cfg.device_recovery_s,
         verify_deadline_ms=cfg.verify_deadline_ms,
+        state_resident=cfg.state_resident,
+        state_resident_mb=cfg.state_resident_mb,
+        state_resident_range_bits=cfg.state_resident_range_bits,
         faults=cfg.faults,
         sidecar_endpoint=cfg.sidecar_endpoint,
         sidecar_weight=cfg.sidecar_weight,

@@ -34,6 +34,11 @@ setters:
   batches per device flush absorb the arrival rate — and back down
   when the lane is quiet and draining fast (small batches keep the
   first-proposal latency tight);
+* **tune** ``sign_batch_wait_ms`` alongside it: shrink the lane's
+  coalescing window when the wait p99 says the linger has become the
+  endorsement latency, and stretch it when a flowing lane keeps
+  flushing nearly-empty batches (occupancy fill under its band) —
+  the max-wait half of the max-batch/max-wait contract, closed-loop;
 * **re-weight or BUSY-shed** tenants on fast burn: a tenant whose
   latency budget burns past the shed band is put in *shed mode* —
   the scheduler answers its arrivals with typed BUSY + retry-after
@@ -64,8 +69,9 @@ Knob bounds ride a faults-style spec string (the nodeconfig
     name[:min=..][:max=..][:cool=..] [; more knobs]
 
 known names: ``coalesce_blocks``, ``verify_chunk``,
-``pipeline_depth``, ``host_stage_workers``, ``weight``, ``shed``
-(shed takes only ``cool=``).
+``pipeline_depth``, ``host_stage_workers``, ``sign_batch_max``,
+``sign_batch_wait_ms``, ``weight``, ``shed`` (shed takes only
+``cool=``).
 Omitting a knob from the spec keeps its default bounds
 (:data:`DEFAULT_KNOB_SPECS`); an empty spec means all defaults.
 
@@ -94,7 +100,8 @@ _log = logging.getLogger("fabric_tpu.control.autopilot")
 #: knob names the spec parser accepts — an operator typo must be a
 #: config error, not a silently-ignored bound
 KNOWN_KNOBS = ("coalesce_blocks", "verify_chunk", "pipeline_depth",
-               "host_stage_workers", "sign_batch_max", "weight", "shed")
+               "host_stage_workers", "sign_batch_max",
+               "sign_batch_wait_ms", "weight", "shed")
 
 #: default per-knob bounds (overridable per knob via the spec string)
 DEFAULT_KNOB_SPECS = (
@@ -103,6 +110,7 @@ DEFAULT_KNOB_SPECS = (
     "pipeline_depth:min=2:max=4;"
     "host_stage_workers:min=0:max=4;"
     "sign_batch_max:min=64:max=4096;"
+    "sign_batch_wait_ms:min=0.5:max=16;"
     "weight:min=0.125:max=8;"
     "shed"
 )
@@ -130,6 +138,10 @@ DEFAULT_BANDS = {
     "sign_wait_lo_ms": 5.0,  # waits must also sit below this for a
                              # step down (a draining lane, not a
                              # momentarily idle one)
+    "sign_wait_hi_ms": 10.0,  # wait p99 above → shrink the
+                              # coalescing window (wait_ms down)
+    "sign_fill_lo": 0.25,   # occupancy p50 / batch_max below (lane
+                            # flowing) → linger longer (wait_ms up)
     "burn_hi": 1.5,        # tenant burn above → halve its weight
     "burn_lo": 0.5,        # below → restore toward its hello weight
     "shed_hi": 4.0,        # tenant fast burn above → shed mode ON
@@ -188,6 +200,17 @@ class KnobSpec:
                 out.append(c)
                 c *= 2
             out.append(int(self.hi))
+            return tuple(out)
+        if self.name == "sign_batch_wait_ms":
+            # doubling float rungs min → max ("up" = linger longer in
+            # the coalescing window so batches actually fill); the
+            # operator's max is always a rung
+            out = []
+            c = float(self.lo)
+            while c < float(self.hi):
+                out.append(c)
+                c *= 2
+            out.append(float(self.hi))
             return tuple(out)
         return ()  # weight/shed are not ladder knobs
 
@@ -272,6 +295,13 @@ def parse_knob_specs(spec: str | None) -> dict[str, KnobSpec]:
                     f"autopilot knob spec {part!r}: sign_batch_max "
                     "min must be >= 1 (a 0-lane sign batch does not "
                     "exist)"
+                )
+            elif name == "sign_batch_wait_ms" and ks.lo <= 0:
+                raise KnobSpecError(
+                    f"autopilot knob spec {part!r}: sign_batch_wait_ms "
+                    "min must be > 0 ms (a doubling ladder cannot "
+                    "leave a 0 floor; wait_ms=0 is the static "
+                    "flush-immediately config, not a runtime rung)"
                 )
             elif name == "weight" and ks.lo <= 0:
                 raise KnobSpecError(
@@ -360,6 +390,10 @@ class Signals:
     #: phantom decisions.
     sign_busy_rate: float | None = None
     sign_wait_p99_ms: float | None = None
+    #: trailing batch-occupancy p50 as a fraction of batch_max — the
+    #: sign_batch_wait_ms knob's efficiency signal: a flowing lane
+    #: flushing nearly-empty batches wastes device dispatches
+    sign_fill: float | None = None
     clock_s: float = 0.0
 
     def tenant_burn(self, tenant: str) -> float | None:
@@ -533,6 +567,10 @@ class Autopilot:
                 wait = st.get("wait_ms") or {}
                 if wait.get("n"):
                     s.sign_wait_p99_ms = float(wait.get("p99") or 0.0)
+                occ = st.get("occupancy") or {}
+                bm = int(st.get("batch_max") or 0)
+                if occ.get("n") and bm > 0:
+                    s.sign_fill = float(occ.get("p50") or 0.0) / bm
             except Exception as e:
                 _log.debug("autopilot: sign signal read failed: %s", e)
         try:
@@ -818,6 +856,38 @@ class Autopilot:
                         signal="sign_busy_rate",
                         value=s.sign_busy_rate,
                         threshold=b["sign_busy_lo"],
+                    )
+        # 6c) sign-lane coalescing window (the wait_ms twin of 6b):
+        #     waits stretching past their band mean the linger IS the
+        #     endorsement latency — shrink the window; a flowing lane
+        #     flushing nearly-empty batches (occupancy fill under its
+        #     band) wastes device dispatches — linger longer so
+        #     batches actually fill.  Wait p99 wins when both fire
+        #     (latency rules efficiency), and both ride the usual
+        #     cooldown / dead-band / clamp-ladder governance.
+        if ("sign_batch_wait_ms" in self.values
+                and s.sign_wait_p99_ms is not None):
+            if (s.sign_wait_p99_ms > b["sign_wait_hi_ms"]
+                    and self._cool("sign_batch_wait_ms", "", now)):
+                step = self._step("sign_batch_wait_ms", -1)
+                if step is not None:
+                    return Decision(
+                        t=now, knob="sign_batch_wait_ms",
+                        direction="down", old=step[0], new=step[1],
+                        signal="sign_wait_p99_ms",
+                        value=s.sign_wait_p99_ms,
+                        threshold=b["sign_wait_hi_ms"],
+                    )
+            elif (s.sign_fill is not None
+                    and s.sign_fill < b["sign_fill_lo"]
+                    and self._cool("sign_batch_wait_ms", "", now)):
+                step = self._step("sign_batch_wait_ms", +1)
+                if step is not None:
+                    return Decision(
+                        t=now, knob="sign_batch_wait_ms",
+                        direction="up", old=step[0], new=step[1],
+                        signal="sign_fill", value=s.sign_fill,
+                        threshold=b["sign_fill_lo"],
                     )
         # 7) recovery: restore a halved weight toward its hello value
         if self.set_weight is not None and "weight" in self.specs:

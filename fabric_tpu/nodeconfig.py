@@ -207,6 +207,25 @@ class PeerConfig:
     # Python — so this is a latch signal for future blocks, not a
     # per-block abort.  0 = no deadline.
     verify_deadline_ms: float = 0.0
+    # device-resident MVCC state (fabric_tpu/state): keep an LRU
+    # key-range cache of committed versions resident in DEVICE memory
+    # across blocks — the fused stage-2 program reads them there, the
+    # per-block host state_fill shrinks to the miss set, and each
+    # committed block's write-set applies as a delta scatter at the
+    # commit boundary.  Default OFF: CPU/tier-1 hosts keep the exact
+    # host state_fill path (which also stays as the bit-equal
+    # per-block fallback for misses, range queries, eviction pressure
+    # and device failures).
+    state_resident: bool = False
+    # resident version-table budget in MiB of device memory (12 bytes
+    # per cached key; the slot count rounds down to a power of two so
+    # mesh shards divide it exactly)
+    state_resident_mb: int = 64
+    # key-range granularity: keys hash into 2^bits ranges, the LRU
+    # admission/eviction unit — fewer bits = coarser ranges (bulkier
+    # evictions, cheaper bookkeeping), more bits = finer working-set
+    # tracking
+    state_resident_range_bits: int = 12
     # validation sidecar, client side (fabric_tpu/sidecar): with an
     # endpoint set, every channel's validator ships its signature
     # batches to the sidecar's shared device fabric instead of owning
@@ -531,6 +550,19 @@ def _load(cls, source, environ=None):
         raise ConfigError(
             f"key 'sign_batch_wait_ms': must be >= 0 ms (0 = flush "
             f"immediately), got {cfg.sign_batch_wait_ms}"
+        )
+    if isinstance(cfg, PeerConfig) and cfg.state_resident_mb < 1:
+        raise ConfigError(
+            f"key 'state_resident_mb': must be >= 1 MiB of device "
+            f"memory for the resident version table, "
+            f"got {cfg.state_resident_mb}"
+        )
+    if isinstance(cfg, PeerConfig) and not (
+            1 <= cfg.state_resident_range_bits <= 24):
+        raise ConfigError(
+            f"key 'state_resident_range_bits': must be in [1, 24] "
+            f"(keys hash into 2^bits LRU ranges), "
+            f"got {cfg.state_resident_range_bits}"
         )
     if isinstance(cfg, PeerConfig) and cfg.autopilot_tick_s <= 0:
         raise ConfigError(

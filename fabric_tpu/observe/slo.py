@@ -438,6 +438,51 @@ def _wlabel(w: float) -> str:
     return f"{int(w)}s" if float(w).is_integer() else f"{w}s"
 
 
+# -- endorse-side objectives (the sign lane's SLO feed) ----------------------
+
+#: the default endorse objective pair a peer arms when it runs BOTH an
+#: SLO spec and the sign lane (peer/node.py): ``endorse:latency`` —
+#: good = a sign request waited ≤ ms in the batcher's coalescing
+#: window before its device flush — and ``endorse_busy:busy`` — good =
+#: the request was admitted rather than bounced with SignBusy.  Both
+#: ride the dedicated ``endorse`` channel so the commit-path latency
+#: series stays undiluted, and both surface in ``/slo`` and
+#: :meth:`SloEngine.burns` like any other objective (the autopilot's
+#: burn map carries them under the ``endorse`` channel).
+DEFAULT_ENDORSE_SLOS = (
+    "endorse:latency:ms=25:channel=endorse;"
+    "endorse_busy:busy:pct=5:channel=endorse"
+)
+
+ENDORSE_CHANNEL = "endorse"
+
+
+def endorse_observer(engine: SloEngine):
+    """→ the ``SignBatcher.observer`` callable that classifies the
+    sign lane's per-request telemetry — the same wait values feeding
+    the ``sign_batch_wait_seconds`` histogram, and the same admission
+    edges feeding ``sign_busy_total`` — into the engine's endorse
+    objectives.  Objectives are resolved at CALL time, so a
+    ``set_objectives`` rotation never strands a stale closure.
+
+    Contract: ``observer(wait_ms: float | None, busy: bool)`` — BUSY
+    bounces carry ``wait_ms=None`` (a bounced request has no wait
+    sample; it is not a latency event, exactly like the tracer feed's
+    BUSY exclusion)."""
+
+    def observer(wait_ms, busy):
+        for o in engine.objectives:
+            if o.channel != ENDORSE_CHANNEL:
+                continue
+            if o.kind == "busy":
+                engine.record(o, ENDORSE_CHANNEL, good=not busy)
+            elif not busy and wait_ms is not None:
+                engine.record(o, ENDORSE_CHANNEL,
+                              good=wait_ms <= o.ms)
+
+    return observer
+
+
 _global = SloEngine()
 _attached = False
 

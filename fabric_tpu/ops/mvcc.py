@@ -259,6 +259,24 @@ class StaticBlock:
             ))
         return p
 
+    def packed_read_pv(self):
+        """[T, R, 3] int32 on device — (read_present, read_ver_block,
+        read_ver_txnum) per read slot, the EXPECTED side of the
+        per-read committed-version compare.  State-INDEPENDENT, so the
+        device-resident state path (fabric_tpu/state) uploads it from
+        the prefetch thread; the committed side is then gathered from
+        the resident version table INSIDE the fused stage-2 program
+        instead of being host-filled per block.  Versions ride as raw
+        int32 bit patterns (equality-only compare — exact)."""
+        p = getattr(self, "_packed_rpv", None)
+        if p is None:
+            T, R = self.read_keys.shape
+            rpv = np.zeros((T, R, 3), np.int32)
+            rpv[:, :, 0] = self.read_present
+            rpv[:, :, 1:3] = self.read_vers.view(np.int32)
+            p = self._packed_rpv = jnp.asarray(rpv)
+        return p
+
 
 def prepare_block_static(txs: list[TxRWSet], bucketed: bool = False) -> StaticBlock:
     """Build the state-independent device arrays for `mvcc_validate`.

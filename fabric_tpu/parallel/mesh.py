@@ -63,6 +63,18 @@ def resolve_mesh(mesh_devices: int) -> Mesh | None:
     return Mesh(np.asarray(devices[:n]), axis_names=("data",))
 
 
+def shard_state_table(mesh: Mesh | None, table):
+    """Axis-0 shard the device-resident MVCC version table
+    (fabric_tpu/state/residency.py) — the resident cache is a stage-2
+    operand like every other, so it lives under the SAME data-mesh
+    sharding the fused program's launch/static lanes use.  The table's
+    slot count is a power of two (ResidencyManager rounds its capacity
+    down), so 2/4/8-chip meshes always divide it exactly; functional
+    scatter updates (``table.at[idx].set``) preserve the layout, and
+    an unmeshed host gets the plain single-device array."""
+    return shard_batch(mesh, table)
+
+
 def shard_batch(mesh: Mesh | None, arr):
     """Device-put ONE array with axis 0 sharded over the mesh.
 

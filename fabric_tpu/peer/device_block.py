@@ -83,10 +83,44 @@ def _policy_reduce(sig_padded, match, endo_idx, sig: PlanSig):
     return vals[-1], safe
 
 
+def _resident_ver_ok(static_p, table, u_pack, read_pv, R: int,
+                     u_bucket: int):
+    """[T] bool committed-version check computed ON DEVICE from the
+    resident version table — the device twin of
+    ``VecStaticBlock.ver_ok_from_u`` (bit-equal: same validateKVRead
+    reduction, committed rows gathered from ``table`` for resident
+    slots and from the host-provided lanes of ``u_pack`` for misses
+    and in-flight-overlay overrides).
+
+      table    [cap, 3] i32: present | ver_block | ver_txnum
+      u_pack   [Ub, 4] i32: slot (−1 = host lane) | present | vb | vt
+      read_pv  [T, R, 3] i32: expected present | vb | vt per read
+    """
+    slot = u_pack[:, 0]
+    use_host = slot < 0
+    trow = table[jnp.where(slot >= 0, slot, 0)]          # [Ub, 3]
+    urow = jnp.where(use_host[:, None], u_pack[:, 1:4], trow)
+    up = jnp.concatenate(
+        [urow[:, 0] != 0, jnp.zeros((1,), bool)]
+    )  # + sentinel row for padding reads
+    uv = jnp.concatenate(
+        [urow[:, 1:3], jnp.zeros((1, 2), urow.dtype)]
+    )
+    rk = static_p[:, :R]                                  # [T, R]
+    idx = jnp.where(rk >= 0, rk, u_bucket)
+    cp = up[idx]                                          # [T, R]
+    cv = uv[idx]                                          # [T, R, 2]
+    rp = read_pv[:, :, 0] != 0
+    rv = read_pv[:, :, 1:3]
+    ver_eq = jnp.all(rv == cv, axis=-1)
+    okr = jnp.where(rp & cp, ver_eq, rp == cp)
+    return jnp.all(okr | (rk < 0), axis=-1)
+
+
 def build_stage2(t_bucket: int, n_sig: int, group_sigs: tuple,
-                 static_dims: tuple):
+                 static_dims: tuple, resident_dims: tuple | None = None):
     """→ jitted stage2(sig_valid, launch_vec, *group_packed,
-    static_packed) → packed int8.
+    static_packed[, table, u_pack, read_pv]) → packed int8.
 
     Inputs arrive PACKED — one array per H2D transfer (each device_put
     costs ~1 ms of fixed host overhead over the tunnel, so the
@@ -99,6 +133,13 @@ def build_stage2(t_bucket: int, n_sig: int, group_sigs: tuple,
       [3T:4T]  creator_ok   [4T:5T] policy_ok
       [5T:5T+n_sig] sig_valid
       then per group: [Eb] safe bits.
+
+    ``resident_dims`` = (u_bucket, capacity) compiles the
+    DEVICE-RESIDENT state variant (fabric_tpu/state): launch_vec's
+    ver_ok column is ignored and the per-read committed-version check
+    runs on device against the resident version table
+    (:func:`_resident_ver_ok`) — the host ``state_fill`` gather only
+    covers the miss/overlay lanes shipped inside ``u_pack``.
     """
     R, W, Q = static_dims
 
@@ -108,7 +149,13 @@ def build_stage2(t_bucket: int, n_sig: int, group_sigs: tuple,
         static_p = rest[g]
         creator_idx = launch_vec[:, 0]
         structural_ok = launch_vec[:, 1] != 0
-        ver_ok = launch_vec[:, 2] != 0
+        if resident_dims is not None:
+            table, u_pack, read_pv = rest[g + 1:g + 4]
+            ver_ok = _resident_ver_ok(
+                static_p, table, u_pack, read_pv, R, resident_dims[0]
+            )
+        else:
+            ver_ok = launch_vec[:, 2] != 0
         # two sentinel lanes past the batch: n_sig = missing creator
         # (False), n_sig+1 = HOST-verified creator (True — idemix
         # identities have no batch lane; validator encodes them as -2)
@@ -185,7 +232,7 @@ class DeviceBlockPipeline:
         )
 
     def run(self, handle, launch_vec, groups, static_packed, static_dims,
-            pre_ok_pad_len, mesh=None):
+            pre_ok_pad_len, mesh=None, resident=None):
         """handle: p256v3.VerifyHandle; launch_vec np [T,3] i32;
         groups: list of (plan, packed_dev [Eb, S·P+S+1], Eb, S);
         static_packed: device [T, R+W+2Q] i32; static_dims: (R, W, Q).
@@ -198,17 +245,31 @@ class DeviceBlockPipeline:
         vector (``handle.device_out``) keeps whatever sharding the
         verify dispatch gave it.  Bit-equal to unsharded: every device
         value is integer/boolean (the f32 fixpoint matvec sums 0/1
-        counts < 2^24, exact in any reduction order)."""
+        counts < 2^24, exact in any reduction order).
+
+        ``resident``: (table_dev [cap,3] i32, u_pack np [Ub,4] i32,
+        read_pv_dev [T,R,3] i32) — the device-resident state operands
+        (fabric_tpu/state): the program variant computes ver_ok ON
+        DEVICE from the resident version table, launch_vec's ver_ok
+        column is inert.  The table keeps whatever sharding the
+        residency manager gave it (axis 0 over the same data mesh);
+        u_pack is the only launch-time state upload."""
         t_bucket = pre_ok_pad_len
         n_sig = int(handle.device_out.shape[0])
         gsigs = tuple(
             plan_sig(plan, eb, s) for plan, _, eb, s in groups
         )
-        key = (t_bucket, n_sig, gsigs, static_dims)
+        resident_dims = None
+        if resident is not None:
+            table_dev, u_pack, read_pv_dev = resident
+            resident_dims = (int(u_pack.shape[0]),
+                             int(table_dev.shape[0]))
+        key = (t_bucket, n_sig, gsigs, static_dims, resident_dims)
         fn = self._cache.get(key)
         if fn is None:
             fn = self._cache[key] = build_stage2(
-                t_bucket, n_sig, gsigs, static_dims
+                t_bucket, n_sig, gsigs, static_dims,
+                resident_dims=resident_dims,
             )
             self._cache_gauge.set(len(self._cache))
         t0 = time.perf_counter()
@@ -219,6 +280,11 @@ class DeviceBlockPipeline:
                 shard_batch(mesh, jnp.asarray(launch_vec))]
         args += [shard_batch(mesh, gp) for _, gp, _, _ in groups]
         args += [shard_batch(mesh, static_packed)]
+        if resident is not None:
+            # table keeps the manager's sharding; u_pack is per-key
+            # (not per-tx) so it rides unsharded — it is tiny
+            args += [table_dev, jnp.asarray(u_pack),
+                     shard_batch(mesh, read_pv_dev)]
         from fabric_tpu.observe import device_annotation
 
         # lines the fused stage-2 dispatch up with the XLA timeline

@@ -67,6 +67,9 @@ class PeerChannel:
                  device_retries: int = 2,
                  device_recovery_s: float = 30.0,
                  verify_deadline_ms: float = 0.0,
+                 state_resident: bool = False,
+                 state_resident_mb: int = 64,
+                 state_resident_range_bits: int = 12,
                  sidecar_endpoint: str = "",
                  sidecar_weight: float = 1.0,
                  sidecar_recovery_s: float = 5.0,
@@ -179,6 +182,9 @@ class PeerChannel:
             device_retries=device_retries,
             device_recovery_s=device_recovery_s,
             verify_deadline_ms=verify_deadline_ms,
+            state_resident=state_resident,
+            state_resident_mb=state_resident_mb,
+            state_resident_range_bits=state_resident_range_bits,
             channel=channel_id,
         )
         if sidecar_endpoint:
@@ -329,6 +335,14 @@ class PeerChannel:
             await self._commit_inner(
                 block, pend.txs, flt, batch, history, pend.hd_bytes
             )
+            # device-resident state (fabric_tpu/state): the serial /
+            # anti-entropy path commits OUTSIDE the CommitPipeline, so
+            # its write-set delta must reach the resident table here —
+            # a bypassed scatter is exactly the stale-version hazard
+            # FT015 polices (idempotent if a pipeline ever re-routes)
+            rc = getattr(self.validator, "resident_commit", None)
+            if rc is not None:
+                rc(batch)
             t2 = _time.perf_counter()
         self._commit_metrics(flt, t1 - t0, t2 - t1, t2 - t0)
         self._signal_height()
@@ -1102,6 +1116,9 @@ class PeerNode:
                  device_retries: int = 2,
                  device_recovery_s: float = 30.0,
                  verify_deadline_ms: float = 0.0,
+                 state_resident: bool = False,
+                 state_resident_mb: int = 64,
+                 state_resident_range_bits: int = 12,
                  faults: str = "",
                  sidecar_endpoint: str = "",
                  sidecar_weight: float = 1.0,
@@ -1162,6 +1179,13 @@ class PeerNode:
         self.device_retries = int(device_retries)
         self.device_recovery_s = float(device_recovery_s)
         self.verify_deadline_ms = float(verify_deadline_ms)
+        # device-resident MVCC state knobs (fabric_tpu/state): every
+        # joined channel's validator pins an LRU key-range residency
+        # cache in device memory.  OFF by default — CPU/tier-1 hosts
+        # keep the exact host state_fill path.
+        self.state_resident = bool(state_resident)
+        self.state_resident_mb = int(state_resident_mb)
+        self.state_resident_range_bits = int(state_resident_range_bits)
         # validation sidecar knobs (fabric_tpu/sidecar): endpoint =
         # this peer's channels validate through a remote sidecar;
         # listen = this process ALSO serves one from its device fabric
@@ -1362,6 +1386,9 @@ class PeerNode:
             device_retries=self.device_retries,
             device_recovery_s=self.device_recovery_s,
             verify_deadline_ms=self.verify_deadline_ms,
+            state_resident=self.state_resident,
+            state_resident_mb=self.state_resident_mb,
+            state_resident_range_bits=self.state_resident_range_bits,
             sidecar_endpoint=self.sidecar_endpoint,
             sidecar_weight=self.sidecar_weight,
             sidecar_recovery_s=self.sidecar_recovery_s,
@@ -1447,6 +1474,30 @@ class PeerNode:
                 self.sign_signer = signlane.BatchedSigner(
                     self.signer, self.sign_batcher
                 )
+                if self.slos:
+                    # endorse-side SLOs: a peer that declares SLOs AND
+                    # runs the sign lane arms the default
+                    # endorse:latency / endorse_busy:busy pair (unless
+                    # the operator's spec already names the endorse
+                    # channel) and feeds them from the lane's
+                    # per-request wait/BUSY telemetry — the same
+                    # values its histograms record — so /slo and
+                    # burns() cover the endorsement half of the flow
+                    from fabric_tpu.observe import slo as _slo
+
+                    engine = _slo.global_engine()
+                    if not any(o.channel == _slo.ENDORSE_CHANNEL
+                               for o in engine.objectives):
+                        engine.set_objectives(
+                            tuple(engine.objectives) + tuple(
+                                _slo.parse_slos(
+                                    _slo.DEFAULT_ENDORSE_SLOS
+                                )
+                            )
+                        )
+                    self.sign_batcher.observer = (
+                        _slo.endorse_observer(engine)
+                    )
         if self.autopilot:
             # close the adaptive-control loop: the controller reads
             # the global SLO engine + the sidecar scheduler (when this
@@ -1476,18 +1527,30 @@ class PeerNode:
                 if (knob == "sign_batch_max"
                         and self.sign_batcher is not None):
                     self.sign_batcher.set_batch_max(int(value))
+                if (knob == "sign_batch_wait_ms"
+                        and self.sign_batcher is not None):
+                    self.sign_batcher.set_wait_ms(float(value))
 
             sched = (self.sidecar_server.scheduler
                      if self.sidecar_server is not None else None)
+            # the host-workers ladder clamps to this machine's cores
+            # (rungs the pool cannot take must not charge cooldowns or
+            # log phantom decisions), and its starting value is the
+            # RESOLVED pool size, not the raw config (−1 would snap to
+            # 0 and invert the knob)
+            specs = host_clamped_specs(
+                parse_knob_specs(self.autopilot_knobs or None)
+            )
+            if self.sign_batch_wait_ms == 0:
+                # wait_ms=0 is the operator's STATIC flush-immediately
+                # choice (the spec parser itself refuses a 0 ladder
+                # floor) — snapping it onto the 0.5 rung and stepping
+                # "up" on the first empty flush would silently override
+                # it, so the knob stays structurally inert here
+                specs = {k: v for k, v in specs.items()
+                         if k != "sign_batch_wait_ms"}
             self.autopilot_ctl = Autopilot(
-                # the host-workers ladder clamps to this machine's
-                # cores (rungs the pool cannot take must not charge
-                # cooldowns or log phantom decisions), and its
-                # starting value is the RESOLVED pool size, not the
-                # raw config (−1 would snap to 0 and invert the knob)
-                host_clamped_specs(
-                    parse_knob_specs(self.autopilot_knobs or None)
-                ), _apply,
+                specs, _apply,
                 set_weight=(sched.set_weight if sched else None),
                 set_shed=(sched.set_shed if sched else None),
                 slo=global_engine(), scheduler=sched,
@@ -1501,6 +1564,7 @@ class PeerNode:
                         self.host_stage_workers
                     ),
                     "sign_batch_max": self.sign_batch_max,
+                    "sign_batch_wait_ms": self.sign_batch_wait_ms,
                 },
             )
             if self.sidecar_server is not None:

@@ -528,6 +528,20 @@ class CommitPipeline:
             _faults.fire("pipeline.prefetch")
             return self._prefetch_many_fn(group)
 
+    def _resident_commit(self, res) -> None:
+        """Device-resident state (fabric_tpu/state): apply the
+        committed block's write-set delta to the validator's resident
+        version table AT the commit boundary — strictly before the
+        commit future resolves, so a successor launch whose overlay no
+        longer covers this block has happens-before ordering with the
+        scatter (the coherence contract in state/residency.py), while
+        a launch whose overlay still covers it forces the same keys
+        onto overlay-valued host lanes either way.  Validators without
+        the hook (toy validators, custom prefetchers) skip free."""
+        fn = getattr(self.validator, "resident_commit", None)
+        if fn is not None:
+            fn(res.batch)
+
     def _commit_traced(self, res, root):
         """Committer-thread task: commit under its span, then finalize
         the block's root — ring append + slow-block watchdog run here,
@@ -536,6 +550,7 @@ class CommitPipeline:
             with self.tracer.span("commit", parent=root):
                 _faults.fire("pipeline.commit")
                 self.commit_fn(res)
+                self._resident_commit(res)
         except BaseException:
             self._note_stage_failure("commit", res.block.header.number)
             raise
@@ -720,6 +735,7 @@ class CommitPipeline:
             with tr.span("commit", parent=root):
                 _faults.fire("pipeline.commit")
                 self.commit_fn(res)
+                self._resident_commit(res)
         except BaseException:
             self._note_stage_failure("commit", block.header.number)
             raise
@@ -784,6 +800,7 @@ class CommitPipeline:
                 with self.tracer.span("commit", parent=root):
                     _faults.fire("pipeline.commit")
                     self.commit_fn(res)
+                    self._resident_commit(res)
             except BaseException:
                 self._note_stage_failure(
                     "commit", res.block.header.number

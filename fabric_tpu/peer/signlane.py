@@ -148,6 +148,12 @@ class SignBatcher:
         # (t, True = admitted | False = BUSY); bounded by count AND
         # aged out by _SIGNAL_WINDOW_S at read time
         self._recent: deque[tuple[float, bool]] = deque(maxlen=256)
+        # per-request observer (observe/slo.endorse_observer shape):
+        # called OUTSIDE the condition lock with (wait_ms, busy) —
+        # flushed requests carry their coalescing-window wait, BUSY
+        # bounces carry wait_ms=None.  Contained: an observer error
+        # never kills the flusher or an endorser thread.
+        self.observer = None
         self._wait_samples: deque[tuple[float, float]] = deque(
             maxlen=256
         )  # (t, wait ms)
@@ -211,6 +217,7 @@ class SignBatcher:
         """Block until the batch carrying ``digest`` flushes; →
         (r, s).  Raises :class:`SignBusy` on admission overflow."""
         now = self.clock()
+        busy_exc = None
         with self._cond:
             cap = self._batch_max * _QUEUE_BATCHES
             if self._stopped:
@@ -219,12 +226,18 @@ class SignBatcher:
                 self._busy_total += 1
                 self._recent.append((now, False))
                 self._busy_ctr.add()
-                raise SignBusy(len(self._pending), cap)
-            p = _Pending(int(digest), now)
-            self._pending.append(p)
-            self._recent.append((now, True))
-            self._req_ctr.add()
-            self._cond.notify_all()
+                busy_exc = SignBusy(len(self._pending), cap)
+            else:
+                p = _Pending(int(digest), now)
+                self._pending.append(p)
+                self._recent.append((now, True))
+                self._req_ctr.add()
+                self._cond.notify_all()
+        if busy_exc is not None:
+            # outside the lock: the endorse SLO feed must never
+            # serialize (or deadlock) the admission path
+            self._observe(None, True)
+            raise busy_exc
         deadline = time.monotonic() + timeout_s
         warn_at = time.monotonic() + 60.0
         while not p.event.wait(timeout=1.0):
@@ -287,7 +300,9 @@ class SignBatcher:
                 )
             self._occupancy.append(len(batch))
         for p in batch:
-            self._wait_h.observe(max(0.0, t0 - p.t_submit))
+            w = max(0.0, t0 - p.t_submit)
+            self._wait_h.observe(w)
+            self._observe(w * 1000.0, False)
         self._lanes_h.observe(len(batch))
         try:
             sigs = self.sign_many([p.digest for p in batch])
@@ -310,6 +325,18 @@ class SignBatcher:
             p.event.set()
 
     # -- observability -----------------------------------------------------
+
+    def _observe(self, wait_ms, busy: bool) -> None:
+        """Hand one request event to the attached observer (the
+        endorse-side SLO feed, observe/slo.endorse_observer) —
+        contained, lock-free."""
+        obs = self.observer
+        if obs is None:
+            return
+        try:
+            obs(wait_ms, busy)
+        except Exception as e:
+            _log.debug("sign-lane observer failed: %s", e)
 
     def stats(self) -> dict:
         """Snapshot for bench extras and the autopilot's sign knob:

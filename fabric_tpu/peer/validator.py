@@ -324,6 +324,9 @@ class BlockValidator:
         device_retries: int = 2,
         device_recovery_s: float = 30.0,
         verify_deadline_ms: float = 0.0,
+        state_resident: bool = False,
+        state_resident_mb: int = 64,
+        state_resident_range_bits: int = 12,
         channel: str = "",
     ):
         self.msp = msp_manager
@@ -422,6 +425,27 @@ class BlockValidator:
             )
         else:
             self.device_guard = None
+        # device-resident MVCC state (fabric_tpu/state, nodeconfig
+        # ``state_resident`` / ``state_resident_mb`` /
+        # ``state_resident_range_bits``): an LRU key-range residency
+        # cache keeps committed versions in DEVICE memory across
+        # blocks — the fused stage-2 program reads them there and the
+        # per-block host state_fill shrinks to the miss/overlay set,
+        # with each committed write-set applied as a delta scatter at
+        # the commit boundary (CommitPipeline → resident_commit).
+        # Default OFF: the host state_fill path — which also stays as
+        # the bit-equal fallback oracle for misses, range queries,
+        # eviction pressure and device failures — is the exact
+        # existing path.
+        if state_resident:
+            from fabric_tpu.state import resolve_residency
+
+            self.resident = resolve_residency(
+                True, state_resident_mb, state_resident_range_bits,
+                mesh=self.mesh, channel=channel,
+            )
+        else:
+            self.resident = None
         # optional phase accumulator (seconds per phase, summed across
         # blocks) — the bench publishes it as the per-phase breakdown
         # artifact; None = no instrumentation overhead
@@ -1854,6 +1878,11 @@ class BlockValidator:
             static.u_index = dict(zip(static.u_pairs,
                                       range(rwp.n_keys)))
             static.packed_static()
+            if self.resident is not None:
+                # expected-read plane for the device-resident compare:
+                # state-independent, so it uploads HERE (prefetch
+                # thread), never on the launch critical path
+                static.packed_read_pv()
             return _DevicePre(
                 groups=groups, group_entries=group_entries, static=static,
                 has_range=False, policies=self.policies,
@@ -1984,6 +2013,8 @@ class BlockValidator:
         # prefetch-thread key index (see _device_preprocess)
         static.u_index = dict(zip(pairs, range(rwp.n_keys)))
         static.packed_static()  # ONE H2D, prefetch thread
+        if self.resident is not None:
+            static.packed_read_pv()  # resident-compare expected plane
         return _DevicePre(
             groups=groups, group_entries=group_entries, static=static,
             has_range=False, policies=self.policies,
@@ -2050,7 +2081,29 @@ class BlockValidator:
                     )  # -2 = host-verified (idemix) → always-true lane
 
         static = dpre.static
-        if getattr(static, "u_pairs", None) is not None:
+        resident_pack = None
+        if (self.resident is not None and self.resident.enabled
+                and getattr(static, "u_pairs", None) is not None
+                and not dpre.has_range):
+            # device-resident state path: the committed-version
+            # compare runs ON DEVICE against the resident table; the
+            # host gather below shrinks to the miss/overlay set.  Any
+            # failure latches the cache off and this block (and every
+            # later one) takes the host oracle path — verdicts never
+            # change, only time does.
+            try:
+                resident_pack = self._resident_pack(static, overlay)
+            except Exception as e:
+                self.resident.disable(f"resident launch failed: {e}")
+                _log.warning(
+                    "resident state path failed for block %d (%s) — "
+                    "falling back to host state_fill",
+                    block.header.number, e,
+                )
+                resident_pack = None
+        if resident_pack is not None:
+            ver_ok = 1  # inert lane: computed on device from the table
+        elif getattr(static, "u_pairs", None) is not None:
             # flat path: committed versions per UNIQUE key, compared on
             # host — one [T] bool rides to the device
             ver_ok = self._flat_ver_ok(static, overlay)
@@ -2072,9 +2125,58 @@ class BlockValidator:
         fetch2 = self._device_pipeline.run(
             handle, launch_vec, dpre.groups, static.packed_static(),
             static.dims, t_bucket, mesh=self.mesh,
+            resident=resident_pack,
         )
         self._t("stage2_dispatch", t0)
         return fetch2, range_phantom
+
+    # -- device-resident state (fabric_tpu/state) --------------------------
+
+    def _resident_pack(self, static, overlay):
+        """Build the resident-state stage-2 operands for one flat
+        block — ``(table_snapshot, u_pack [Ub,4] i32, read_pv_dev)``
+        — or None when the block must take the host oracle path.  The
+        slot/host-lane packing (hit slots captured atomically with
+        the table snapshot, misses host-gathered + admitted, overlay
+        keys forced onto overlay-valued host lanes) is the
+        subsystem's ``state.build_launch_pack``; this wrapper only
+        supplies the prefetch-built key index and appends the
+        expected-read plane the prefetch thread already uploaded."""
+        from fabric_tpu.state import build_launch_pack
+
+        pairs = static.u_pairs
+        idx = getattr(static, "u_index", None)
+        if idx is None:  # built on the prefetch thread normally
+            idx = static.u_index = dict(zip(pairs, range(len(pairs))))
+        out = build_launch_pack(
+            self.resident, pairs, self.state, overlay=overlay,
+            u_index=idx,
+        )
+        if out is None:
+            return None
+        table, u_pack = out
+        return (table, u_pack, static.packed_read_pv())
+
+    def resident_commit(self, batch) -> None:
+        """Apply one COMMITTED block's write-set to the resident
+        version table as a delta scatter — called at the commit
+        boundary by the CommitPipeline (committer thread; inline for
+        barriers/serial) and by the serial ``commit_block`` path, so
+        the table never misses a committed delta regardless of which
+        path a block rode.  Idempotent (a replayed batch scatters the
+        same values); a device failure latches the cache off, never
+        changes verdicts.  No-op when the cache is off or disabled."""
+        res = self.resident
+        if res is None or not res.enabled or batch is None:
+            return
+        try:
+            res.apply_batch(batch)
+        except Exception as e:
+            res.disable(f"commit scatter failed: {e}")
+            _log.warning(
+                "resident commit scatter failed (%s) — cache disabled, "
+                "blocks take the host state_fill path", e,
+            )
 
     def _flat_ver_ok(self, static, overlay):
         """[T] bool committed-version check for a flat block: one FUSED

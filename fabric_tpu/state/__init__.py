@@ -1,0 +1,13 @@
+"""Device-resident MVCC state: the LRU key-range residency cache.
+
+The subsystem that lets the fused stage-2 program read committed
+versions from DEVICE memory instead of re-gathering them on host every
+block (``fabric_tpu/state/residency.py``).  The host ``state_fill``
+path stays intact as the bit-equal oracle and the per-block fallback.
+"""
+
+from fabric_tpu.state.residency import (  # noqa: F401
+    ResidencyManager,
+    build_launch_pack,
+    resolve_residency,
+)

@@ -1,0 +1,632 @@
+"""Device-resident MVCC version cache: an LRU key-range residency
+manager with delta scatter commits.
+
+Why this exists: every block used to re-gather its committed read
+versions on host (the ``state_fill`` stage — ``get_versions_cols``
+over the block's unique keys) and ship the result up inside the launch
+frame, because the device forgot the world between blocks.  But the
+commit pipeline already COMPUTES the exact per-block change to that
+world — the committed ``UpdateBatch`` (and at depth N, the merged
+overlay machinery proves those deltas compose).  Keeping a version
+table resident in device memory turns the per-block cost from
+O(unique read keys) host work + upload into:
+
+* a host dict probe per unique key (the residency directory),
+* ONE small launch upload — slot ids plus host-provided lanes for the
+  misses and the in-flight-overlay overrides,
+* one scatter per committed block applying its write-set delta.
+
+Millions of keys won't fit, so residency is an **LRU key-range
+cache**: keys hash into ``2^range_bits`` ranges and ranges are the
+admission/eviction unit — hot-key working sets (the realistic traffic
+shape) stay pinned while cold ranges age out.  A missed key rides the
+host path for ITS block (the shrunken ``state_fill``) and is admitted
+for the next one.
+
+Coherence with the depth-N pipeline (peer/pipeline.py): the table
+always holds committed state as of some prefix of the chain, and every
+launch overlays the in-flight commit window on top — exactly the
+contract the host ``state_fill`` already satisfies:
+
+* the commit scatter (:meth:`apply_batch`) runs inside the pipeline's
+  commit boundary, BEFORE the block's commit future resolves, so a
+  launch whose overlay no longer covers block k has happens-before
+  ordering with k's scatter;
+* a launch whose overlay still covers k forces k's keys onto host
+  lanes carrying the overlay values — whether the scatter landed or
+  not, the override wins (and the scatter writes the same values);
+* jax arrays are immutable, so a launch captures a consistent table
+  SNAPSHOT (:meth:`lookup` returns slots and table atomically under
+  the lock); later scatters/evictions produce new arrays and can
+  never tear an in-flight dispatch.
+
+Admission never persists a racy read: keys covered by the launch
+overlay are NOT admitted at launch time (their committed read races
+the in-flight apply) — the commit scatter lands them with the
+authoritative value instead.
+
+Failure containment: any device error inside the manager latches it
+DISABLED (:meth:`disable`) — every lookup then misses and blocks ride
+the host oracle path; verdicts never change, only time does.  Nothing
+here is durable: a crash rebuilds residency cold from the reopened
+ledger's traffic (pinned by the differential battery).
+
+Default OFF (nodeconfig ``state_resident``): CPU/tier-1 hosts keep
+the exact existing ``state_fill`` path and never construct a manager.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+from collections import OrderedDict, deque
+
+import numpy as np
+
+_log = logging.getLogger("fabric_tpu.state.residency")
+
+#: bytes per table slot: (present, ver_block, ver_txnum) int32
+SLOT_BYTES = 12
+
+#: smallest table the capacity knob can produce — below this the
+#: directory overhead dwarfs the cache
+MIN_SLOTS = 256
+
+#: scatter row-count buckets (pow2) so the jitted update kernel
+#: compiles a bounded family of shapes
+_MIN_SCATTER = 16
+
+#: trailing lookups the hit-rate gauge aggregates over
+_HIT_WINDOW = 256
+
+
+def _ver_i32(block: int, txnum: int) -> tuple[int, int]:
+    """(block, txnum) version → int32 bit patterns (the table stores
+    uint32 versions as raw int32 bits; every consumer compares for
+    EQUALITY only, so the reinterpretation is exact)."""
+    return (
+        int(np.uint32(block).view(np.int32)),
+        int(np.uint32(txnum).view(np.int32)),
+    )
+
+
+def build_launch_pack(res: "ResidencyManager", pairs: list, state,
+                      overlay=None, u_index: dict | None = None):
+    """One block's resident-state launch operands:
+    ``(table_snapshot, u_pack [Ub, 4] int32)`` — or None when the
+    block must take the host oracle path (working set larger than the
+    whole table, cache latched off mid-way).
+
+    * hits reference table slots captured ATOMICALLY with the table
+      snapshot (:meth:`ResidencyManager.lookup`);
+    * misses ride host lanes (slot −1) carrying the SHRUNKEN
+      ``state_fill`` gather (``state.get_versions_cols`` over the miss
+      set only) and are admitted for future blocks;
+    * keys the in-flight overlay window touches are FORCED onto host
+      lanes with the overlay values — the same override rule the host
+      ``_flat_ver_ok`` applies, so resident ≡ host by construction —
+      and are never admitted from the (racy) committed read: their
+      commit scatter lands the authoritative value at the commit
+      boundary.
+
+    ``u_pack`` pads to a pow2 bucket so the stage-2 program cache
+    compiles one variant per bucket, and its upload bytes (plus the
+    admit scatter) feed the per-block h2d accounting."""
+    U = len(pairs)
+    if U > res.capacity:
+        return None  # guaranteed eviction thrash: host path
+    # overlay override set FIRST: lookup accounts forced lanes on
+    # their own counter (neither hit nor miss — the A/B attribution
+    # must not credit the table for reads served from the overlay)
+    over_vals: dict[int, tuple] = {}
+    forced: set | None = None
+    if overlay is not None and getattr(overlay, "updates", None):
+        if u_index is None:
+            u_index = dict(zip(pairs, range(U)))
+        iget = u_index.get
+        for pr, vv in overlay.updates.items():
+            ui = iget(pr)
+            if ui is None:
+                continue
+            if vv.value is None:  # in-flight delete
+                over_vals[ui] = (0, 0, 0)
+            else:
+                vb, vt = _ver_i32(int(vv.version[0]),
+                                  int(vv.version[1]))
+                over_vals[ui] = (1, vb, vt)
+        if over_vals:
+            forced = {pairs[ui] for ui in over_vals}
+    slots, table = res.lookup(pairs, forced_pairs=forced)
+    if table is None:
+        return None  # latched off under the lookup
+    host_pack = np.zeros((U, 3), np.int32)
+    nbytes = 0
+    # misses = host lanes that really gather from the state DB (the
+    # forced overlay lanes came back −1 too, but their values come
+    # from the overlay below and they are never admitted from the
+    # racy committed read — the commit scatter lands them)
+    miss_rows = [
+        i for i in np.flatnonzero(slots < 0).tolist()
+        if i not in over_vals
+    ]
+    if miss_rows:
+        miss_pairs = [pairs[i] for i in miss_rows]
+        # THE shrunken state_fill: only the miss set hits the backend
+        up, uv = state.get_versions_cols(miss_pairs)
+        rows = np.asarray(miss_rows)
+        host_pack[rows, 0] = up
+        host_pack[rows, 1:3] = uv.view(np.int32)
+        nbytes += res.admit(miss_pairs, up, uv)
+    for ui, row in over_vals.items():
+        host_pack[ui] = row
+    Ub = max(_MIN_SCATTER, 1 << max(U - 1, 0).bit_length())
+    u_pack = np.full((Ub, 4), -1, np.int32)
+    u_pack[:, 1:4] = 0
+    if U:
+        u_pack[:U, 0] = slots
+        u_pack[:U, 1:4] = host_pack
+    res.note_upload(u_pack.nbytes)
+    res.observe_block(nbytes + u_pack.nbytes)
+    return table, u_pack
+
+
+def resolve_residency(state_resident: bool, mb: int, range_bits: int,
+                      mesh=None, channel: str = ""):
+    """Production knob triple → a :class:`ResidencyManager` or None
+    (the nodeconfig ``state_resident`` / ``state_resident_mb`` /
+    ``state_resident_range_bits`` flow) — mirrors ``resolve_mesh`` /
+    ``resolve_host_pool``: OFF costs nothing, not even the import of
+    the device stack (the table builds lazily)."""
+    if not state_resident:
+        return None
+    return ResidencyManager(capacity_mb=mb, range_bits=range_bits,
+                            mesh=mesh, channel=channel)
+
+
+class ResidencyManager:
+    """See module docstring.
+
+    Locking: ONE lock guards the directory (key → slot), the range
+    LRU, the free-slot pool and the table pointer.  Table mutation is
+    a functional scatter (``table.at[idx].set``) producing a NEW
+    array, so readers holding an older snapshot are never torn; the
+    lock only serializes the read-modify-write of the pointer (a
+    commit scatter on the committer thread vs an admission on the
+    launch thread would otherwise lose one of the two updates).
+    """
+
+    def __init__(self, capacity_mb: int = 64, range_bits: int = 12,
+                 mesh=None, channel: str = "", registry=None,
+                 slots: int | None = None):
+        if capacity_mb < 1:
+            raise ValueError("state_resident_mb must be >= 1")
+        if not (1 <= int(range_bits) <= 24):
+            raise ValueError(
+                "state_resident_range_bits must be in [1, 24]"
+            )
+        if slots is not None:
+            # explicit slot count — the test seam that makes eviction
+            # churn drivable without a megabyte working set
+            if slots < 4:
+                raise ValueError("slots must be >= 4")
+            self.capacity = 1 << (int(slots).bit_length() - 1)
+        else:
+            want = (int(capacity_mb) * (1 << 20)) // SLOT_BYTES
+            # pow2 slot count: mesh shards divide it exactly and the
+            # stage-2 program cache keys on the table shape
+            self.capacity = max(
+                MIN_SLOTS, 1 << (max(want, 1).bit_length() - 1)
+            )
+        self.range_bits = int(range_bits)
+        self.mesh = mesh
+        self.channel = channel
+        self._lock = threading.Lock()
+        self._table = None  # lazy [capacity, 3] int32 on device
+        # (ns, key) → (slot, range_id): the range id is immutable per
+        # key, so caching it here keeps every post-admission path — the
+        # launch-critical lookup especially — a pure dict probe (no
+        # per-hit blake2b under the lock)
+        self._dir: dict[tuple, tuple] = {}
+        self._ranges: OrderedDict[int, list] = OrderedDict()  # LRU
+        self._free: list[int] = list(range(self.capacity - 1, -1, -1))
+        self._enabled = True
+        self._scatter_fns: dict[int, object] = {}
+        self._recent: deque[tuple[int, int]] = deque(maxlen=_HIT_WINDOW)
+        self._hits_total = 0
+        self._misses_total = 0
+        self._overlay_forced_total = 0
+        self._evictions_total = 0
+        self._h2d_bytes_total = 0
+        if registry is None:
+            from fabric_tpu.ops_metrics import global_registry
+
+            registry = global_registry()
+        self._hits_ctr = registry.counter(
+            "state_resident_hits_total",
+            "unique read keys served from the device-resident table",
+        )
+        self._miss_ctr = registry.counter(
+            "state_resident_misses_total",
+            "unique read keys that fell back to the host state gather",
+        )
+        self._forced_ctr = registry.counter(
+            "state_resident_overlay_forced_total",
+            "unique read keys routed onto overlay-valued host lanes "
+            "(neither a resident hit nor a state-gather miss)",
+        )
+        self._evict_ctr = registry.counter(
+            "state_resident_evictions_total",
+            "key ranges evicted from the device-resident table (LRU)",
+        )
+        self._hit_gauge = registry.gauge(
+            "state_resident_hit_rate",
+            "trailing resident hit rate over unique read keys",
+        )
+        self._enabled_gauge = registry.gauge(
+            "state_resident_enabled",
+            "1 while the device-resident state cache is serving lookups",
+        )
+        self._h2d_hist = registry.histogram(
+            "h2d_state_bytes_per_block",
+            "state bytes uploaded per block on the resident path "
+            "(miss fill + launch slot frame + write-set delta)",
+            buckets=(256, 1024, 4096, 16384, 65536, 262144, 1048576,
+                     float("inf")),
+        )
+        self._enabled_gauge.set(1, channel=self.channel)
+
+    # -- state -------------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def disable(self, reason: str = "") -> None:
+        """Latch the cache OFF — every subsequent lookup misses, so
+        blocks ride the host ``state_fill`` oracle.  Called on any
+        device error inside the manager (and by the validator when a
+        resident launch path throws): the latch changes time, never
+        verdicts."""
+        with self._lock:
+            already = not self._enabled
+            self._enabled = False
+            self._table = None
+            self._dir.clear()
+            self._ranges.clear()
+            self._free = list(range(self.capacity - 1, -1, -1))
+        if not already:
+            self._enabled_gauge.set(0, channel=self.channel)
+            _log.warning(
+                "%s: device-resident state cache DISABLED (%s) — "
+                "blocks take the host state_fill path",
+                self.channel or "validator", reason or "unspecified",
+            )
+
+    def range_of(self, ns: str, key: str) -> int:
+        """Stable hash range id for a key — the top ``range_bits``
+        bits of a 64-bit digest of ``ns \\0 key``."""
+        h = hashlib.blake2b(
+            f"{ns}\x00{key}".encode(), digest_size=8
+        ).digest()
+        return int.from_bytes(h, "big") >> (64 - self.range_bits)
+
+    # -- the device table --------------------------------------------------
+
+    def _ensure_table(self):
+        """Lazy table build (first armed lookup): jax is imported here
+        and nowhere at module level, so constructing a manager on a
+        jax-free host costs nothing until the device path engages."""
+        if self._table is None:
+            import jax.numpy as jnp
+
+            from fabric_tpu.parallel.mesh import shard_state_table
+
+            self._table = shard_state_table(
+                self.mesh, jnp.zeros((self.capacity, 3), jnp.int32)
+            )
+        return self._table
+
+    def _scatter(self, idx: np.ndarray, rows: np.ndarray) -> None:
+        """table[idx] = rows, functionally, under the caller-held
+        lock.  Rows pad to a pow2 bucket with out-of-bounds indices
+        (== capacity), which jax scatter DROPS — one compiled update
+        program per bucket size, not per row count."""
+        import jax
+        import jax.numpy as jnp
+
+        k = len(idx)
+        if k == 0:
+            return
+        bucket = max(_MIN_SCATTER, 1 << (k - 1).bit_length())
+        pidx = np.full(bucket, self.capacity, np.int32)
+        prows = np.zeros((bucket, 3), np.int32)
+        pidx[:k] = idx
+        prows[:k] = rows
+        fn = self._scatter_fns.get(bucket)
+        if fn is None:
+            fn = self._scatter_fns[bucket] = jax.jit(
+                lambda t, i, r: t.at[i].set(r)
+            )
+        self._table = fn(self._ensure_table(), jnp.asarray(pidx),
+                         jnp.asarray(prows))
+
+    # -- lookups (launch path) ---------------------------------------------
+
+    def lookup(self, pairs: list, forced_pairs: set | None = None):
+        """Unique read keys → ``(slots [U] int32, table_snapshot)``.
+
+        ``slots[i] == -1`` means miss (the caller fills a host lane
+        and may :meth:`admit` the key for future blocks).  The table
+        snapshot and the slot vector are taken atomically under the
+        lock, so a concurrent commit scatter or admission eviction can
+        never remap a returned slot out from under the dispatch —
+        functional arrays keep the snapshot's rows intact forever.
+
+        ``forced_pairs``: keys the caller will route onto host lanes
+        REGARDLESS of residency (the in-flight overlay override set) —
+        they come back −1 and are accounted on the dedicated
+        overlay-forced counter, NOT as hits or misses: a block whose
+        whole read set rides overlay lanes must not report hit_rate
+        1.0 when zero reads were served from the device table (the
+        bench A/B attribution would lie).  Their ranges still touch
+        the LRU when resident — the keys stay hot.
+
+        Touches the LRU for every HIT range (the working set stays
+        pinned while it is actually read)."""
+        U = len(pairs)
+        slots = np.full(U, -1, np.int32)
+        if not self._enabled:
+            return slots, None
+        with self._lock:
+            if not self._enabled:
+                return slots, None
+            get = self._dir.get
+            touched: set[int] = set()
+            hits = 0
+            forced = 0
+            for i, pr in enumerate(pairs):
+                e = get(pr)
+                if forced_pairs is not None and pr in forced_pairs:
+                    forced += 1
+                    if e is not None and e[1] not in touched:
+                        touched.add(e[1])
+                        self._ranges.move_to_end(e[1])
+                    continue  # slot stays −1: host lane by contract
+                if e is not None:
+                    slots[i] = e[0]
+                    hits += 1
+                    if e[1] not in touched:
+                        touched.add(e[1])
+                        self._ranges.move_to_end(e[1])
+            # the table is part of the snapshot even on an all-miss
+            # lookup: the resident dispatch variant needs the operand
+            # regardless, and building it here keeps snapshot+slots
+            # atomic under the one lock
+            table = self._ensure_table()
+            misses = U - hits - forced
+            self._hits_total += hits
+            self._misses_total += misses
+            self._overlay_forced_total += forced
+            if hits or misses:
+                self._recent.append((hits, hits + misses))
+            wh = sum(h for h, _t in self._recent)
+            wt = sum(t for _h, t in self._recent)
+        if hits:
+            self._hits_ctr.add(hits, channel=self.channel)
+        if misses:
+            self._miss_ctr.add(misses, channel=self.channel)
+        if forced:
+            self._forced_ctr.add(forced, channel=self.channel)
+        if wt:
+            self._hit_gauge.set(round(wh / wt, 4), channel=self.channel)
+        return slots, table
+
+    # -- admission + eviction ----------------------------------------------
+
+    def admit(self, pairs: list, present: np.ndarray,
+              vers: np.ndarray) -> int:
+        """Admit missed keys with their host-gathered committed
+        (present, version) values — the miss path's partial range
+        upload.  Absent keys are admitted too (``present`` False →
+        table row 0): cached absence is exactly as load-bearing as a
+        cached version for the MVCC compare.
+
+        Evicts LRU ranges (never ones being admitted by THIS call)
+        when the free pool runs dry; keys that still cannot get a slot
+        are simply skipped — they stay misses.  Returns the bytes
+        scattered to device (h2d accounting)."""
+        if not self._enabled or not pairs:
+            return 0
+        idx: list[int] = []
+        rows: list[tuple] = []
+        with self._lock:
+            if not self._enabled:
+                return 0
+            admitting: set[int] = set()
+            for i, pr in enumerate(pairs):
+                if pr in self._dir:
+                    continue
+                rid = self.range_of(pr[0], pr[1])
+                if not self._free and not self._evict_locked(
+                        protect=admitting | {rid}):
+                    break  # nothing evictable: the rest stay misses
+                if not self._free:
+                    break
+                slot = self._free.pop()
+                self._dir[pr] = (slot, rid)
+                admitting.add(rid)
+                if rid in self._ranges:
+                    self._ranges[rid].append(pr)
+                    self._ranges.move_to_end(rid)
+                else:
+                    self._ranges[rid] = [pr]
+                idx.append(slot)
+                p = bool(present[i])
+                vb, vt = (
+                    _ver_i32(int(vers[i][0]), int(vers[i][1]))
+                    if p else (0, 0)
+                )
+                rows.append((int(p), vb, vt))
+            if not idx:
+                return 0
+            arr_idx = np.asarray(idx, np.int32)
+            arr_rows = np.asarray(rows, np.int32).reshape(-1, 3)
+            try:
+                self._scatter(arr_idx, arr_rows)
+            except Exception as e:
+                self._disable_locked()
+                _log.warning(
+                    "%s: resident admit scatter failed (%s) — cache "
+                    "disabled", self.channel or "validator", e,
+                )
+                return 0
+            nbytes = len(idx) * SLOT_BYTES
+            self._h2d_bytes_total += nbytes
+        self._enabled_gauge.set(1 if self._enabled else 0,
+                                channel=self.channel)
+        return nbytes
+
+    def _evict_locked(self, protect: set) -> bool:
+        """Evict the least-recently-touched range not in ``protect``;
+        caller holds the lock.  Returns True when slots were freed.
+        Evicted rows need no device clear — the directory is
+        authoritative, and slot reuse always scatters the new value
+        before any launch frame can reference it."""
+        for rid in self._ranges:
+            if rid in protect:
+                continue
+            keys = self._ranges.pop(rid)
+            for pr in keys:
+                e = self._dir.pop(pr, None)
+                if e is not None:
+                    self._free.append(e[0])
+            self._evictions_total += 1
+            self._evict_ctr.add(1, channel=self.channel)
+            return True
+        return False
+
+    # -- the commit boundary -----------------------------------------------
+
+    def apply_batch(self, batch) -> int:
+        """Apply one committed block's write-set as a device scatter —
+        the delta the PR-9 merged-overlay machinery already computes.
+        Runs at the pipeline's commit boundary (committer thread, or
+        inline for barriers/serial commits), BEFORE the block leaves
+        the in-flight overlay window — see the module docstring's
+        coherence argument.
+
+        Keys with a slot are updated in place (deletes scatter
+        present=0 — cached absence).  A written key WITHOUT a slot is
+        admitted only when its range is already resident and a slot is
+        free (the value is known, so admission is free); commits never
+        evict — eviction pressure belongs to the read path.  Returns
+        the bytes scattered (h2d accounting).  Idempotent: replaying
+        a batch scatters the same values."""
+        if not self._enabled or batch is None:
+            return 0
+        updates = getattr(batch, "updates", None)
+        if not updates:
+            return 0
+        with self._lock:
+            if not self._enabled:
+                return 0
+            idx: list[int] = []
+            rows: list[tuple] = []
+            for (ns, key), vv in updates.items():
+                pr = (ns, key)
+                e = self._dir.get(pr)
+                if e is None:
+                    rid = self.range_of(ns, key)
+                    if rid not in self._ranges or not self._free:
+                        continue
+                    slot = self._free.pop()
+                    self._dir[pr] = (slot, rid)
+                    self._ranges[rid].append(pr)
+                else:
+                    slot = e[0]
+                if vv.value is None:
+                    rows.append((0, 0, 0))
+                else:
+                    vb, vt = _ver_i32(int(vv.version[0]),
+                                      int(vv.version[1]))
+                    rows.append((1, vb, vt))
+                idx.append(slot)
+            if not idx:
+                return 0
+            try:
+                self._scatter(np.asarray(idx, np.int32),
+                              np.asarray(rows, np.int32))
+            except Exception as e:
+                self._disable_locked()
+                _log.warning(
+                    "%s: resident commit scatter failed (%s) — cache "
+                    "disabled", self.channel or "validator", e,
+                )
+                return 0
+            nbytes = len(idx) * SLOT_BYTES
+            self._h2d_bytes_total += nbytes
+        return nbytes
+
+    def invalidate_keys(self, pairs) -> None:
+        """Drop keys from residency (the invalidation hook FT015
+        polices): a committed-store write that bypasses
+        :meth:`apply_batch` MUST at least invalidate, or a stale
+        resident version silently corrupts MVCC verdicts."""
+        with self._lock:
+            for pr in pairs:
+                e = self._dir.pop(tuple(pr), None)
+                if e is None:
+                    continue
+                slot, rid = e
+                keys = self._ranges.get(rid)
+                if keys is not None:
+                    try:
+                        keys.remove(tuple(pr))
+                    except ValueError:
+                        pass
+                    if not keys:
+                        self._ranges.pop(rid, None)
+                self._free.append(slot)
+
+    def _disable_locked(self) -> None:
+        self._enabled = False
+        self._table = None
+        self._dir.clear()
+        self._ranges.clear()
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self._enabled_gauge.set(0, channel=self.channel)
+
+    # -- accounting --------------------------------------------------------
+
+    def note_upload(self, nbytes: int) -> None:
+        """Count launch-frame bytes (the per-block slot/host-lane
+        pack) toward the h2d total; the validator calls this once per
+        resident block and then observes :meth:`block_bytes`."""
+        with self._lock:
+            self._h2d_bytes_total += int(nbytes)
+
+    def observe_block(self, nbytes: int) -> None:
+        """One block's total state upload (miss fill + slot frame +
+        any admit scatter) → the ``h2d_state_bytes_per_block``
+        histogram."""
+        self._h2d_hist.observe(int(nbytes), channel=self.channel)
+
+    def stats(self) -> dict:
+        """Snapshot for bench extras and tests."""
+        with self._lock:
+            wh = sum(h for h, _t in self._recent)
+            wt = sum(t for _h, t in self._recent)
+            return {
+                "enabled": self._enabled,
+                "capacity_slots": self.capacity,
+                "range_bits": self.range_bits,
+                "resident_keys": len(self._dir),
+                "resident_ranges": len(self._ranges),
+                "hits_total": self._hits_total,
+                "misses_total": self._misses_total,
+                "overlay_forced_total": self._overlay_forced_total,
+                "hit_rate": round(wh / wt, 4) if wt else None,
+                "evictions_total": self._evictions_total,
+                "h2d_bytes_total": self._h2d_bytes_total,
+            }

@@ -1043,3 +1043,118 @@ class TestSignBatchKnob:
         d = pilot.tick()
         assert d is not None and d.knob == "sign_batch_max"
         assert batcher.batch_max == 512
+
+# ---------------------------------------------------------------------------
+# sign_batch_wait_ms: the coalescing-window knob (ISSUE 14 satellite —
+# the ROADMAP PR-13 follow-up: drive wait_ms alongside the batch cap)
+
+
+class TestSignWaitKnob:
+    def test_spec_defaults_and_ladder(self):
+        ks = parse_knob_specs("")
+        assert ks["sign_batch_wait_ms"].ladder() == (
+            0.5, 1.0, 2.0, 4.0, 8.0, 16.0
+        )
+        # operator override reshapes the doubling ladder; the max is
+        # always a reachable rung
+        ks = parse_knob_specs("sign_batch_wait_ms:min=1:max=6")
+        assert ks["sign_batch_wait_ms"].ladder() == (1.0, 2.0, 4.0, 6.0)
+
+    def test_malformed_spec_raises(self):
+        # a 0 floor cannot seed a doubling ladder — operator-grade
+        # error at config load, not a silent dead knob
+        with pytest.raises(KnobSpecError):
+            parse_knob_specs("sign_batch_wait_ms:min=0:max=8")
+        with pytest.raises(KnobSpecError):
+            parse_knob_specs("sign_batch_wait_ms:min=-1")
+
+    def test_down_on_wait_up_on_empty_flushes_dead_band_cooldown(self):
+        clk = Clock()
+        pilot, acts = _pilot(
+            clk, initial={"sign_batch_wait_ms": 2.0},
+        )
+        # no sign lane → no signal → never a decision
+        assert pilot.tick(Signals(clock_s=clk.t)) is None
+        # wait p99 past its band → the linger IS the latency: step DOWN
+        clk.advance(30)
+        d = pilot.tick(Signals(
+            sign_wait_p99_ms=50.0, clock_s=clk.t
+        ))
+        assert (d.knob, d.direction, d.old, d.new) == (
+            "sign_batch_wait_ms", "down", 2.0, 1.0
+        )
+        assert ("sign_batch_wait_ms", 1.0) in acts
+        # cooldown holds under continued pressure
+        clk.advance(1)
+        assert pilot.tick(Signals(
+            sign_wait_p99_ms=50.0, clock_s=clk.t
+        )) is None
+        # dead band: short waits + healthy fill hold steady
+        clk.advance(30)
+        assert pilot.tick(Signals(
+            sign_wait_p99_ms=3.0, sign_fill=0.6, clock_s=clk.t
+        )) is None
+        # flowing lane flushing nearly-empty batches → linger longer
+        d = pilot.tick(Signals(
+            sign_wait_p99_ms=1.0, sign_fill=0.05, clock_s=clk.t
+        ))
+        assert (d.knob, d.direction, d.new) == (
+            "sign_batch_wait_ms", "up", 2.0
+        )
+        # busy pressure outranks the window knob (6b before 6c)
+        clk.advance(30)
+        pilot2, _ = _pilot(clk, initial={
+            "sign_batch_max": 256, "sign_batch_wait_ms": 2.0,
+        })
+        d = pilot2.tick(Signals(
+            sign_busy_rate=0.5, sign_wait_p99_ms=50.0, clock_s=clk.t
+        ))
+        assert d.knob == "sign_batch_max"
+
+    def test_dropped_spec_leaves_knob_structurally_inert(self):
+        """The PeerNode wiring for an operator-configured
+        sign_batch_wait_ms=0 (flush immediately): the knob's spec is
+        DROPPED before the controller is built, so no signal can ever
+        actuate it — the static choice is never silently overridden."""
+        clk = Clock()
+        specs = {k: v for k, v in parse_knob_specs("").items()
+                 if k != "sign_batch_wait_ms"}
+        pilot, acts = _pilot(clk, specs=specs,
+                             initial={"sign_batch_wait_ms": 0.0})
+        assert "sign_batch_wait_ms" not in pilot.values
+        clk.advance(30)
+        assert pilot.tick(Signals(
+            sign_wait_p99_ms=50.0, sign_fill=0.01, clock_s=clk.t
+        )) is None
+        assert acts == []
+
+    def test_fill_signal_to_real_batcher_actuation(self):
+        """read_signals() derives the occupancy-fill fraction from the
+        SignBatcher stats shape and the decision lands on a REAL
+        batcher through set_wait_ms — the PeerNode wiring, minus the
+        network."""
+        from types import SimpleNamespace
+
+        from fabric_tpu.peer.signlane import SignBatcher
+
+        batcher = SignBatcher(lambda d: [(1, 1)] * len(d),
+                              batch_max=256, wait_ms=2.0)
+        clk = Clock(100.0)
+        source = SimpleNamespace(stats=lambda: {
+            "busy_rate": 0.0, "batch_max": 256,
+            "wait_ms": {"n": 9, "p99": 1.0},
+            "occupancy": {"n": 9, "p50": 8, "max": 12},
+        })
+        pilot = Autopilot(
+            None,
+            lambda k, v: (k == "sign_batch_wait_ms"
+                          and batcher.set_wait_ms(float(v))),
+            sign_source=source, clock=clk, registry=Registry(),
+            initial={"sign_batch_wait_ms": 2.0},
+        )
+        s = pilot.read_signals()
+        assert s.sign_fill == 8 / 256
+        d = pilot.tick()
+        assert d is not None and d.knob == "sign_batch_wait_ms"
+        assert d.direction == "up"
+        assert batcher._wait_ms == 4.0

@@ -139,3 +139,29 @@ def test_sign_lane_knobs_flow_and_validate():
                                  "FABTPU_SIGN_BATCH_MAX": "512"}
     )
     assert cfg.sign_device is True and cfg.sign_batch_max == 512
+
+def test_state_resident_knobs_flow_and_validate():
+    """ISSUE 14 knobs (device-resident MVCC state): default OFF (the
+    exact host state_fill path), values flow like every prior knob,
+    bad values are operator-grade ConfigErrors, env overrides work."""
+    cfg = load_peer_config(dict(PEER_MIN))
+    assert cfg.state_resident is False
+    assert cfg.state_resident_mb == 64
+    assert cfg.state_resident_range_bits == 12
+    cfg = load_peer_config({
+        **PEER_MIN, "state_resident": True, "state_resident_mb": 256,
+        "state_resident_range_bits": 16,
+    })
+    assert (cfg.state_resident, cfg.state_resident_mb,
+            cfg.state_resident_range_bits) == (True, 256, 16)
+    with pytest.raises(ConfigError, match="state_resident_mb"):
+        load_peer_config({**PEER_MIN, "state_resident_mb": 0})
+    with pytest.raises(ConfigError, match="state_resident_range_bits"):
+        load_peer_config({**PEER_MIN, "state_resident_range_bits": 0})
+    with pytest.raises(ConfigError, match="state_resident_range_bits"):
+        load_peer_config({**PEER_MIN, "state_resident_range_bits": 25})
+    cfg = load_peer_config(
+        dict(PEER_MIN), environ={"FABTPU_STATE_RESIDENT": "1",
+                                 "FABTPU_STATE_RESIDENT_MB": "8"}
+    )
+    assert cfg.state_resident is True and cfg.state_resident_mb == 8

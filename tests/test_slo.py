@@ -419,3 +419,121 @@ def test_global_configure_attaches_once():
         assert global_tracer()._listeners.count(eng.on_block) == 1
     finally:
         slo_mod.configure("")  # disarm for other tests
+
+# ---------------------------------------------------------------------------
+# endorse-side objectives: the sign lane's SLO feed (ISSUE 14 satellite
+# — the ROADMAP PR-13 follow-up)
+
+
+class TestEndorseObjectives:
+    def test_default_pair_parses(self):
+        from fabric_tpu.observe.slo import DEFAULT_ENDORSE_SLOS
+
+        objs = parse_slos(DEFAULT_ENDORSE_SLOS)
+        assert [(o.name, o.kind, o.channel) for o in objs] == [
+            ("endorse", "latency", "endorse"),
+            ("endorse_busy", "busy", "endorse"),
+        ]
+        assert objs[0].ms > 0
+        # the pair composes with a commit-path spec (distinct names)
+        both = parse_slos(
+            "commit:latency:ms=250;" + DEFAULT_ENDORSE_SLOS
+        )
+        assert len(both) == 3
+
+    def test_observer_classifies_wait_and_busy(self):
+        from fabric_tpu.observe.slo import (
+            DEFAULT_ENDORSE_SLOS, endorse_observer,
+        )
+
+        clk = _Clock()
+        eng = _engine(DEFAULT_ENDORSE_SLOS, clock=clk)
+        obs = endorse_observer(eng)
+        for _ in range(6):
+            obs(2.0, False)       # fast waits: good latency samples
+        obs(80.0, False)          # one slow wait: bad latency
+        obs(None, True)           # one BUSY bounce: bad busy sample
+        burns = eng.burns()
+        lat = burns[("endorse", "endorse")]
+        busy = burns[("endorse_busy", "endorse")]
+        assert lat is not None and lat > 1.0       # 1/7 bad vs 1% budget
+        assert busy is not None and busy > 1.0     # 1/7 bad vs 5% budget
+        # a BUSY bounce is NOT a latency sample (7 latency events, not
+        # 8) while the busy objective sees every admission edge (8)
+        rep = eng.report()
+        by_name = {o["name"]: o for o in rep["objectives"]}
+        assert by_name["endorse"]["channels"]["endorse"]["events"] == 7
+        assert by_name["endorse_busy"]["channels"]["endorse"][
+            "events"] == 8
+        # /slo surface: both objectives report on the endorse channel
+        assert by_name["endorse"]["channels"]["endorse"]["status"] in (
+            "burning", "fast_burn",
+        )
+
+    def test_observer_resolves_objectives_at_call_time(self):
+        from fabric_tpu.observe.slo import (
+            DEFAULT_ENDORSE_SLOS, endorse_observer,
+        )
+
+        clk = _Clock()
+        eng = _engine("", clock=clk)
+        obs = endorse_observer(eng)
+        obs(1.0, False)  # no endorse objectives yet: nothing recorded
+        assert eng.burns() == {}
+        eng.set_objectives(parse_slos(DEFAULT_ENDORSE_SLOS))
+        obs(1.0, False)  # same closure now feeds the rotated set
+        assert ("endorse", "endorse") in eng.burns()
+
+    def test_through_a_real_sign_batcher(self):
+        """The wiring PeerNode.start() performs, minus the node: a
+        real SignBatcher with the observer attached feeds the engine
+        from its flush path (waits) and its admission path (BUSY)."""
+        import threading
+
+        from fabric_tpu.observe.slo import (
+            DEFAULT_ENDORSE_SLOS, endorse_observer,
+        )
+        from fabric_tpu.peer.signlane import SignBatcher, SignBusy
+
+        clk = _Clock()
+        eng = _engine(DEFAULT_ENDORSE_SLOS, clock=clk)
+        gate = threading.Event()
+
+        def backend(digests):
+            gate.wait(timeout=10.0)
+            return [(1, 1)] * len(digests)
+
+        b = SignBatcher(backend, batch_max=2, wait_ms=0.0)
+        b.observer = endorse_observer(eng)
+        b.start()
+        busy = []
+        try:
+            # a request storm against the gated backend: the 2×cap
+            # admission window fills and the overflow bounces BUSY
+            # (the test_signlane overflow shape, observer attached)
+            def worker():
+                try:
+                    b.sign_digest(7)
+                except SignBusy as e:
+                    busy.append(e)
+
+            ts = [threading.Thread(target=worker) for _ in range(10)]
+            for t in ts:
+                t.start()
+            import time as _t
+
+            _t.sleep(0.3)
+            gate.set()
+            for t in ts:
+                t.join(timeout=10.0)
+            assert busy, "expected BUSY bounces"
+        finally:
+            gate.set()
+            b.stop()
+        burns = eng.burns()
+        assert ("endorse", "endorse") in burns       # wait samples fed
+        assert ("endorse_busy", "endorse") in burns  # BUSY event fed
+        rep = eng.report()
+        by_name = {o["name"]: o for o in rep["objectives"]}
+        ch = by_name["endorse_busy"]["channels"]["endorse"]
+        assert ch["bad"] >= 1  # at least the overflow bounce

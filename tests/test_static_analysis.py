@@ -1941,6 +1941,170 @@ class TestNonceReuseHazard:
         assert got == []
 
 
+# -- FT015 resident-state-bypass ---------------------------------------------
+
+BAD_RESIDENT = """\
+from fabric_tpu.state import ResidencyManager, resolve_residency
+
+
+def local_manager_bypass(state, batch):
+    res = ResidencyManager(capacity_mb=1)
+    state.apply_updates(batch, None)
+    return res
+
+
+def via_resolver(state, batch):
+    res = resolve_residency(True, 64, 12)
+    state.apply_updates(batch, None)
+    return res
+
+
+class Committer:
+    def __init__(self, state):
+        self.state = state
+        self.resident = ResidencyManager(capacity_mb=1)
+
+    def commit(self, batch):
+        self.state.apply_updates(batch, None)
+"""
+
+BAD_RESIDENT_ALIAS = """\
+import fabric_tpu.state as st
+
+
+def aliased(state, batch):
+    res = st.ResidencyManager(capacity_mb=1)
+    state.apply_updates(batch, None)
+    return res
+"""
+
+CLEAN_RESIDENT = """\
+from fabric_tpu.state import ResidencyManager
+
+
+def hooked_apply_batch(state, batch):
+    res = ResidencyManager(capacity_mb=1)
+    state.apply_updates(batch, None)
+    res.apply_batch(batch)
+
+
+def hooked_invalidate(state, batch):
+    res = ResidencyManager(capacity_mb=1)
+    state.apply_updates(batch, None)
+    res.invalidate_keys(batch.updates)
+
+
+def hooked_disable(state, batch):
+    res = ResidencyManager(capacity_mb=1)
+    state.apply_updates(batch, None)
+    res.disable("replacing the table")
+
+
+def no_manager_in_scope(state, batch):
+    # apply_updates with no provable manager binding: silent — the
+    # rule polices code that HAS the cache and forgets it
+    state.apply_updates(batch, None)
+
+
+def reassigned_local(state, batch, other):
+    res = ResidencyManager(capacity_mb=1)
+    res = other  # provenance unknown: never counts as a manager
+    state.apply_updates(batch, None)
+
+
+class HookedCommitter:
+    def __init__(self, state):
+        self.state = state
+        self.resident = ResidencyManager(capacity_mb=1)
+
+    def commit(self, batch):
+        self.state.apply_updates(batch, None)
+        self.resident.apply_batch(batch)
+
+    def unrelated(self):
+        return self.state  # no writer here: nothing to flag
+"""
+
+CLEAN_RESIDENT_SHADOW = """\
+def ResidencyManager(x):  # a same-named local helper never matches
+    return x
+
+
+def shadowed(state, batch):
+    res = ResidencyManager(1)
+    state.apply_updates(batch, None)
+"""
+
+
+class TestResidentStateBypass:
+    def test_flags_bypassing_writes(self, tmp_path):
+        from fabric_tpu.analysis.rules.resident_bypass import (
+            ResidentStateBypassRule,
+        )
+
+        got = run_rule(tmp_path, ResidentStateBypassRule(),
+                       {"mod.py": BAD_RESIDENT})
+        assert [(f.rule, f.line) for f in got] == [
+            ("FT015", 6),    # local manager, write, no hook
+            ("FT015", 12),   # via resolve_residency
+            ("FT015", 22),   # class self-attr manager, method write
+        ]
+        assert "stale" in got[0].message.lower() or (
+            "OLD version" in got[0].message
+        )
+
+    def test_flags_module_alias_ctor(self, tmp_path):
+        from fabric_tpu.analysis.rules.resident_bypass import (
+            ResidentStateBypassRule,
+        )
+
+        got = run_rule(tmp_path, ResidentStateBypassRule(),
+                       {"mod.py": BAD_RESIDENT_ALIAS})
+        assert [(f.rule, f.line) for f in got] == [("FT015", 6)]
+
+    def test_clean_shapes_never_flag(self, tmp_path):
+        from fabric_tpu.analysis.rules.resident_bypass import (
+            ResidentStateBypassRule,
+        )
+
+        got = run_rule(tmp_path, ResidentStateBypassRule(), {
+            "mod.py": CLEAN_RESIDENT,
+            "shadow.py": CLEAN_RESIDENT_SHADOW,
+        })
+        assert got == []
+
+    def test_test_code_exempt(self, tmp_path):
+        from fabric_tpu.analysis.rules.resident_bypass import (
+            ResidentStateBypassRule,
+        )
+
+        got = run_rule(tmp_path, ResidentStateBypassRule(), {
+            "test_mod.py": BAD_RESIDENT,
+            "tests/helper.py": BAD_RESIDENT,
+            "conftest.py": BAD_RESIDENT,
+        })
+        assert got == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        from fabric_tpu.analysis.rules.resident_bypass import (
+            ResidentStateBypassRule,
+        )
+
+        src = "\n".join([
+            "from fabric_tpu.state import ResidencyManager",
+            "",
+            "def f(state, batch):",
+            "    res = ResidencyManager(capacity_mb=1)",
+            "    state.apply_updates(batch, None)  "
+            "# fabtpu: noqa(FT015)",
+            "    return res",
+            "",
+        ])
+        got = run_rule(tmp_path, ResidentStateBypassRule(),
+                       {"mod.py": src})
+        assert got == []
+
+
 def test_rule_battery_registered():
     from fabric_tpu.analysis import all_rules
 
@@ -1960,4 +2124,5 @@ def test_rule_battery_registered():
         "FT012": "pvtdata-purge-race",
         "FT013": "metric-label-cardinality",
         "FT014": "nonce-reuse-hazard",
+        "FT015": "resident-state-bypass",
     }
