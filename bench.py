@@ -464,6 +464,36 @@ def _vitals_extras(sampler) -> dict | None:
     }
 
 
+def _ledger_capture():
+    """Arm the process-global launch ledger for the scenario — every
+    bench then ships ``extras.device_ledger`` (per-kernel compile/
+    queue/execute/transfer decomposition, cache hit rates, HBM
+    watermarks) in its JSON line, so BENCH_r06's ``device_wait``
+    arrives pre-decomposed.  Default ON; ``FABTPU_BENCH_LEDGER=0``
+    keeps the ledger-less hot path for overhead measurement."""
+    import os
+
+    if os.environ.get("FABTPU_BENCH_LEDGER", "1") != "1":
+        return None
+    from fabric_tpu.observe import ledger as ledger_mod
+
+    return ledger_mod.configure()
+
+
+def _ledger_extras(led) -> dict | None:
+    """Snapshot the launch ledger for the BENCH_*.json extras,
+    including a ground-truth ``jax.live_arrays()`` HBM sample."""
+    if led is None:
+        return None
+    from fabric_tpu.observe.ledger import live_device_bytes
+
+    out = led.report(rows=8)
+    live = live_device_bytes()
+    if live is not None:
+        out["live_device_bytes"] = live
+    return out
+
+
 def _host_stage_extras(fresh_validator) -> dict | None:
     """host_stage sub-breakdown for the JSON extras: resolved worker
     count, per-shard p50, and the recode location — read off the last
@@ -1849,6 +1879,10 @@ def main():
     # BENCH_*.json extras, turning end-number snapshots into
     # attributed per-stage trajectories (the BENCH_r06 runbook knob)
     vitals = _vitals_capture()
+    # the device-time launch ledger is ON for every scenario (default;
+    # FABTPU_BENCH_LEDGER=0 disarms): extras.device_ledger decomposes
+    # the run's device_wait into compile/queue/execute/transfer
+    led = _ledger_capture()
     result = _BENCHES[name]()
     if name == "block_commit":
         # self-contained round artifact: the headline clean number
@@ -1883,6 +1917,9 @@ def main():
     trails = _vitals_extras(vitals)
     if trails is not None:
         result.setdefault("extras", {})["vitals"] = trails
+    ledger_rep = _ledger_extras(led)
+    if ledger_rep is not None:
+        result.setdefault("extras", {})["device_ledger"] = ledger_rep
     print(json.dumps(result))
 
 

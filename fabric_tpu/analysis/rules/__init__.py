@@ -19,6 +19,8 @@ them); slugs are the human-facing names:
     FT014 nonce-reuse-hazard     random k nonces reaching sign calls
     FT015 resident-state-bypass  store writes skipping the residency
                                  cache's invalidation hook
+    FT016 unattributed-device-sync  device syncs bypassing the launch
+                                 ledger's attribution bracket
 """
 
 from fabric_tpu.analysis.rules import (  # noqa: F401
@@ -35,6 +37,7 @@ from fabric_tpu.analysis.rules import (  # noqa: F401
     resident_bypass,
     retrace_hazard,
     swallowed_exception,
+    unattributed_sync,
     unfinished_span,
     union_env,
 )

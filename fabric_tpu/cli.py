@@ -154,6 +154,7 @@ async def _run_peer(cfg):
         vitals_interval_s=cfg.vitals_interval_s,
         vitals_retention=cfg.vitals_retention,
         blackbox_dir=cfg.blackbox_dir,
+        device_ledger=cfg.device_ledger,
         autopilot=cfg.autopilot,
         autopilot_tick_s=cfg.autopilot_tick_s,
         autopilot_knobs=cfg.autopilot_knobs,
@@ -248,6 +249,13 @@ async def _run_sidecar(args):
 
         ts_mod.configure(interval_s=args.vitals_interval_s,
                          retention=args.vitals_retention)
+    if args.device_ledger:
+        # device-time launch ledger on the sidecar process: every
+        # coalesced cross-tenant dispatch reports compile/queue/
+        # execute/transfer at /launches (default ON, like the peer)
+        from fabric_tpu.observe import ledger as ledger_mod
+
+        ledger_mod.configure()
     ssl_ctx = None
     if args.tls_cert and args.tls_key:
         from fabric_tpu.comm.rpc import make_server_tls
@@ -551,6 +559,11 @@ def main(argv=None):
     c.add_argument("--blackbox-dir", default="",
                    help="directory for black-box incident bundles "
                         "('' = in-memory index only)")
+    c.add_argument("--device-ledger", type=int, default=1,
+                   help="per-launch device-time ledger (1 = on, the "
+                        "default): compile/queue/execute/transfer "
+                        "attribution at /launches on the operations "
+                        "port")
     c.add_argument("--autopilot", action="store_true",
                    help="arm a sidecar-local traffic autopilot "
                         "actuating coalesce/verify_chunk (drain-"

@@ -128,6 +128,10 @@ DEFAULT_BANDS = {
     "queue_lo_ms": 5.0,    # below → coalesce down
     "launch_hi_ms": 250.0,  # launch p99 above → shrink verify_chunk
     "launch_lo_ms": 50.0,   # below → grow it back
+    "devq_hi_ms": 25.0,    # ledger device-queue p99 above → shrink
+                           # verify_chunk (launches queue behind each
+                           # other on the device lane)
+    "devq_lo_ms": 2.0,     # below → grow it back toward monolithic
     "coverage_lo": 0.25,   # overlap coverage below → depth down
     "coverage_hi": 0.85,   # above → depth up
     "prefetch_hi_ms": 150.0,  # prefetch (host parse) p99 above →
@@ -378,6 +382,13 @@ class Signals:
     #: {tenant: BUSY pushback fraction} (scheduler stats)
     busy_rate: dict = field(default_factory=dict)
     launch_p99_ms: float | None = None
+    #: trailing device-lane queue-wait p99 ms off the launch ledger
+    #: (observe/ledger.py) — the honest device-pressure signal: a
+    #: launch that waited behind its predecessor on the device lane,
+    #: measured, not inferred from launch-span p99.  None = no ledger
+    #: armed (or no synced rows in the window): the chunk rule falls
+    #: back to launch_p99_ms.
+    device_queue_p99_ms: float | None = None
     overlap_coverage: float | None = None
     #: trailing prefetch-span (host parse + staging) p99 ms — the
     #: host_stage_workers signal: a feeder slower than its device
@@ -574,6 +585,14 @@ class Autopilot:
             except Exception as e:
                 _log.debug("autopilot: sign signal read failed: %s", e)
         try:
+            from fabric_tpu.observe import ledger as _ledger
+
+            led = _ledger.global_ledger()
+            if led is not None:
+                s.device_queue_p99_ms = led.queue_p99_ms()
+        except Exception as e:
+            _log.debug("autopilot: ledger signal read failed: %s", e)
+        try:
             roots = self.tracer.recent_roots()
         except Exception as e:
             _log.debug("autopilot: tracer signal read failed: %s", e)
@@ -748,28 +767,37 @@ class Autopilot:
                         signal="queue_age_p99_ms", value=age_p99,
                         threshold=b["queue_lo_ms"],
                     )
-        # 4) slow launches: smaller verify chunks
-        if ("verify_chunk" in self.values
-                and s.launch_p99_ms is not None):
-            if (s.launch_p99_ms > b["launch_hi_ms"]
-                    and self._cool("verify_chunk", "", now)):
+        # 4) device pressure: smaller verify chunks.  The launch
+        #    ledger's trailing queue-wait p99 is the HONEST signal
+        #    (launch-span p99 mixes host staging and compile time into
+        #    what it calls device pressure) — when the ledger is armed
+        #    its reading drives this rule; the launch-span p99 stays
+        #    as the ledger-less fallback.
+        if "verify_chunk" in self.values:
+            if s.device_queue_p99_ms is not None:
+                sig, val = "device_queue_p99_ms", s.device_queue_p99_ms
+                hi, lo = b["devq_hi_ms"], b["devq_lo_ms"]
+            else:
+                sig, val = "launch_p99_ms", s.launch_p99_ms
+                hi, lo = b["launch_hi_ms"], b["launch_lo_ms"]
+        else:
+            val = None
+        if "verify_chunk" in self.values and val is not None:
+            if val > hi and self._cool("verify_chunk", "", now):
                 step = self._step("verify_chunk", +1)
                 if step is not None:
                     return Decision(
                         t=now, knob="verify_chunk", direction="up",
                         old=step[0], new=step[1],
-                        signal="launch_p99_ms", value=s.launch_p99_ms,
-                        threshold=b["launch_hi_ms"],
+                        signal=sig, value=val, threshold=hi,
                     )
-            elif (s.launch_p99_ms < b["launch_lo_ms"]
-                    and self._cool("verify_chunk", "", now)):
+            elif val < lo and self._cool("verify_chunk", "", now):
                 step = self._step("verify_chunk", -1)
                 if step is not None:
                     return Decision(
                         t=now, knob="verify_chunk", direction="down",
                         old=step[0], new=step[1],
-                        signal="launch_p99_ms", value=s.launch_p99_ms,
-                        threshold=b["launch_lo_ms"],
+                        signal=sig, value=val, threshold=lo,
                     )
         # 5) wasted window: step pipeline depth down (up on recovery)
         if ("pipeline_depth" in self.values
@@ -1036,6 +1064,7 @@ class Autopilot:
                 ),
                 "busy_rate": dict(sorted(sigs.busy_rate.items())),
                 "launch_p99_ms": sigs.launch_p99_ms,
+                "device_queue_p99_ms": sigs.device_queue_p99_ms,
                 "overlap_coverage": sigs.overlap_coverage,
                 "prefetch_p99_ms": sigs.prefetch_p99_ms,
                 "clock_s": round(sigs.clock_s, 3),

@@ -186,6 +186,17 @@ class PeerConfig:
     # incident recorder alone — bundles then carry trace/SLO/autopilot
     # context but no metric trails.
     blackbox_dir: str = ""
+    # device-time launch ledger (fabric_tpu/observe/ledger.py): wraps
+    # every device dispatch (stage-2 verify/MVCC, the sign-kernel
+    # flush, resident-table scatters, sidecar batches) and decomposes
+    # device_wait into compile / queue / execute / transfer per
+    # launch, with program-cache hit rates and per-owner HBM
+    # watermarks — served at /launches, mirrored as dev:* child spans
+    # in /trace, and read by the autopilot's device_queue_ms signal.
+    # Default ON: an armed ledger is a few perf_counter reads per
+    # launch (no thread); OFF makes every dispatch hook one global
+    # read + None check and registers no instruments.
+    device_ledger: bool = True
     # device-lane degradation (peer/degrade.py DeviceLaneGuard): after
     # device_fail_threshold CONSECUTIVE device-verify failures the
     # validator latches a degraded CPU mode (ops/p256.verify_host +

@@ -1,8 +1,10 @@
 """fabric_tpu.observe — block-commit span tracing (tracer.py), the
 latency/error SLO burn-rate engine (slo.py), the pipeline
-overlap-coverage analyzer (overlap.py), and the flight-data recorder:
+overlap-coverage analyzer (overlap.py), the flight-data recorder:
 metrics time-series trails (timeseries.py) + black-box incident
-bundles (blackbox.py), served at ``/vitals``."""
+bundles (blackbox.py), served at ``/vitals`` — and the per-launch
+device-time ledger (ledger.py) decomposing device_wait into
+compile / queue / execute / transfer, served at ``/launches``."""
 
 from fabric_tpu.observe.overlap import (  # noqa: F401
     coverage_from_roots,

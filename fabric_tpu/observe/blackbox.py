@@ -72,7 +72,11 @@ TRACE_TREES_PER_NS = 4
 #: points of each metric series frozen into a bundle
 SERIES_POINTS = 64
 
-_DROP_ORDER = ("traces", "vitals", "slo", "scheduler", "autopilot")
+_DROP_ORDER = ("traces", "vitals", "launches", "exemplars", "slo",
+               "scheduler", "autopilot")
+
+#: launch-ledger rows frozen into a bundle
+LEDGER_ROWS = 8
 
 
 class BlackBox:
@@ -128,6 +132,7 @@ class BlackBox:
             from fabric_tpu.ops_metrics import global_registry
 
             registry = global_registry()
+        self._registry = registry
         self._bundle_ctr = registry.counter(
             "blackbox_bundles_total",
             "black-box incident bundles recorded by kind",
@@ -160,7 +165,9 @@ class BlackBox:
             from fabric_tpu.observe.slo import global_engine
 
             slo = global_engine()
-        return sampler, tracer, autopilot, slo
+        from fabric_tpu.observe import ledger as _ledger
+
+        return sampler, tracer, autopilot, slo, _ledger.global_ledger()
 
     # -- recording ---------------------------------------------------------
 
@@ -195,7 +202,7 @@ class BlackBox:
 
     def _build(self, kind: str, detail: dict, now: float,
                seq: int) -> dict:
-        sampler, tracer, autopilot, slo = self._sources()
+        sampler, tracer, autopilot, slo, launches = self._sources()
         bundle: dict = {
             "seq": seq,
             "kind": kind,
@@ -221,6 +228,16 @@ class BlackBox:
             })
         if autopilot is not None:
             grab("autopilot", autopilot.report)
+        if launches is not None:
+            # the device-time ledger: per-kernel decomposition + the
+            # last few raw rows — the "was device_wait a compile?"
+            # question answered inside the postmortem itself
+            grab("launches", lambda: launches.report(rows=LEDGER_ROWS))
+        if sampler is not None or launches is not None:
+            from fabric_tpu.ops_metrics import exemplars_report
+
+            grab("exemplars",
+                 lambda: exemplars_report(self._registry) or None)
         if self.scheduler is not None:
             grab("scheduler", self.scheduler.stats)
         if slo is not None and getattr(slo, "objectives", ()):
@@ -303,7 +320,8 @@ class BlackBox:
                 "sections": sorted(
                     k for k in b
                     if k in ("vitals", "traces", "autopilot",
-                             "scheduler", "slo", "faults")
+                             "scheduler", "slo", "faults", "launches",
+                             "exemplars")
                 ),
                 "truncated": b.get("truncated", []),
             })

@@ -244,6 +244,12 @@ class SloEngine:
             # actuation trail — they are control plane, not traffic,
             # and must not dilute any latency series
             return
+        if ns == "sign":
+            # sign-flush roots (peer/signlane.py) exist for the device
+            # ledger's /trace?ns=sign waterfall; the sign lane already
+            # feeds the endorse SLOs per-request through its observer,
+            # so counting flush roots here would double-book them
+            return
         busy = bool(attrs.get("busy"))
         dur_ms = root.dur * 1000.0
         for o in self.objectives:

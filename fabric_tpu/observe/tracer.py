@@ -59,6 +59,15 @@ _WATCHDOG_MIN_SAMPLES = 8
 
 _USE_CURRENT = object()  # sentinel: "parent argument not given"
 
+#: Chrome trace-event color names for the launch ledger's device-lane
+#: spans (observe/ledger.py): compile stalls render visually distinct
+#: from queue waits and execute
+_DEV_SPAN_COLORS = {
+    "dev:compile": "terrible",
+    "dev:queue": "bad",
+    "dev:execute": "good",
+}
+
 
 class Span:
     """One timed region.  ``t0``/``t1`` are ``perf_counter`` seconds;
@@ -255,14 +264,19 @@ class Tracer:
         return _SpanCtx(self, name, parent, attrs)
 
     def add(self, name: str, t0: float, t1: float, parent=_USE_CURRENT,
-            **attrs) -> None:
+            thread: str | None = None, **attrs) -> None:
         """Record an already-measured span [t0, t1] (retro form for
-        code that times stages anyway, e.g. BlockValidator._t)."""
+        code that times stages anyway, e.g. BlockValidator._t).
+        ``thread`` overrides the row name — the launch ledger files
+        its ``dev:*`` spans on a synthetic ``device:<lane>`` row so
+        /trace and the Perfetto export grow a device lane instead of
+        mixing device time into the recording thread's row."""
         if parent is _USE_CURRENT:
             parent = self.current()
         if parent is None:
             return
-        sp = Span(name, t0, threading.current_thread().name, attrs)
+        sp = Span(name, t0,
+                  thread or threading.current_thread().name, attrs)
         sp.t1 = t1
         sp.root = parent.root if parent.root is not None else parent
         parent.children.append(sp)
@@ -428,13 +442,19 @@ class Tracer:
             # the root's block number is the grouping key and always
             # wins — a stitched remote subtree's own ids must not
             # shadow it (its request id rides as args["req"])
-            events.append({
+            ev = {
                 "name": sp.name, "cat": "fabtpu", "ph": "X",
                 "ts": sp.t0 * 1e6,
                 "dur": max(0.0, sp.dur) * 1e6,
                 "pid": p, "tid": row,
                 "args": {**sp.attrs, "block": block},
-            })
+            }
+            # ledger device-lane spans: color-code so a compile stall
+            # reads differently from execute at a glance in Perfetto
+            cname = _DEV_SPAN_COLORS.get(sp.name)
+            if cname is not None:
+                ev["cname"] = cname
+            events.append(ev)
             for n, t, a in sp.events:
                 events.append({
                     "name": n, "cat": "fabtpu", "ph": "i", "s": "t",

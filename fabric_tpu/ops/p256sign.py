@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 
 from fabric_tpu.crypto import ec_ref
+from fabric_tpu.observe import ledger as _ledger
 from fabric_tpu.ops import rns
 from fabric_tpu.ops.p256v3 import (
     MIN_BUCKET,
@@ -115,6 +116,9 @@ def _fb_table() -> np.ndarray:
             )
             base = p_
         _FB = tab
+        # HBM owner tag: the staged comb table pins its bytes on
+        # device for the process lifetime once the kernel captures it
+        _ledger.account_hbm("comb_table", tab.nbytes)
     return tab
 
 
@@ -256,23 +260,32 @@ class SignHandle:
     thread keeps staging while the device walks the comb ladder."""
 
     __slots__ = ("device_out", "n_real", "es", "ds", "k_invs",
-                 "verify_after")
+                 "verify_after", "rec")
 
     def __init__(self, device_out, n_real: int, es, ds, k_invs,
-                 verify_after: bool = False):
+                 verify_after: bool = False, rec=None):
         self.device_out = device_out
         self.n_real = n_real
         self.es = es
         self.ds = ds
         self.k_invs = k_invs
         self.verify_after = verify_after
+        # launch-ledger record (observe/ledger.py): fetch() brackets
+        # the device sync so the ledger can attribute the wait
+        self.rec = rec
 
     def fetch(self) -> list[tuple[int, int]]:
         """→ [(r, s)] low-S normalized, bit-equal to the serial
         RFC 6979 oracle."""
         if not self.n_real:
             return []
-        out = np.asarray(self.device_out)[: self.n_real]
+        rec = self.rec
+        if rec is not None:
+            rec.sync_begin()
+        out = np.asarray(self.device_out)
+        if rec is not None:
+            rec.sync_end(d2h_bytes=out.nbytes)
+        out = out[: self.n_real]
         xs = _rows_to_ints_mod_p(out[:, 0])
         zs = _rows_to_ints_mod_p(out[:, 1])
         # k ∈ [1, n−1] ⇒ R ≠ ∞ ⇒ Z ≢ 0; guard anyway so one corrupt
@@ -380,6 +393,14 @@ def sign_launch(digests, key, ks=None, chunk: int | None = None,
 
     chunk = max(int(chunk), MIN_BUCKET) if chunk else 0
     _lanes_hist().observe(total)
+    # launch-ledger record (observe/ledger.py): the comb-ladder kernel
+    # retraces per (chunk or bucket shape, mesh layout)
+    rec = _ledger.launch(
+        "sign",
+        key=(chunk if (chunk and B0 > chunk) else total,
+             mesh.size if mesh is not None else 0),
+        lanes=B0, h2d_bytes=limbs.nbytes,
+    )
 
     def dispatch(rows):
         with _dev_ann("fabtpu.sign_dispatch"):
@@ -397,8 +418,10 @@ def sign_launch(digests, key, ks=None, chunk: int | None = None,
         dev = dispatch(limbs)
     if hasattr(dev, "copy_to_host_async"):
         dev.copy_to_host_async()
+    if rec is not None:
+        rec.dispatched()
     return SignHandle(dev, B0, digests, ds, k_invs,
-                      verify_after=verify_after)
+                      verify_after=verify_after, rec=rec)
 
 
 def sign_digests(digests, key, **kw) -> list[tuple[int, int]]:

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 
 from fabric_tpu import faults as _faults
 from fabric_tpu.crypto import ec_ref
+from fabric_tpu.observe import ledger as _ledger
 from fabric_tpu.ops import rns
 from fabric_tpu.utils.batching import next_pow2
 
@@ -1037,14 +1038,23 @@ class VerifyHandle:
     signature bits never cross the device boundary on the critical
     path."""
 
-    __slots__ = ("device_out", "n_real")
+    __slots__ = ("device_out", "n_real", "rec")
 
-    def __init__(self, device_out, n_real: int):
+    def __init__(self, device_out, n_real: int, rec=None):
         self.device_out = device_out
         self.n_real = n_real
+        # launch-ledger record (observe/ledger.py): fetch() brackets
+        # the device sync so the ledger can attribute the wait
+        self.rec = rec
 
     def fetch(self) -> list[bool]:
-        return [bool(v) for v in np.asarray(self.device_out)[: self.n_real]]
+        rec = self.rec
+        if rec is not None:
+            rec.sync_begin()
+        out = np.asarray(self.device_out)
+        if rec is not None:
+            rec.sync_end(d2h_bytes=out.nbytes)
+        return [bool(v) for v in out[: self.n_real]]
 
     def __call__(self) -> list[bool]:
         return self.fetch()
@@ -1113,7 +1123,7 @@ def _chunk_bounds(n_real: int, chunk: int) -> list[tuple[int, int, int]]:
 
 
 def _launch_chunked(n_real: int, chunk: int, stage_fn,
-                    dispatch_fn=None, pool=None) -> VerifyHandle:
+                    dispatch_fn=None, pool=None, rec=None) -> VerifyHandle:
     """Microbatched double-buffered dispatch.
 
     Legacy form (``dispatch_fn`` None): ``stage_fn(lo, hi, pad)``
@@ -1162,7 +1172,9 @@ def _launch_chunked(n_real: int, chunk: int, stage_fn,
     dev = jnp.concatenate(outs) if len(outs) > 1 else outs[0]
     if hasattr(dev, "copy_to_host_async"):
         dev.copy_to_host_async()
-    return VerifyHandle(dev, n_real)
+    if rec is not None:
+        rec.dispatched()
+    return VerifyHandle(dev, n_real, rec)
 
 
 def _stage_packed(cols, lo, hi, pad, pool, recode_device) -> np.ndarray:
@@ -1182,7 +1194,8 @@ def _stage_packed(cols, lo, hi, pad, pool, recode_device) -> np.ndarray:
                                recode_device=recode_device)
 
 
-def _launch_cols(n_real, cols, chunk, mesh, pool, recode_device):
+def _launch_cols(n_real, cols, chunk, mesh, pool, recode_device,
+                 rec=None):
     """Column-form launch: stage straight into the packed wire frame
     (single-pass serial path, or slab-sharded over the host pool),
     dispatch (sharded), with the H2D frame size observed per
@@ -1192,6 +1205,12 @@ def _launch_cols(n_real, cols, chunk, mesh, pool, recode_device):
 
     def dispatch(packed):
         _h2d_hist().observe(packed.nbytes, recode=rc)
+        if rec is not None:
+            rec.note_h2d(packed.nbytes)
+            # re-anchor at the FIRST actual dispatch: the host
+            # wire-frame staging above must not be booked as compile
+            # (miss) or dispatch overhead (hit)
+            rec.begin_dispatch()
         # the TraceAnnotation lines this dispatch up with the XLA
         # timeline when a jax profiler capture runs (real-TPU rounds)
         with _dev_ann("fabtpu.verify_dispatch"):
@@ -1212,13 +1231,15 @@ def _launch_cols(n_real, cols, chunk, mesh, pool, recode_device):
             return _stage_packed(cols, lo, hi, pad, inner, recode_device)
 
         return _launch_chunked(n_real, chunk, stage, dispatch_fn=dispatch,
-                               pool=pool)
+                               pool=pool, rec=rec)
     packed = _stage_packed(cols, 0, n_real, _bucket(n_real), pool,
                            recode_device)
     out = dispatch(packed)
     if hasattr(out, "copy_to_host_async"):
         out.copy_to_host_async()
-    return VerifyHandle(out, n_real)
+    if rec is not None:
+        rec.dispatched()
+    return VerifyHandle(out, n_real, rec)
 
 
 def verify_launch(items, chunk: int | None = None, mesh=None, pool=None,
@@ -1263,25 +1284,33 @@ def verify_launch(items, chunk: int | None = None, mesh=None, pool=None,
         n_real = items.n
         cols = (items.assemble() if isinstance(items, ColumnarSigBatch)
                 else _assemble_cols(items))
-        return _launch_cols(n_real, cols, chunk, mesh, pool, recode_device)
+        return _launch_cols(n_real, cols, chunk, mesh, pool,
+                            recode_device, rec=_verify_rec(n_real, chunk,
+                                                           mesh,
+                                                           recode_device))
     items = list(items)
     if not items:
         return VerifyHandle(jnp.zeros((0,), bool), 0)
     n_real = len(items)
+    rec = _verify_rec(n_real, chunk, mesh, recode_device)
     if pool is not None or recode_device:
         # pooled staging and device recoding are COLUMN lanes: lift
         # legacy tuples into the column form (accept-set equal — the
         # chunked/coalesced differentials already pin this route)
         n_real, cols = _to_cols(items)
-        return _launch_cols(n_real, cols, chunk, mesh, pool, recode_device)
+        return _launch_cols(n_real, cols, chunk, mesh, pool,
+                            recode_device, rec=rec)
     if chunk and n_real > chunk:
         def stage(lo, hi, pad):
             return verify_batch_jit(
                 *(_shard(mesh, a) for a in prepare(items[lo:hi], pad_to=pad))
             )
 
-        return _launch_chunked(n_real, chunk, stage)
+        return _launch_chunked(n_real, chunk, stage, rec=rec)
     args = prepare(items, pad_to=_bucket(n_real))
+    if rec is not None:
+        rec.note_h2d(sum(a.nbytes for a in args))
+        rec.begin_dispatch()  # prepare() above was host staging
     if mesh is not None:
         args = tuple(_shard(mesh, a) for a in args)
     with _dev_ann("fabtpu.verify_dispatch"):
@@ -1291,7 +1320,24 @@ def verify_launch(items, chunk: int | None = None, mesh=None, pool=None,
         # readback latency is substantial on tunneled devices and must
         # overlap the caller's host work, not serialize behind it
         out.copy_to_host_async()
-    return VerifyHandle(out, n_real)
+    if rec is not None:
+        rec.dispatched()
+    return VerifyHandle(out, n_real, rec)
+
+
+def _verify_rec(n_real: int, chunk: int, mesh, recode_device: bool):
+    """Open a launch-ledger record for one verify dispatch (None when
+    the ledger is disarmed — a single global read + None check).  The
+    structural key drives the ledger's first-seen compile inference:
+    the jitted kernel retraces per (padded bucket or chunk shape,
+    recode variant, mesh layout)."""
+    shape = chunk if (chunk and n_real > chunk) else _bucket(n_real)
+    return _ledger.launch(
+        "verify",
+        key=(shape, bool(recode_device),
+             mesh.size if mesh is not None else 0),
+        lanes=n_real,
+    )
 
 
 def _to_cols(items):
@@ -1376,13 +1422,23 @@ def verify_launch_many(batches, chunk: int | None = None,
     # all `grand` lanes are "real" to the chunker (padding lanes are
     # pre-rejected rows); its tail invariant pads to
     # _bucket(grand) == grand
-    dev = _launch_cols(grand, tuple(cat), chunk, mesh, pool,
-                       recode_device).device_out
-    return [
+    inner = _launch_cols(grand, tuple(cat), chunk, mesh, pool,
+                         recode_device,
+                         rec=_verify_rec(grand, chunk, mesh,
+                                         recode_device))
+    dev = inner.device_out
+    out = [
         VerifyHandle(dev[off:off + _bucket(n)], n) if n
         else VerifyHandle(jnp.zeros((0,), bool), 0)
         for off, n in zip(offs, sizes)
     ]
+    # ONE ledger record covers the coalesced dispatch: the first live
+    # block's fetch closes it (slices sync the shared computation)
+    for h in out:
+        if h.n_real:
+            h.rec = inner.rec
+            break
+    return out
 
 
 def _batch_len(items) -> int:

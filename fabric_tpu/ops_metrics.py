@@ -17,6 +17,7 @@ from __future__ import annotations
 import math
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 
@@ -86,13 +87,20 @@ class _Hist:
 
 class Histogram:
     def __init__(self, name: str, help_: str, registry: "Registry",
-                 buckets=_DEFAULT_BUCKETS):
+                 buckets=_DEFAULT_BUCKETS, exemplars: int = 0):
         self.name, self.help = name, help_
         self.buckets = tuple(buckets)
         self._values: dict[tuple, _Hist] = {}
         self._lock = registry._lock
+        # trace exemplars: a bounded last-K ring of (value, trace ref)
+        # per label variant, recorded when the observer passes an
+        # ``exemplar=`` ref — so a p99 spike on /vitals links to the
+        # exact block's trace tree.  0 (the default) keeps observe()
+        # byte-for-byte on today's path.
+        self.exemplar_k = int(exemplars)
+        self._exemplars: dict[tuple, deque] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, *, exemplar=None, **labels) -> None:
         k = _label_key(labels)
         with self._lock:
             h = self._values.get(k)
@@ -103,6 +111,13 @@ class Histogram:
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     h.counts[i] += 1
+            if self.exemplar_k and exemplar is not None:
+                ring = self._exemplars.get(k)
+                if ring is None:
+                    ring = self._exemplars[k] = deque(
+                        maxlen=self.exemplar_k
+                    )
+                ring.append((value, str(exemplar)))
 
     def value(self, **labels) -> dict | None:
         """Locked read of ONE label variant: {"counts" (cumulative per
@@ -123,6 +138,12 @@ class Histogram:
                     "count": h.n}
                 for k, h in self._values.items()
             }
+
+    def exemplar_snapshot(self) -> dict[tuple, list]:
+        """Locked copy of every variant's exemplar ring: {label key:
+        [(value, trace ref), ...]} — empty when exemplars are unarmed."""
+        with self._lock:
+            return {k: list(r) for k, r in self._exemplars.items() if r}
 
     def time(self, **labels):
         """Context manager observing elapsed seconds."""
@@ -154,11 +175,14 @@ class Registry:
         return self._get(name, help_, Gauge)
 
     def histogram(self, name: str, help_: str = "",
-                  buckets=None) -> Histogram:
-        """``buckets`` applies on FIRST registration only (a metric's
-        bucket layout is fixed for its lifetime); later callers get
-        the existing instrument regardless."""
-        kwargs = {} if buckets is None else {"buckets": buckets}
+                  buckets=None, exemplars: int | None = None) -> Histogram:
+        """``buckets``/``exemplars`` apply on FIRST registration only
+        (a metric's bucket layout and exemplar capacity are fixed for
+        its lifetime); later callers get the existing instrument
+        regardless."""
+        kwargs: dict = {} if buckets is None else {"buckets": buckets}
+        if exemplars is not None:
+            kwargs["exemplars"] = exemplars
         return self._get(name, help_, Histogram, **kwargs)
 
     def _get(self, name, help_, cls, **kwargs):
@@ -225,6 +249,30 @@ class Registry:
                     out.append(f"{name}_sum{self._fmt_labels(k)} {h['sum']}")
                     out.append(f"{name}_count{self._fmt_labels(k)} {h['count']}")
         return "\n".join(out) + "\n"
+
+
+def exemplars_report(registry: "Registry",
+                     metric: str | None = None) -> dict:
+    """{metric: {label_str: [[value, trace_ref], ...]}} over every
+    histogram with a non-empty exemplar ring — the /vitals and
+    black-box-bundle surface.  Bounded by construction (each ring is
+    last-K)."""
+    out: dict = {}
+    for name, m in registry.metrics():
+        if metric is not None and name != metric:
+            continue
+        if not isinstance(m, Histogram) or not m.exemplar_k:
+            continue
+        snap = m.exemplar_snapshot()
+        if not snap:
+            continue
+        out[name] = {
+            (",".join(f"{k}={v}" for k, v in key) or "_"): [
+                [round(v, 9), ref] for v, ref in ring
+            ]
+            for key, ring in sorted(snap.items())
+        }
+    return out
 
 
 _global = Registry()

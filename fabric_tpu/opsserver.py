@@ -1,4 +1,5 @@
-"""Operations HTTP server: /metrics, /healthz, /logspec, /version.
+"""Operations HTTP server: /metrics, /healthz, /logspec, /version,
+/trace, /slo, /autopilot, /vitals, /launches, /debug.
 
 Reference: core/operations/system.go:89-209 — every peer and orderer
 process runs one (internal/peer/node/start.go:232-241,
@@ -48,7 +49,7 @@ class OperationsServer:
                  registry: Registry | None = None,
                  health: HealthRegistry | None = None,
                  tracer=None, slo=None, autopilot=None,
-                 vitals=None, blackbox=None):
+                 vitals=None, blackbox=None, launches=None):
         self.host, self.port = host, port
         self.registry = registry or global_registry()
         self.health = health or HealthRegistry()
@@ -71,6 +72,9 @@ class OperationsServer:
         # process-global resolution, like /autopilot)
         self.vitals = vitals
         self.blackbox = blackbox
+        # /launches: the device-time launch ledger (None = lazy
+        # process-global resolution, like /autopilot and /vitals)
+        self.launches = launches
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self):
@@ -182,6 +186,8 @@ class OperationsServer:
             ).encode()
         if path == "/vitals" or path.startswith("/vitals?"):
             return self._route_vitals(path)
+        if path == "/launches" or path.startswith("/launches?"):
+            return self._route_launches(path)
         if path.startswith("/debug/"):
             return self._route_debug(path)
         return 404, "application/json", b'{"error": "not found"}'
@@ -305,13 +311,75 @@ class OperationsServer:
                 return 404, "application/json", json.dumps(
                     {"error": f"no recorded series for metric {name!r}"}
                 ).encode()
-            return 200, "application/json", json.dumps(
-                {"metric": name, "series": series[name]}
-            ).encode()
+            variants = series[name]
+            label = q.get("label", [None])[0]
+            if label is not None:
+                # one metric with many label variants used to return
+                # every ring; ?label=k=v keeps only the variants that
+                # carry that exact pair (or the full label string)
+                variants = {
+                    ls: v for ls, v in variants.items()
+                    if ls == label or label in ls.split(",")
+                }
+                if not variants:
+                    return 404, "application/json", json.dumps(
+                        {"error": f"no series for metric {name!r} with "
+                                  f"label {label!r}"}
+                    ).encode()
+            payload = {"metric": name, "series": variants}
+            # trace exemplars (ops_metrics histograms): a p99 spike in
+            # the trail links to the exact block's trace tree
+            from fabric_tpu.ops_metrics import exemplars_report
+
+            ex = exemplars_report(self.registry, metric=name).get(name)
+            if ex:
+                if label is not None:
+                    ex = {
+                        ls: v for ls, v in ex.items()
+                        if ls == label or label in ls.split(",")
+                    }
+                if ex:
+                    payload["exemplars"] = ex
+            return 200, "application/json", json.dumps(payload).encode()
         payload: dict = {"enabled": sampler is not None}
         if sampler is not None:
             payload.update(sampler.report())
         payload["incidents"] = bb.bundles() if bb is not None else []
+        return 200, "application/json", json.dumps(payload).encode()
+
+    def _route_launches(self, path: str):
+        """Device-time attribution surface (fabric_tpu.observe.ledger):
+        per-kernel compile/queue/execute percentiles, program-cache
+        hit rates, HBM owner watermarks + a live ``jax.live_arrays()``
+        sample, and the last-N raw launch rows.  ``?n=K`` bounds the
+        rows, ``?kernel=NAME`` filters them.  Unarmed answers
+        honestly: enabled false, no rows."""
+        from urllib.parse import parse_qs, urlparse
+
+        led = self.launches
+        if led is None:
+            from fabric_tpu.observe import ledger as _ledger
+
+            led = _ledger.global_ledger()
+        if led is None:
+            return 200, "application/json", json.dumps(
+                {"enabled": False}
+            ).encode()
+        q = parse_qs(urlparse(path).query)
+        try:
+            # <= 0 means no raw rows (rows() pins this — a raw slice
+            # would invert the bound via rows[-0:])
+            n = int(q.get("n", ["16"])[0])
+        except ValueError:
+            return 400, "application/json", b'{"error": "bad n"}'
+        kernel = q.get("kernel", [None])[0]
+        payload = {"enabled": True,
+                   **led.report(rows=n, kernel=kernel)}
+        from fabric_tpu.observe.ledger import live_device_bytes
+
+        live = live_device_bytes()
+        if live is not None:
+            payload["live_device_bytes"] = live
         return 200, "application/json", json.dumps(payload).encode()
 
     def _route_debug(self, path: str):

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from fabric_tpu.crypto import policy as pol
+from fabric_tpu.observe import ledger as _ledger
 from fabric_tpu.ops import mvcc as mvcc_ops
 
 
@@ -266,12 +267,32 @@ class DeviceBlockPipeline:
                              int(table_dev.shape[0]))
         key = (t_bucket, n_sig, gsigs, static_dims, resident_dims)
         fn = self._cache.get(key)
-        if fn is None:
+        compiled = fn is None
+        if compiled:
             fn = self._cache[key] = build_stage2(
                 t_bucket, n_sig, gsigs, static_dims,
                 resident_dims=resident_dims,
             )
             self._cache_gauge.set(len(self._cache))
+        # launch ledger (observe/ledger.py): the program-cache verdict
+        # is EXACT here — this class owns the cache.  The launch-time
+        # H2D is the packed launch vector (+ the resident slot frame);
+        # groups/static uploaded from the prefetch thread already.
+        h2d = launch_vec.nbytes
+        if resident is not None:
+            h2d += resident[1].nbytes
+        rec = _ledger.launch("stage2", compiled=compiled,
+                             lanes=t_bucket, h2d_bytes=h2d)
+        # the fused path never calls the verify handle's fetch (the
+        # signature vector stays on device as a stage-2 operand), so
+        # its ledger record would never close: complete it
+        # enqueue-only here — its compile/dispatch/h2d stand, and the
+        # fused chain's device time is owned by THIS record's sync
+        # (splitting verify execute out of one fused dependency chain
+        # is not host-observable, so the ledger does not pretend to)
+        vrec = getattr(handle, "rec", None)
+        if vrec is not None:
+            vrec.complete()
         t0 = time.perf_counter()
         from fabric_tpu.parallel.mesh import shard_batch
 
@@ -287,16 +308,33 @@ class DeviceBlockPipeline:
                      shard_batch(mesh, read_pv_dev)]
         from fabric_tpu.observe import device_annotation
 
+        if rec is not None:
+            # transient HBM pin: this block's launch frames (verify
+            # output + packed operands) pinned on device until the
+            # fetch — ADDITIVE, so depth-N concurrent blocks sum and
+            # the watermark records the true concurrent peak; released
+            # when the record completes
+            rec.pin_hbm("launch_frames", sum(
+                int(getattr(a, "nbytes", 0)) for a in args
+            ))
         # lines the fused stage-2 dispatch up with the XLA timeline
         # when a jax profiler capture is running (real-TPU rounds)
         with device_annotation("fabtpu.stage2_dispatch"):
             packed = fn(*args)
         if hasattr(packed, "copy_to_host_async"):
             packed.copy_to_host_async()
+        if rec is not None:
+            rec.dispatched()
+            rec.pin_hbm("outputs", int(getattr(packed, "nbytes", 0)))
         self._dispatch_hist.observe(time.perf_counter() - t0)
 
         def fetch():
-            flat = np.asarray(packed).astype(bool)
+            if rec is not None:
+                rec.sync_begin()
+            flat = np.asarray(packed)
+            if rec is not None:
+                rec.sync_end(d2h_bytes=flat.nbytes)
+            flat = flat.astype(bool)
             T = t_bucket
             out = {
                 "valid": flat[0:T],

@@ -1105,6 +1105,7 @@ class PeerNode:
                  vitals_interval_s: float = 0.0,
                  vitals_retention: int = 240,
                  blackbox_dir: str = "",
+                 device_ledger: bool = True,
                  autopilot: bool = False,
                  autopilot_tick_s: float = 1.0,
                  autopilot_knobs: str = "",
@@ -1156,6 +1157,11 @@ class PeerNode:
         self.blackbox_dir = blackbox_dir
         self.vitals = None
         self.blackbox = None
+        # device-time launch ledger (nodeconfig ``device_ledger``,
+        # default ON): armed refcounted at start() like the recorder —
+        # colocated nodes share one ledger, the last release disarms
+        self.device_ledger = bool(device_ledger)
+        self.launch_ledger = None
         # traffic autopilot (nodeconfig ``autopilot`` / ``autopilot_
         # tick_s`` / ``autopilot_knobs``): built and started at
         # start() — OFF by default, so tier-1/CPU hosts never even
@@ -1596,6 +1602,14 @@ class PeerNode:
                 scheduler=(self.sidecar_server.scheduler
                            if self.sidecar_server is not None else None),
             )
+        if self.device_ledger:
+            # device-time launch ledger: per-launch compile/queue/
+            # execute/transfer attribution, /launches, dev:* trace
+            # lanes, the autopilot's device_queue_ms signal.  Same
+            # refcounted sharing story as the recorder above.
+            from fabric_tpu.observe import ledger as _ledgermod
+
+            self.launch_ledger = _ledgermod.acquire()
         self.operations = None
         if operations_port is not None:
             from fabric_tpu.opsserver import HealthRegistry, OperationsServer
@@ -1639,7 +1653,7 @@ class PeerNode:
             self.operations = await OperationsServer(
                 port=operations_port, health=health,
                 autopilot=self.autopilot_ctl, vitals=self.vitals,
-                blackbox=self.blackbox,
+                blackbox=self.blackbox, launches=self.launch_ledger,
             ).start()
         return self
 
@@ -1669,6 +1683,11 @@ class PeerNode:
 
             _blackbox.release()
             self.blackbox = None
+        if self.launch_ledger is not None:
+            from fabric_tpu.observe import ledger as _ledgermod
+
+            _ledgermod.release()
+            self.launch_ledger = None
         if self.autopilot_ctl is not None:
             # disable BEFORE stopping so /autopilot (and the gauge)
             # never reads a dead control loop as live, and release the

@@ -676,7 +676,7 @@ class CommitPipeline:
                 self._note_stage_failure("prefetch", block.header.number)
                 raise
             try:
-                with self.tracer.span("launch", parent=root):
+                with self.tracer.span("launch", parent=root) as lsp:
                     _faults.fire("pipeline.launch")
                     if self.pre_launch_fn is not None:
                         self.pre_launch_fn(block)
@@ -687,6 +687,10 @@ class CommitPipeline:
                         extra_txids=extra,
                     )
                     self._launch_s = time.perf_counter() - t0
+                    self.tracer.set_attrs(
+                        lsp, device=getattr(pend, "fetch2", None)
+                        is not None,
+                    )
             except BaseException:
                 self._note_stage_failure("launch", block.header.number)
                 raise
@@ -849,7 +853,7 @@ class CommitPipeline:
         t1 = time.perf_counter()
         self.tracer.add("prefetch_wait", t0, t1, parent=root)
         try:
-            with self.tracer.span("launch", parent=root):
+            with self.tracer.span("launch", parent=root) as lsp:
                 _faults.fire("pipeline.launch")
                 if self.pre_launch_fn is not None:
                     # caller thread, AFTER any predecessor barrier
@@ -860,6 +864,13 @@ class CommitPipeline:
                 self._launched = self.validator.validate_launch(
                     block, pre=pre, overlay=overlay,
                     extra_txids=extra,
+                )
+                # attribution aid for /trace + the device ledger's
+                # exemplars: a block silently riding the host path
+                # (no fused stage-2 dispatch) must be visible
+                self.tracer.set_attrs(
+                    lsp, device=getattr(self._launched, "fetch2", None)
+                    is not None,
                 )
         except BaseException:
             self._note_stage_failure("launch", block.header.number)

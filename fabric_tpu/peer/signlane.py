@@ -161,6 +161,9 @@ class SignBatcher:
         self._signed_total = 0
         self._busy_total = 0
         self._batches_total = 0
+        # flush sequence for the ns="sign" trace roots (flusher-thread
+        # private — no lock needed)
+        self._flush_seq = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -304,6 +307,18 @@ class SignBatcher:
             self._wait_h.observe(w)
             self._observe(w * 1000.0, False)
         self._lanes_h.observe(len(batch))
+        # one trace root per flush in the "sign" flight-recorder ring:
+        # the device ledger's dev:* child spans (and its histogram
+        # exemplars) need a tree to attach to on the flusher thread,
+        # and /trace?ns=sign gets the sign lane's own waterfall.  A
+        # disabled tracer makes every call below a no-op.
+        from fabric_tpu.observe import global_tracer
+
+        tr = global_tracer()
+        self._flush_seq += 1
+        root = tr.begin_block(self._flush_seq, ns="sign",
+                              lanes=len(batch))
+        tok = tr.attach(root) if root is not None else None
         try:
             sigs = self.sign_many([p.digest for p in batch])
             if len(sigs) != len(batch):
@@ -316,6 +331,10 @@ class SignBatcher:
                 p.error = e
                 p.event.set()
             return
+        finally:
+            if root is not None:
+                tr.detach(tok)
+                tr.finish_block(root)
         self._backend_h.observe(self.clock() - t0)
         with self._cond:
             self._batches_total += 1

@@ -1760,9 +1760,15 @@ class BlockValidator:
 
     def _put_group(self, gp):
         """Upload one policy-group pack (prefetch thread), axis-0
-        sharded over the validator's mesh when one is configured."""
+        sharded over the validator's mesh when one is configured.
+        The bytes count on the launch ledger's ``stage2_prefetch``
+        h2d lane — prefetch-thread uploads are device transfer time
+        the launch-time accounting would otherwise miss."""
         import jax.numpy as jnp
 
+        from fabric_tpu.observe import ledger as _ledger
+
+        _ledger.note_h2d("stage2_prefetch", gp.nbytes)
         if self.mesh is None:
             return jnp.asarray(gp)
         from fabric_tpu.parallel.mesh import shard_batch

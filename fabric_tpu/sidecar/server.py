@@ -396,8 +396,8 @@ class SidecarServer:
                 t0 = self.tracer.clock()
                 try:
                     verdicts = await loop.run_in_executor(
-                        self._device, self._verify_batch,
-                        [r.items for r in batch],
+                        self._device, self._dispatch_traced,
+                        [r.items for r in batch], batch[0].root,
                     )
                     t1 = self.tracer.clock()
                     await self._answer(batch, verdicts, t0, t1)
@@ -429,6 +429,20 @@ class SidecarServer:
                             self._req_ctr.add(1, tenant=req.tenant,
                                               status="dropped")
                             self.tracer.finish_block(req.root)
+
+    def _dispatch_traced(self, itemsets: list, root) -> list:
+        """Executor-thread shim: adopt the coalesced group's LEADER
+        request tree as the thread-current span for the device verify,
+        so the launch ledger's ``dev:*`` child spans (and its
+        histogram exemplars) attach to the request the dispatch was
+        built for — the sidecar's /trace?ns=sidecar waterfall then
+        carries the device lane too."""
+        tok = self.tracer.attach(root) if root is not None else None
+        try:
+            return self._verify_batch(itemsets)
+        finally:
+            if root is not None:
+                self.tracer.detach(tok)
 
     def _verify_batch(self, itemsets: list) -> list:
         _faults.fire("sidecar.dispatch", n=len(itemsets))

@@ -325,6 +325,12 @@ class ResidencyManager:
             self._table = shard_state_table(
                 self.mesh, jnp.zeros((self.capacity, 3), jnp.int32)
             )
+            # HBM owner tag (observe/ledger.py): the resident table
+            # pins capacity*12 bytes of device memory once built
+            from fabric_tpu.observe import ledger as _ledger
+
+            _ledger.account_hbm("resident_table",
+                                self.capacity * SLOT_BYTES)
         return self._table
 
     def _scatter(self, idx: np.ndarray, rows: np.ndarray) -> None:
@@ -344,12 +350,22 @@ class ResidencyManager:
         pidx[:k] = idx
         prows[:k] = rows
         fn = self._scatter_fns.get(bucket)
-        if fn is None:
+        compiled = fn is None
+        if compiled:
             fn = self._scatter_fns[bucket] = jax.jit(
                 lambda t, i, r: t.at[i].set(r)
             )
+        # launch ledger: scatters are enqueue-only (functional update,
+        # never awaited) — the row records compile + h2d, not execute
+        from fabric_tpu.observe import ledger as _ledger
+
+        rec = _ledger.launch("resident_scatter", compiled=compiled,
+                             lanes=k,
+                             h2d_bytes=pidx.nbytes + prows.nbytes)
         self._table = fn(self._ensure_table(), jnp.asarray(pidx),
                          jnp.asarray(prows))
+        if rec is not None:
+            rec.complete()
 
     # -- lookups (launch path) ---------------------------------------------
 
@@ -609,8 +625,12 @@ class ResidencyManager:
     def observe_block(self, nbytes: int) -> None:
         """One block's total state upload (miss fill + slot frame +
         any admit scatter) → the ``h2d_state_bytes_per_block``
-        histogram."""
+        histogram, folded into the launch ledger's per-kernel h2d
+        accounting too (the ``state`` lane on /launches)."""
         self._h2d_hist.observe(int(nbytes), channel=self.channel)
+        from fabric_tpu.observe import ledger as _ledger
+
+        _ledger.note_h2d("state", nbytes)
 
     def stats(self) -> dict:
         """Snapshot for bench extras and tests."""

@@ -14,6 +14,8 @@ Output, in postmortem reading order:
   p99s) so the minutes BEFORE the incident are visible,
 * the autopilot decision log as a timeline relative to the incident,
 * the SLO burn snapshot and scheduler per-tenant rows,
+* the device ledger (per-kernel compile/queue/execute decomposition,
+  cache hit rates, HBM watermarks, the last raw launch rows),
 * the fault-injection stats (what the chaos plan actually did), and
 * the captured trace trees, rendered through scripts/traceview.py's
   waterfall.
@@ -191,6 +193,48 @@ def render_faults(stats: dict) -> list[str]:
     return lines
 
 
+def render_launches(led: dict) -> list[str]:
+    """The device-time ledger section: per-kernel compile/queue/
+    execute decomposition + cache hit rates, HBM owner watermarks,
+    and the last raw launch rows — "was device_wait a compile?"
+    answered inside the postmortem."""
+    lines = ["", "-- device ledger " + "-" * 53]
+    for name, k in sorted((led.get("kernels") or {}).items()):
+        parts = [f"  {name:<16} launches={k.get('launches', 0):<5}"
+                 f"hit_rate={_fmt(k.get('cache_hit_rate', 0))}"]
+        for stage in ("compile_ms", "queue_ms", "execute_ms"):
+            p = k.get(stage)
+            if p:
+                parts.append(
+                    f"{stage[:-3]} p50={_fmt(p['p50'])}"
+                    f"/p99={_fmt(p['p99'])}ms"
+                )
+        lines.append("  ".join(parts))
+    hbm = led.get("hbm") or {}
+    if hbm:
+        lines.append("  [hbm watermarks]")
+        for owner, row in sorted(hbm.items()):
+            lines.append(
+                "    %-16s current=%-12d watermark=%d" % (
+                    owner, row.get("current_bytes", 0),
+                    row.get("watermark_bytes", 0),
+                )
+            )
+    recent = led.get("recent") or []
+    if recent:
+        lines.append("  [last launches]")
+        for r in recent:
+            lines.append(
+                "    %-12s %-5s compile=%-8s queue=%-8s "
+                "execute=%-8s block=%s" % (
+                    r.get("kernel"), r.get("cache"),
+                    _fmt(r.get("compile_ms")), _fmt(r.get("queue_ms")),
+                    _fmt(r.get("execute_ms")), r.get("block", "-"),
+                )
+            )
+    return lines
+
+
 def render_traces(traces: dict) -> list[str]:
     import os
 
@@ -225,6 +269,8 @@ def render_bundle(b: dict, series_limit: int | None = 24,
         lines += render_slo(b["slo"])
     if "scheduler" in b:
         lines += render_scheduler(b["scheduler"])
+    if "launches" in b:
+        lines += render_launches(b["launches"])
     if "faults" in b:
         lines += render_faults(b["faults"])
     if traces and "traces" in b:

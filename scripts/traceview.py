@@ -18,6 +18,11 @@ thread/worker that ran it — the overlap question ("did prefetch(k+1)
 run while commit(k) fsynced?") is answered by bars on different
 thread rows sharing a time range across consecutive blocks.
 
+The launch ledger's device-lane child spans (observe/ledger.py) ride
+``device:<lane>`` rows with distinct bar glyphs — ``%`` for
+``dev:compile``, ``~`` for ``dev:queue``, ``=`` for ``dev:execute`` —
+so a cold-compile stall is visually distinct from kernel execute.
+
 Merged MULTI-PROCESS dumps (a peer tree with the sidecar's stitched
 request subtree, or a Chrome export with several process_name rows)
 render with per-process labels — ``[sidecar:fabtpu-sidecar-dev_0]``
@@ -34,7 +39,13 @@ import json
 import sys
 
 
-def _bar(start: float, dur: float, total: float, width: int) -> str:
+#: bar glyphs for the launch ledger's device-lane spans: a compile
+#: stall must read differently from queue wait and execute at a glance
+_DEV_BARS = {"dev:compile": "%", "dev:queue": "~", "dev:execute": "="}
+
+
+def _bar(start: float, dur: float, total: float, width: int,
+         char: str = "#") -> str:
     """[start, start+dur) rendered on a width-char axis of [0, total)."""
     if total <= 0:
         return " " * width
@@ -42,14 +53,15 @@ def _bar(start: float, dur: float, total: float, width: int) -> str:
     hi = int((start + dur) / total * width)
     lo = max(0, min(lo, width - 1))
     hi = max(lo + 1, min(hi, width))
-    return " " * lo + "#" * (hi - lo) + " " * (width - hi)
+    return " " * lo + char * (hi - lo) + " " * (width - hi)
 
 
 def _line(depth: int, name: str, start: float, dur: float, total: float,
           thread: str, width: int) -> str:
     label = "  " * depth + name
     return "  %s %-28s %8.2f +%8.2f ms  [%s]" % (
-        _bar(start, dur, total, width), label[:28], start, dur, thread,
+        _bar(start, dur, total, width, _DEV_BARS.get(name, "#")),
+        label[:28], start, dur, thread,
     )
 
 

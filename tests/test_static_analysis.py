@@ -2105,6 +2105,176 @@ class TestResidentStateBypass:
         assert got == []
 
 
+# -- FT016 unattributed-device-sync ------------------------------------------
+
+BAD_UNATTRIBUTED = """\
+import jax
+import numpy as np
+
+
+def fetch_unledgered(handle):
+    return np.asarray(handle.device_out)
+
+
+def local_chain(handle):
+    out = handle.device_out
+    return np.asarray(out)
+
+
+def direct_get(x):
+    return jax.device_get(x)
+
+
+def blocks_here(x):
+    x.block_until_ready()
+    return x
+
+
+def np_array_variant(self):
+    return np.array(self.device_out)
+"""
+
+BAD_UNATTRIBUTED_ALIASES = """\
+import jax as j
+from jax import device_get as dg
+
+
+def via_alias(x):
+    return j.device_get(x)
+
+
+def via_bare_rename(x):
+    return dg(x)
+"""
+
+CLEAN_UNATTRIBUTED = """\
+import jax
+import numpy as np
+from fabric_tpu.observe import ledger
+
+
+def bracketed(handle, rec):
+    rec.sync_begin()
+    out = np.asarray(handle.device_out)
+    rec.sync_end(d2h_bytes=out.nbytes)
+    return out
+
+
+def opens_its_own_record(handle):
+    rec = ledger.launch("verify")
+    return np.asarray(handle.device_out)
+
+
+def unknown_provenance(arr):
+    # a parameter is not a provable device value
+    return np.asarray(arr)
+
+
+def reassigned_local(handle, other):
+    out = handle.device_out
+    out = other  # provenance unknown: never counts
+    return np.asarray(out)
+
+
+def host_producer(xs):
+    return np.asarray(sorted(xs))
+
+
+def block_until_ready_with_args(x):
+    # not the zero-arg jax-array method shape
+    x.block_until_ready(5)
+"""
+
+CLEAN_UNATTRIBUTED_SHADOW = """\
+import numpy as np
+
+
+def device_get(x):  # a same-named local helper never matches
+    return x
+
+
+def uses_local_helper(x):
+    return device_get(x)
+
+
+def np_not_imported_as_numpy(handle):
+    # this module's `np` IS numpy, but `asarray` of a non-device
+    # value stays silent; and without a numpy import the converter
+    # check never arms in other modules
+    return np.asarray([1, 2])
+"""
+
+
+class TestUnattributedDeviceSync:
+    def test_flags_unledgered_syncs(self, tmp_path):
+        from fabric_tpu.analysis.rules.unattributed_sync import (
+            UnattributedDeviceSyncRule,
+        )
+
+        got = run_rule(tmp_path, UnattributedDeviceSyncRule(),
+                       {"mod.py": BAD_UNATTRIBUTED})
+        assert [(f.rule, f.line) for f in got] == [
+            ("FT016", 6),    # np.asarray(handle.device_out)
+            ("FT016", 11),   # single-assignment device local
+            ("FT016", 15),   # jax.device_get
+            ("FT016", 19),   # .block_until_ready()
+            ("FT016", 24),   # np.array(self.device_out)
+        ]
+        assert "launch-ledger" in got[0].message
+
+    def test_flags_import_aliases(self, tmp_path):
+        from fabric_tpu.analysis.rules.unattributed_sync import (
+            UnattributedDeviceSyncRule,
+        )
+
+        got = run_rule(tmp_path, UnattributedDeviceSyncRule(),
+                       {"mod.py": BAD_UNATTRIBUTED_ALIASES})
+        assert [(f.rule, f.line) for f in got] == [
+            ("FT016", 6),    # j.device_get through the alias
+            ("FT016", 10),   # renamed bare from-import
+        ]
+
+    def test_clean_shapes_never_flag(self, tmp_path):
+        from fabric_tpu.analysis.rules.unattributed_sync import (
+            UnattributedDeviceSyncRule,
+        )
+
+        got = run_rule(tmp_path, UnattributedDeviceSyncRule(), {
+            "mod.py": CLEAN_UNATTRIBUTED,
+            "shadow.py": CLEAN_UNATTRIBUTED_SHADOW,
+        })
+        assert got == []
+
+    def test_test_code_exempt(self, tmp_path):
+        from fabric_tpu.analysis.rules.unattributed_sync import (
+            UnattributedDeviceSyncRule,
+        )
+
+        got = run_rule(tmp_path, UnattributedDeviceSyncRule(), {
+            "test_mod.py": BAD_UNATTRIBUTED,
+            "tests/helper.py": BAD_UNATTRIBUTED,
+            "conftest.py": BAD_UNATTRIBUTED,
+        })
+        assert got == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        from fabric_tpu.analysis.rules.unattributed_sync import (
+            UnattributedDeviceSyncRule,
+        )
+
+        src = "\n".join([
+            "import numpy as np",
+            "",
+            "def f(handle):",
+            "    return np.asarray(handle.device_out)  "
+            "# fabtpu: noqa(FT016)",
+            "",
+        ])
+        got = run_rule(tmp_path, UnattributedDeviceSyncRule(),
+                       {"mod.py": src})
+        assert got == []
+
+
 def test_rule_battery_registered():
     from fabric_tpu.analysis import all_rules
 
@@ -2125,4 +2295,5 @@ def test_rule_battery_registered():
         "FT013": "metric-label-cardinality",
         "FT014": "nonce-reuse-hazard",
         "FT015": "resident-state-bypass",
+        "FT016": "unattributed-device-sync",
     }

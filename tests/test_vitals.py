@@ -587,6 +587,65 @@ def test_vitals_endpoint_roundtrip():
         loop.close()
 
 
+def test_vitals_metric_label_filter_and_exemplars():
+    """/vitals?metric=N&label=k=v keeps only the matching variants —
+    one metric with many label variants no longer returns every ring.
+    404 semantics unchanged for unknown metrics; an exemplar-armed
+    histogram's rings ride the payload."""
+    import asyncio
+
+    from fabric_tpu.opsserver import HealthRegistry, OperationsServer
+
+    clk = Clock()
+    reg, s = _sampler(clk)
+    c = reg.counter("reqs_total", "t")
+    c.add(4, tenant="a")
+    c.add(9, tenant="b")
+    h = reg.histogram("stage_seconds", "t", exemplars=2)
+    h.observe(0.5, exemplar="blk7", stage="launch")
+    s.sample()
+
+    async def scenario():
+        srv = await OperationsServer(
+            port=0, registry=reg, health=HealthRegistry(), vitals=s,
+        ).start()
+        try:
+            loop = asyncio.get_event_loop()
+            st, m = await loop.run_in_executor(
+                None, _get, srv.port,
+                "/vitals?metric=reqs_total&label=tenant=a",
+            )
+            assert st == 200
+            assert list(m["series"]) == ["tenant=a"]
+            # no filter still returns every variant (unchanged)
+            st, m2 = await loop.run_in_executor(
+                None, _get, srv.port, "/vitals?metric=reqs_total"
+            )
+            assert sorted(m2["series"]) == ["tenant=a", "tenant=b"]
+            # exemplar-armed histogram: rings ride the payload
+            st, m3 = await loop.run_in_executor(
+                None, _get, srv.port, "/vitals?metric=stage_seconds"
+            )
+            assert m3["exemplars"]["stage=launch"] == [[0.5, "blk7"]]
+            # 404s: unknown metric (unchanged), and a label matching
+            # no variant of a known metric
+            for bad in ("/vitals?metric=nope&label=tenant=a",
+                        "/vitals?metric=reqs_total&label=tenant=zz"):
+                try:
+                    await loop.run_in_executor(None, _get, srv.port, bad)
+                    raise AssertionError(f"expected 404 for {bad}")
+                except urllib.error.HTTPError as e:
+                    assert e.code == 404
+        finally:
+            await srv.stop()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(asyncio.wait_for(scenario(), 30))
+    finally:
+        loop.close()
+
+
 def test_vitals_endpoint_unarmed_is_honest():
     import asyncio
 
