@@ -1,9 +1,14 @@
 """CLI: ``python -m fabric_tpu.analysis [paths...]``.
 
 Exit status 0 = clean (baselined findings allowed), 1 = live
-findings, 2 = usage error.  ``--json`` emits machine-readable output
-for CI; the default renderer prints ``path:line:col: RULE(name)
-[severity] message`` lines plus a summary.
+findings OR stale baseline entries, 2 = usage error.  ``--json``
+emits machine-readable output for CI (including per-rule wall-time
+under ``timings``); ``--sarif`` emits a SARIF 2.1.0 log for code
+scanners; ``--changed [REF]`` analyzes only files that differ from a
+git ref (project-wide rules still see the full tree — a change
+anywhere can create a cross-module finding elsewhere).  The default
+renderer prints ``path:line:col: RULE(name) [severity] message``
+lines plus a summary.
 """
 
 from __future__ import annotations
@@ -11,14 +16,25 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
+from collections import Counter
 
 from fabric_tpu.analysis import (
     all_rules,
     analyze_paths,
     load_baseline,
 )
-from fabric_tpu.analysis.core import default_baseline_path
+from fabric_tpu.analysis.core import (
+    AnalysisResult,
+    Rule,
+    default_baseline_path,
+)
+
+_SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
 
 
 def _repo_root() -> str:
@@ -28,6 +44,120 @@ def _repo_root() -> str:
     return os.path.dirname(pkg)
 
 
+def _changed_paths(root: str, ref: str) -> list[str] | None:
+    """Analyzable .py files differing from ``ref`` (``git diff
+    --name-only`` plus untracked), absolute.  None = git failed."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        if diff.returncode != 0:
+            sys.stderr.write(diff.stderr)
+            return None
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        names = diff.stdout.splitlines() + (
+            untracked.stdout.splitlines()
+            if untracked.returncode == 0 else []
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        sys.stderr.write(f"git diff failed: {e}\n")
+        return None
+    out = []
+    for name in names:
+        if not name.endswith(".py"):
+            continue
+        path = os.path.join(root, name)
+        if os.path.exists(path):  # deleted files have nothing to parse
+            out.append(path)
+    return sorted(set(out))
+
+
+def _is_project_rule(rule: Rule) -> bool:
+    return type(rule).check_project is not Rule.check_project
+
+
+def _merge(a: AnalysisResult, b: AnalysisResult) -> AnalysisResult:
+    order = lambda f: (f.path, f.line, f.col, f.rule)
+    timings = Counter(a.timings)
+    timings.update(b.timings)
+    return AnalysisResult(
+        findings=sorted(a.findings + b.findings, key=order),
+        baselined=sorted(a.baselined + b.baselined, key=order),
+        suppressed=a.suppressed + b.suppressed,
+        stale_baseline=[],  # partial runs cannot judge staleness
+        timings=dict(timings),
+    )
+
+
+def _to_sarif(result: AnalysisResult, rules: list[Rule]) -> dict:
+    ids = {f.rule for f in result.findings}
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "fabric_tpu.analysis",
+                "informationUri":
+                    "https://example.invalid/fabric-tpu/analysis",
+                "rules": [
+                    {
+                        "id": r.id,
+                        "name": r.name,
+                        "shortDescription": {"text": r.description},
+                    }
+                    for r in rules if r.id in ids or not ids
+                ],
+            }},
+            "results": [
+                {
+                    "ruleId": f.rule,
+                    "level": f.severity,
+                    "message": {"text": f.message},
+                    "locations": [{
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.path},
+                            "region": {
+                                "startLine": max(f.line, 1),
+                                "startColumn": f.col + 1,
+                            },
+                        },
+                    }],
+                }
+                for f in result.findings
+            ],
+        }],
+    }
+
+
+def _write_baseline(path: str, result: AnalysisResult) -> None:
+    """Rewrite the baseline from the live run: every finding the run
+    produced (kept + previously-baselined) becomes budget."""
+    counts: Counter = Counter(
+        f.baseline_key() for f in result.findings + result.baselined
+    )
+    entries = [
+        {"rule": rule, "path": p, "message": msg, "count": n}
+        for (rule, p, msg), n in sorted(counts.items())
+    ]
+    payload = {
+        "_comment": (
+            "Grandfathered findings: each entry absorbs `count` "
+            "occurrences matching (rule, path, message). Keep this "
+            "empty — fix findings instead of baselining them; the "
+            "mechanism exists for emergencies and for staging large "
+            "rule rollouts."
+        ),
+        "findings": entries,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m fabric_tpu.analysis",
@@ -35,16 +165,26 @@ def main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument("paths", nargs="*", help="files/dirs (default: fabric_tpu/)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="emit findings as JSON")
+                    help="emit findings as JSON (with per-rule timings)")
+    ap.add_argument("--sarif", action="store_true",
+                    help="emit a SARIF 2.1.0 log")
     ap.add_argument("--baseline", default=None,
                     help="baseline file (default: the checked-in "
                          "fabric_tpu/analysis/baseline.json)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the baseline (show every finding)")
+    ap.add_argument("--fix-baseline", action="store_true",
+                    help="rewrite the baseline file from this run's "
+                         "findings and exit 0")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule battery and exit")
     ap.add_argument("--rule", action="append", default=None,
                     help="run only this rule id/name (repeatable)")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="analyze only .py files differing from REF "
+                         "(default HEAD); project-wide rules still "
+                         "scan the full tree")
     args = ap.parse_args(argv)
 
     rules = all_rules()
@@ -59,14 +199,51 @@ def main(argv: list[str] | None = None) -> int:
         if not rules:
             print(f"no rule matches {sorted(want)}", file=sys.stderr)
             return 2
+    if args.sarif and args.as_json:
+        print("--sarif and --json are mutually exclusive",
+              file=sys.stderr)
+        return 2
 
     root = _repo_root()
     paths = args.paths or [os.path.join(root, "fabric_tpu")]
-    baseline = (
-        None if args.no_baseline
-        else load_baseline(args.baseline or default_baseline_path())
-    )
-    result = analyze_paths(paths, root=root, rules=rules, baseline=baseline)
+    baseline_path = args.baseline or default_baseline_path()
+    baseline = None if args.no_baseline else load_baseline(baseline_path)
+
+    if args.changed is not None:
+        changed = _changed_paths(root, args.changed)
+        if changed is None:
+            return 2
+        if not changed:
+            result = AnalysisResult(
+                findings=[], baselined=[], suppressed=0,
+                stale_baseline=[],
+            )
+        else:
+            module_rules = [r for r in rules if not _is_project_rule(r)]
+            project_rules = [r for r in rules if _is_project_rule(r)]
+            result = analyze_paths(
+                changed, root=root, rules=module_rules,
+                baseline=baseline,
+            )
+            if project_rules:
+                # a changed module in a project rule's dependency set
+                # can surface findings in UNCHANGED modules — run the
+                # cross-module rules over the full requested tree
+                result = _merge(result, analyze_paths(
+                    paths, root=root, rules=project_rules,
+                    baseline=baseline,
+                ))
+    else:
+        result = analyze_paths(
+            paths, root=root, rules=rules, baseline=baseline,
+        )
+
+    if args.fix_baseline:
+        _write_baseline(baseline_path, result)
+        n = len(result.findings) + len(result.baselined)
+        print(f"fabric_tpu.analysis: baseline rewritten with {n} "
+              f"entr{'y' if n == 1 else 'ies'} → {baseline_path}")
+        return 0
 
     if args.as_json:
         print(json.dumps({
@@ -74,7 +251,11 @@ def main(argv: list[str] | None = None) -> int:
             "baselined": [f.to_json() for f in result.baselined],
             "suppressed": result.suppressed,
             "stale_baseline": [list(k) for k in result.stale_baseline],
+            "timings": {k: round(v, 6)
+                        for k, v in sorted(result.timings.items())},
         }, indent=2, sort_keys=True))
+    elif args.sarif:
+        print(json.dumps(_to_sarif(result, rules), indent=2))
     else:
         for f in result.findings:
             print(f.render())
@@ -83,14 +264,24 @@ def main(argv: list[str] | None = None) -> int:
             bits.append(f"{len(result.baselined)} baselined")
         if result.suppressed:
             bits.append(f"{result.suppressed} noqa-suppressed")
-        if result.stale_baseline:
-            bits.append(
-                f"{len(result.stale_baseline)} STALE baseline entr"
-                f"{'y' if len(result.stale_baseline) == 1 else 'ies'} "
-                f"(fixed findings — prune them)"
-            )
         print("fabric_tpu.analysis: " + ", ".join(bits))
-    return 1 if result.findings else 0
+        if result.stale_baseline:
+            print(
+                "fabric_tpu.analysis: STALE baseline — "
+                f"{len(result.stale_baseline)} entr"
+                f"{'y' if len(result.stale_baseline) == 1 else 'ies'} "
+                "matched nothing (the findings are fixed); run with "
+                "--fix-baseline to prune:",
+                file=sys.stderr,
+            )
+            for key in sorted(result.stale_baseline):
+                rule, path, msg = key
+                print(f"  {rule} {path}: {msg}", file=sys.stderr)
+    if result.findings:
+        return 1
+    if result.stale_baseline:
+        return 1  # a stale baseline is a lint failure: prune it
+    return 0
 
 
 if __name__ == "__main__":

@@ -14,9 +14,10 @@ import ast
 import json
 import os
 import re
+import time
 import tokenize
 from collections import Counter
-from dataclasses import asdict, dataclass
+from dataclasses import asdict, dataclass, field
 
 SEVERITIES = ("error", "warning")
 
@@ -101,15 +102,28 @@ class ModuleCtx:
         return rules is None or rule.id in rules or rule.name in rules
 
 
+def is_test_path(relpath: str) -> bool:
+    """Test code is exempt from every rule (``exempt_tests``):
+    fixtures and differentials drive bad shapes on purpose."""
+    rel = relpath.replace("\\", "/")
+    base = rel.rsplit("/", 1)[-1]
+    return ("tests/" in rel or rel.startswith("tests")
+            or base.startswith("test_") or base == "conftest.py")
+
+
 class Rule:
     """Base class: subclass, set ``id``/``name``/``severity``, and
     implement ``check_module`` (per-file) and/or ``check_project``
-    (cross-file, runs once with every analyzed module)."""
+    (cross-file, runs once with every analyzed module).  The engine
+    skips test files for every rule with ``exempt_tests`` (the
+    default — the whole battery polices production code; tests pin
+    bad shapes on purpose)."""
 
     id: str = ""
     name: str = ""
     severity: str = "error"
     description: str = ""
+    exempt_tests: bool = True
 
     def check_module(self, ctx: ModuleCtx) -> list[Finding]:
         return []
@@ -226,6 +240,7 @@ class AnalysisResult:
     baselined: list[Finding]
     suppressed: int                  # count silenced by noqa
     stale_baseline: list[tuple]      # baseline keys nothing matched
+    timings: dict[str, float] = field(default_factory=dict)  # rule → s
 
     @property
     def errors(self) -> list[Finding]:
@@ -245,10 +260,20 @@ def analyze_paths(
     by_rel = {m.relpath: m for m in modules}
 
     raw: list[Finding] = list(parse_errors)
+    timings: dict[str, float] = {}
     for rule in rules:
+        t0 = time.perf_counter()
         for m in modules:
+            if rule.exempt_tests and is_test_path(m.relpath):
+                continue
             raw.extend(rule.check_module(m))
-        raw.extend(rule.check_project(modules))
+        project = rule.check_project(modules)
+        if rule.exempt_tests:
+            project = [f for f in project if not is_test_path(f.path)]
+        raw.extend(project)
+        timings[rule.id] = timings.get(rule.id, 0.0) + (
+            time.perf_counter() - t0
+        )
 
     # noqa pass — a finding carries the rule that made it, so look the
     # rule back up by id (parse errors are never suppressible)
@@ -277,6 +302,7 @@ def analyze_paths(
     return AnalysisResult(
         findings=kept, baselined=baselined,
         suppressed=suppressed, stale_baseline=stale,
+        timings=timings,
     )
 
 

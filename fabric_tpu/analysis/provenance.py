@@ -1,0 +1,251 @@
+"""Shared provenance engine for the analysis battery.
+
+Every project rule used to carry its own copy of the same three
+dataflow fragments: an import table (module aliases + from-import
+renames, with local defs shadowing), a single-assignment local
+tracker (with poisoning of every other binding form — the FT014
+review-pass semantics), and a self-attr scan over class bodies.
+This module extracts them once, plus a per-module symbol index that
+is built on first use and cached on the :class:`ModuleCtx`, so
+project-wide rules stop re-walking every tree per rule.
+
+The engine preserves the battery's under-approximation contract:
+every resolver answers "provably yes" or "unknown" — a rule that
+stays silent on "unknown" can only lose findings by porting onto it,
+never invent them.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import dotted_name
+
+# -- import-aware alias resolution ------------------------------------------
+
+
+class ImportMap:
+    """Canonical dotted names for a module's import bindings.
+
+    ==============================================  =======================
+    binding                                         canonical
+    ==============================================  =======================
+    ``import secrets``                              secrets → secrets
+    ``import random as rnd``                        rnd → random
+    ``import jax.numpy as jnp``                     jnp → jax.numpy
+    ``import a.b.c`` (no asname)                    a → a
+    ``from secrets import randbelow as below``      below → secrets.randbelow
+    ``from fabric_tpu.observe import ledger``       ledger → fabric_tpu.observe.ledger
+    ==============================================  =======================
+
+    A ``def``/``class`` anywhere in the module SHADOWS the binding
+    (the FT003 lesson: a same-named local helper never matches), and
+    relative imports resolve to nothing — both answer None.
+    """
+
+    def __init__(self, tree: ast.AST):
+        self._names: dict[str, str] = {}
+        self.local_defs: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self._names[a.asname] = a.name
+                    else:
+                        root = a.name.split(".")[0]
+                        self._names[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:  # relative: canonical unknown
+                    continue
+                mod = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self._names[a.asname or a.name] = (
+                        f"{mod}.{a.name}" if mod else a.name
+                    )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                self.local_defs.add(node.name)
+
+    def resolve(self, name: str) -> str | None:
+        """Local name → canonical dotted path (None = unbound or
+        shadowed by a local def)."""
+        if name in self.local_defs:
+            return None
+        return self._names.get(name)
+
+    def resolve_dotted(self, dotted: str | None) -> str | None:
+        """``"rnd.randrange"`` → ``"random.randrange"`` (the root is
+        resolved, the attribute tail rides along)."""
+        if not dotted:
+            return None
+        root, _, rest = dotted.partition(".")
+        canon = self.resolve(root)
+        if canon is None:
+            return None
+        return f"{canon}.{rest}" if rest else canon
+
+    def resolve_node(self, node: ast.AST) -> str | None:
+        """Name/Attribute chain → canonical dotted path."""
+        return self.resolve_dotted(dotted_name(node))
+
+    def resolve_call(self, call: ast.Call) -> str | None:
+        return self.resolve_node(call.func)
+
+    def any_binding(self, pred) -> bool:
+        """True when any live (unshadowed) binding's canonical path
+        satisfies ``pred`` — the cheap "does this module even import
+        the subsystem" arming check."""
+        return any(
+            pred(canon) for name, canon in self._names.items()
+            if name not in self.local_defs
+        )
+
+
+# -- scope walking + the single-assignment tracker --------------------------
+
+
+def walk_scope(scope: ast.AST):
+    """Every node belonging to ``scope`` itself — nested function /
+    class / lambda bodies are their own scopes and are not entered."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class SingleAssignScope:
+    """One scope's single-assignment locals (the FT014 review-pass
+    semantics, extracted).  ``single[name]`` is the value expression
+    of a local bound by EXACTLY one plain ``name = expr`` statement.
+    EVERY other binding form — tuple/starred unpacking, aug/ann
+    assignment, for targets, comprehensions, walrus, ``with ... as``
+    — POISONS the name: its value is then unprovable and a rule
+    consuming the scope stays silent (the under-approximation
+    contract; a k rebound by ``k, tag = ...`` after a random seed
+    must NOT count as the random value)."""
+
+    def __init__(self, scope: ast.AST):
+        counts: dict[str, int] = {}
+        values: dict[str, ast.expr] = {}
+
+        def poison(target):
+            for sub in ast.walk(target):
+                if isinstance(sub, ast.Name):
+                    counts[sub.id] = counts.get(sub.id, 0) + 99
+
+        for node in walk_scope(scope):
+            if isinstance(node, ast.Assign):
+                if (len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    t = node.targets[0]
+                    counts[t.id] = counts.get(t.id, 0) + 1
+                    values[t.id] = node.value
+                else:
+                    for t in node.targets:
+                        poison(t)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign,
+                                   ast.For, ast.AsyncFor,
+                                   ast.comprehension, ast.NamedExpr)):
+                poison(node.target)
+            elif isinstance(node, ast.withitem):
+                if node.optional_vars is not None:
+                    poison(node.optional_vars)
+        self.single: dict[str, ast.expr] = {
+            n: v for n, v in values.items() if counts.get(n) == 1
+        }
+
+    def value_of(self, name: str) -> ast.expr | None:
+        return self.single.get(name)
+
+    def names_where(self, pred) -> set[str]:
+        """Single-assignment locals whose value expression satisfies
+        ``pred`` — the "local provably bound from X" query."""
+        return {n for n, v in self.single.items() if pred(v)}
+
+
+# -- class self-attr tracking -----------------------------------------------
+
+
+def class_self_attrs(cls: ast.ClassDef, value_pred) -> set[str]:
+    """``self.<attr>`` names assigned anywhere in the class whose
+    assigned value satisfies ``value_pred`` (the repo's
+    ``self._ctr = registry.counter(...)`` idiom)."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        t = node.targets[0]
+        if (isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+                and value_pred(node.value)):
+            out.add(t.attr)
+    return out
+
+
+# -- the per-module symbol index --------------------------------------------
+
+
+class ModuleIndex:
+    """Everything a rule asks of one parsed module, computed once:
+    the import map, the function/class lists, method ownership, and
+    memoized :class:`SingleAssignScope` trackers per scope.  Obtain
+    through :func:`module_index`, which caches the instance on the
+    ``ModuleCtx`` — N project rules share one walk."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        tree = ctx.tree
+        self.imports = ImportMap(tree)
+        self.functions = [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        self.classes = [
+            n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
+        ]
+        # direct methods per class (last def wins, like the runtime)
+        self._class_methods: dict[int, dict] = {}
+        # enclosing class for EVERY function under a class, nested
+        # defs included; outermost class wins for nested classes
+        self._enclosing: dict[int, ast.ClassDef] = {}
+        for cls in self.classes:
+            methods: dict[str, ast.AST] = {}
+            for child in ast.iter_child_nodes(cls):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    methods[child.name] = child
+            self._class_methods[id(cls)] = methods
+            for sub in ast.walk(cls):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    self._enclosing.setdefault(id(sub), cls)
+        self._scopes: dict[int, SingleAssignScope] = {}
+
+    def class_methods(self, cls: ast.ClassDef) -> dict[str, ast.AST]:
+        return self._class_methods[id(cls)]
+
+    def enclosing_class(self, fn: ast.AST) -> ast.ClassDef | None:
+        return self._enclosing.get(id(fn))
+
+    def scope(self, node: ast.AST) -> SingleAssignScope:
+        s = self._scopes.get(id(node))
+        if s is None:
+            s = self._scopes[id(node)] = SingleAssignScope(node)
+        return s
+
+
+def module_index(ctx) -> ModuleIndex:
+    """The cached :class:`ModuleIndex` for a ``ModuleCtx`` (built on
+    first use; every rule after that shares it)."""
+    idx = getattr(ctx, "_prov_index", None)
+    if idx is None or idx.ctx is not ctx:
+        idx = ModuleIndex(ctx)
+        ctx._prov_index = idx
+    return idx

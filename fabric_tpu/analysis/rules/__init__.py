@@ -21,16 +21,22 @@ them); slugs are the human-facing names:
                                  cache's invalidation hook
     FT016 unattributed-device-sync  device syncs bypassing the launch
                                  ledger's attribution bracket
+    FT017 cross-thread-state     self-attrs shared across thread roles
+                                 with no common lock
+    FT018 lost-update            unlocked read-modify-write of an attr
+                                 the class guards elsewhere
 """
 
 from fabric_tpu.analysis.rules import (  # noqa: F401
     asyncio_task_leak,
     blocking_wait,
+    cross_thread_state,
     device_buffer_lifetime,
     host_sync,
     jit_purity,
     kernel_dtype,
     lock_discipline,
+    lost_update,
     metric_label_cardinality,
     nonce_reuse,
     pvtdata_purge_race,

@@ -1,0 +1,247 @@
+"""Shared concurrency scan for the cross-thread rules (FT017/FT018).
+
+Both rules reason about the same three facts of a class:
+
+* **which self-attrs are locks** — ctor-proven (``self._lock =
+  threading.Lock()`` resolved import-aware through the provenance
+  engine's :class:`~fabric_tpu.analysis.provenance.ImportMap`) plus
+  the FT004 textual convention (an attr whose name contains ``lock``
+  or ``mutex``, or ends in ``cond`` — the repo's ``self._cond``
+  Condition idiom);
+* **which locks a statement holds** — lexical ``with`` tracking, one
+  scan per method (:func:`scan_method`), recognizing ``with
+  self._lock:``, ``with self._cond:`` and the ``.acquire()`` /
+  ``.reader()`` / ``.writer()`` call forms;
+* **the intra-class call graph** — ``self.m(...)`` edges with the
+  held-set at the call site, so a ``_flush_locked``-style helper
+  inherits the caller's lock interprocedurally.
+
+Everything here under-approximates: a lock reached any other way
+(global, passed in, attribute chain) is invisible, an unrecognized
+``with`` item holds nothing — both directions only make the two
+rules QUIETER, never wrong.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from fabric_tpu.analysis.core import dotted_name
+from fabric_tpu.analysis.provenance import class_self_attrs, walk_scope
+
+#: canonical dotted names of the threading lock constructors
+LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+#: canonical dotted names of the pool-executor constructors
+EXECUTOR_CTORS = {
+    "concurrent.futures.ThreadPoolExecutor",
+    "concurrent.futures.ProcessPoolExecutor",
+    "concurrent.futures.thread.ThreadPoolExecutor",
+    "concurrent.futures.process.ProcessPoolExecutor",
+}
+#: container-mutating method names — a ``self.X.append(...)`` is a
+#: WRITE to X for race purposes
+MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert",
+    "pop", "popleft", "remove", "discard", "clear", "add",
+    "update", "setdefault", "rotate",
+}
+
+
+def _textual_lock_name(name: str) -> bool:
+    low = name.lower()
+    return "lock" in low or "mutex" in low or low.endswith("cond")
+
+
+def lock_attr_names(cls: ast.ClassDef, imports) -> set[str]:
+    """Self-attr names of ``cls`` that are locks: ctor-proven
+    threading primitives plus the textual naming convention."""
+    proven = class_self_attrs(
+        cls,
+        lambda v: (isinstance(v, ast.Call)
+                   and imports.resolve_call(v) in LOCK_CTORS),
+    )
+    textual = {
+        a for a in class_self_attrs(cls, lambda v: True)
+        if _textual_lock_name(a)
+    }
+    return proven | textual
+
+
+def executor_attr_names(cls: ast.ClassDef, imports) -> set[str]:
+    """Self-attr names provably bound from a pool executor ctor."""
+    return class_self_attrs(
+        cls,
+        lambda v: (isinstance(v, ast.Call)
+                   and imports.resolve_call(v) in EXECUTOR_CTORS),
+    )
+
+
+def self_attr(node: ast.AST) -> str | None:
+    """``self.X`` → ``"X"``, else None (deeper chains excluded)."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _with_lock_token(item: ast.withitem, lock_names: set[str]) -> str | None:
+    """The lock identity a ``with`` item acquires, or None.  A
+    ``self.X`` in ``lock_names`` — bare, or through ``.acquire()`` /
+    ``.reader()`` / ``.writer()`` — yields ``"self.X"``; any other
+    dotted name passes only on the textual convention."""
+    node = item.context_expr
+    if isinstance(node, ast.Call):
+        f = node.func
+        if (isinstance(f, ast.Attribute)
+                and f.attr in ("acquire", "reader", "writer")):
+            node = f.value
+        else:
+            node = f
+    dn = dotted_name(node)
+    if dn is None:
+        return None
+    parts = dn.split(".")
+    if parts[0] == "self" and len(parts) == 2:
+        if parts[1] in lock_names:
+            return dn
+        return None
+    if _textual_lock_name(parts[-1]):
+        return dn
+    return None
+
+
+@dataclass(frozen=True)
+class Access:
+    """One touch of a ``self.`` attribute inside a method."""
+
+    attr: str
+    kind: str            # "read" | "write"
+    line: int
+    col: int
+    held: frozenset      # lock tokens held at the access
+
+
+@dataclass(frozen=True)
+class Call:
+    """One intra-class ``self.m(...)`` call edge."""
+
+    callee: str
+    held: frozenset
+    line: int
+
+
+def scan_method(fn: ast.AST, lock_names: set[str]):
+    """→ ``(accesses, calls)`` of one method body.
+
+    Lexical scan with a ``with``-stack: every ``self.X``
+    read/write/mutator-call/subscript-store is recorded with the lock
+    tokens held at that point; every ``self.m(...)`` call becomes an
+    edge carrying its held-set.  Nested defs/lambdas are skipped (they
+    run on their own schedule — a closure handed to a thread is a
+    spawn site, not a body extension)."""
+    accesses: list[Access] = []
+    calls: list[Call] = []
+
+    def visit(node: ast.AST, held: frozenset):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = set(held)
+            for item in node.items:
+                tok = _with_lock_token(item, lock_names)
+                if tok is not None:
+                    inner.add(tok)
+                else:
+                    visit(item.context_expr, held)
+                if item.optional_vars is not None:
+                    visit(item.optional_vars, held)
+            inner_f = frozenset(inner)
+            for stmt in node.body:
+                visit(stmt, inner_f)
+            return
+        if isinstance(node, ast.Call):
+            f = node.func
+            callee = self_attr(f)
+            if callee is not None:
+                calls.append(Call(callee, held, node.lineno))
+            if isinstance(f, ast.Attribute) and f.attr in MUTATORS:
+                base = self_attr(f.value)
+                if base is not None and base not in lock_names:
+                    accesses.append(Access(
+                        base, "write", node.lineno, node.col_offset, held,
+                    ))
+        elif isinstance(node, ast.Attribute):
+            a = self_attr(node)
+            if a is not None and a not in lock_names:
+                kind = ("write"
+                        if isinstance(node.ctx, (ast.Store, ast.Del))
+                        else "read")
+                accesses.append(Access(
+                    a, kind, node.lineno, node.col_offset, held,
+                ))
+        elif isinstance(node, ast.Subscript):
+            base = self_attr(node.value)
+            if (base is not None and base not in lock_names
+                    and isinstance(node.ctx, (ast.Store, ast.Del))):
+                accesses.append(Access(
+                    base, "write", node.lineno, node.col_offset, held,
+                ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    empty = frozenset()
+    for stmt in getattr(fn, "body", []):
+        visit(stmt, empty)
+    return accesses, calls
+
+
+def scan_class(cls: ast.ClassDef, methods: dict, imports):
+    """Scan every direct method of ``cls`` once.  → ``(lock_names,
+    {method name: (accesses, calls)})``."""
+    lock_names = lock_attr_names(cls, imports)
+    scans = {
+        name: scan_method(fn, lock_names)
+        for name, fn in methods.items()
+    }
+    return lock_names, scans
+
+
+def thread_spawn_roles(cls: ast.ClassDef, methods: dict, imports) -> dict[str, str]:
+    """Spawn-site inference: which methods of ``cls`` run on their
+    own thread.  → ``{method name: role label}``.
+
+    Two provable shapes (anything else — attr-chain targets, closures,
+    externally-passed callables — has unknown provenance and stays
+    silent):
+
+    * ``threading.Thread(target=self.m, ...)`` resolved import-aware
+      to the canonical ``threading.Thread``;
+    * ``<self.ex>.submit(self.m, ...)`` where ``self.ex`` is a
+      ctor-proven pool executor attr of the same class.
+    """
+    executors = executor_attr_names(cls, imports)
+    roles: dict[str, str] = {}
+    for fn in methods.values():
+        for node in walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if imports.resolve_call(node) == "threading.Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        m = self_attr(kw.value)
+                        if m is not None and m in methods:
+                            roles[m] = f"thread({m})"
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr == "submit"
+                    and self_attr(f.value) in executors
+                    and node.args):
+                m = self_attr(node.args[0])
+                if m is not None and m in methods:
+                    roles[m] = f"worker({m})"
+    return roles

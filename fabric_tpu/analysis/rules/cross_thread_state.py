@@ -1,0 +1,194 @@
+"""FT017 cross-thread-state: shared self-attrs reached from two
+thread roles with no common lock.
+
+The PR-13 shape, statically: the gateway's submit-queue deque was
+appended by the ingest thread and drained by the flusher with the
+class's own lock held on only ONE of the two paths — a race that
+corrupts under load and never under test.  This rule infers which
+methods of a class run on which thread and flags attributes provably
+reachable from two roles without a common lock.
+
+**Thread roles**, from spawn sites (:func:`thread_spawn_roles` —
+anything unprovable stays silent):
+
+* ``threading.Thread(target=self.m)`` — import-aware; ``self.m`` must
+  be a method of the class (the repo has ~11 in-tree spawn sites of
+  this shape);
+* ``self.<ex>.submit(self.m, ...)`` where ``<ex>`` is a ctor-proven
+  ``ThreadPoolExecutor`` / ``ProcessPoolExecutor`` attr;
+* the **caller role**: every public method that is not itself a spawn
+  entry — the application thread driving the object.  ``__init__`` is
+  excluded outright: it runs before any thread exists.
+
+Each role's reachable accesses close over the intra-class call graph
+(``self.m()`` edges) with the held-lock set propagated
+interprocedurally — a ``_flush_locked`` helper invoked under ``with
+self._cond:`` counts as locked, so the repo's ``*_locked`` idiom is
+clean by construction.
+
+**The race predicate**, strictly under-approximating:
+
+* the attr is reached from ≥ 2 distinct roles, and
+* at least one of those accesses is a write (attr store, aug-assign,
+  subscript store, or a container mutator like ``.append``), and
+* some pair of accesses from different roles — one of them a write —
+  provably holds NO common lock, and
+* at least one access of the attr somewhere holds SOME lock: a class
+  that never locks the attr at all (stop-flag booleans, config set
+  once before start) expresses a different discipline the rule cannot
+  prove wrong, so it stays silent.
+
+Reassigned or unknown-provenance spawn targets never create roles;
+one finding per (class, attr), anchored at the unlocked access
+(writes preferred).  Suppress an intended benign race (monotonic
+flag handshakes) with ``# fabtpu: noqa(FT017)`` on that line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import Finding, ModuleCtx, Rule, register
+from fabric_tpu.analysis.provenance import module_index
+from fabric_tpu.analysis.rules._threads import (
+    scan_class,
+    thread_spawn_roles,
+)
+
+
+@register
+class CrossThreadStateRule(Rule):
+    id = "FT017"
+    name = "cross-thread-state"
+    severity = "error"
+    description = (
+        "flags self-attributes reached from two inferred thread roles "
+        "(Thread targets, executor submits, public-method callers) "
+        "where some cross-role access pair provably holds no common "
+        "lock while the class locks the same attr elsewhere — the "
+        "unlocked-deque class of race"
+    )
+
+    def check_project(self, modules: list[ModuleCtx]) -> list[Finding]:
+        out: list[Finding] = []
+        for ctx in modules:
+            idx = module_index(ctx)
+            for cls in idx.classes:
+                out.extend(self._check_class(ctx, idx, cls))
+        out.sort(key=lambda f: (f.path, f.line, f.col))
+        return out
+
+    def _check_class(self, ctx: ModuleCtx, idx, cls: ast.ClassDef):
+        methods = idx.class_methods(cls)
+        spawned = thread_spawn_roles(cls, methods, idx.imports)
+        if not spawned:
+            return []  # single-threaded class: nothing to race
+        lock_names, scans = scan_class(cls, methods, idx.imports)
+
+        roles: dict[str, list[str]] = {
+            role: [m] for m, role in spawned.items()
+        }
+        callers = [
+            m for m in methods
+            if not m.startswith("_") and m not in spawned
+        ]
+        if callers:
+            roles["caller"] = callers
+
+        # closure: accesses reachable from an entry method, with the
+        # entry-held set layered onto each access's lexical held set
+        memo: dict[tuple, list] = {}
+
+        def collect(mname: str, entry_held: frozenset, stack: frozenset):
+            key = (mname, entry_held)
+            if key in memo:
+                return memo[key]
+            if mname in stack or mname not in scans:
+                return []
+            accesses, calls = scans[mname]
+            got = [
+                a if not entry_held
+                else type(a)(a.attr, a.kind, a.line, a.col,
+                             a.held | entry_held)
+                for a in accesses
+            ]
+            for c in calls:
+                got.extend(collect(
+                    c.callee, entry_held | c.held, stack | {mname},
+                ))
+            memo[key] = got
+            return got
+
+        per_attr: dict[str, dict[str, list]] = {}
+        empty = frozenset()
+        for role, entries in roles.items():
+            for entry in entries:
+                for a in collect(entry, empty, frozenset()):
+                    per_attr.setdefault(a.attr, {}) \
+                            .setdefault(role, []).append(a)
+
+        findings = []
+        for attr in sorted(per_attr):
+            if attr in methods:
+                continue  # a bound-method reference, not state
+            by_role = per_attr[attr]
+            if len(by_role) < 2:
+                continue
+            every = [a for accs in by_role.values() for a in accs]
+            if not any(a.kind == "write" for a in every):
+                continue
+            if not any(a.held for a in every):
+                continue  # never locked anywhere: different discipline
+            pair = self._racing_pair(by_role)
+            if pair is None:
+                continue
+            (r1, a1), (r2, a2) = pair
+            anchor = a1 if (a1.kind == "write" and not a1.held) else a2
+            other = a2 if anchor is a1 else a1
+            o_role = r2 if anchor is a1 else r1
+            a_role = r1 if anchor is a1 else r2
+            held_txt = (
+                f"under {', '.join(sorted(other.held))}"
+                if other.held else "also unlocked"
+            )
+            findings.append(self.finding(
+                ctx, anchor.line, anchor.col,
+                f"self.{attr} in class {cls.name} is shared across "
+                f"thread roles with no common lock: {anchor.kind} "
+                f"here on role {a_role} holds "
+                f"{'no lock' if not anchor.held else ', '.join(sorted(anchor.held))}"
+                f" while role {o_role} {other.kind}s it at line "
+                f"{other.line} {held_txt} — interleavings corrupt "
+                f"state under load and never under test; hold the "
+                f"class lock on every cross-thread path (the "
+                f"*_locked helper idiom), or carry a "
+                f"# fabtpu: noqa(FT017) saying why this handshake "
+                f"is safe",
+            ))
+        return findings
+
+    @staticmethod
+    def _racing_pair(by_role: dict[str, list]):
+        """First cross-role access pair (one a write) with disjoint
+        held-sets, preferring a pair whose anchor is an unlocked
+        write; deterministic order."""
+        role_names = sorted(by_role)
+        best = None
+        for i, r1 in enumerate(role_names):
+            for r2 in role_names[i + 1:]:
+                for a1 in by_role[r1]:
+                    for a2 in by_role[r2]:
+                        if a1.kind != "write" and a2.kind != "write":
+                            continue
+                        if a1.held & a2.held:
+                            continue
+                        pair = ((r1, a1), (r2, a2))
+                        unlocked_write = (
+                            (a1.kind == "write" and not a1.held)
+                            or (a2.kind == "write" and not a2.held)
+                        )
+                        if unlocked_write:
+                            return pair
+                        if best is None:
+                            best = pair
+        return best

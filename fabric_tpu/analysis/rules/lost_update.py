@@ -1,0 +1,225 @@
+"""FT018 lost-update: unlocked read-modify-write of an attr the
+class guards elsewhere.
+
+The PR-12 lost-actuation class, statically: the autopilot applied a
+knob step computed from a stale read of shared state — two writers
+interleave, the second's write is computed from a value the first
+already replaced, and one update silently vanishes.  No crash, no
+torn structure, just a state transition that never happened.
+
+This rule flags a read-modify-write of a shared ``self.`` attribute
+performed while holding NO lock, in a class that demonstrably guards
+the SAME attribute under a lock somewhere else — the class has
+already declared the attr to be shared mutable state; the unlocked
+RMW is the path that forgot.
+
+**RMW shapes** (all three anchored at the write):
+
+* ``self.a += step`` — augmented assignment, the classic;
+* ``x = self.a`` … ``self.a = f(x)`` — the value being stored
+  references the attr directly, or through a SINGLE-ASSIGNMENT local
+  bound from it (``SingleAssignScope`` — a reassigned local has
+  unknown provenance and stays silent);
+* check-then-act — ``if self.a is None: self.a = ...`` — a test that
+  reads the attr guarding a store to it.
+
+**Lock evidence**, via the shared scan (:mod:`._threads`): lexical
+``with self._lock:`` tracking plus interprocedural entry-held sets —
+a private method whose EVERY intra-class call site provably holds a
+lock inherits it (the ``*_locked`` helper idiom); public methods
+inherit nothing (an external caller holds nothing provable).
+Holding ANY lock at the RMW silences — the rule proves only the
+"forgot the lock entirely" path, not lock-mismatch (FT017's job).
+
+Deliberate single-threaded-phase RMWs carry a
+``# fabtpu: noqa(FT018)`` saying why no second writer can exist.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import Finding, ModuleCtx, Rule, register
+from fabric_tpu.analysis.provenance import module_index
+from fabric_tpu.analysis.rules._threads import (
+    _with_lock_token,
+    scan_class,
+    self_attr,
+)
+
+
+def _refs_attr(expr: ast.AST, attr: str, scope) -> bool:
+    """Does ``expr`` read ``self.<attr>`` — directly, or through a
+    single-assignment local bound from it?"""
+    for node in ast.walk(expr):
+        if self_attr(node) == attr and isinstance(node.ctx, ast.Load):
+            return True
+        if isinstance(node, ast.Name):
+            src = scope.value_of(node.id)
+            if src is not None and self_attr(src) == attr:
+                return True
+    return False
+
+
+def _entry_held(methods: dict, scans: dict) -> dict[str, frozenset]:
+    """Interprocedural entry-held sets: a private method whose every
+    intra-class call site holds lock L enters with L held; public
+    methods (and uncalled private ones) enter with nothing.  Fixed
+    point over the call graph — monotone-decreasing intersections,
+    converges in a handful of rounds."""
+    empty = frozenset()
+    sites: dict[str, list] = {m: [] for m in methods}
+    for caller, (_, calls) in scans.items():
+        for c in calls:
+            if c.callee in sites:
+                sites[c.callee].append((caller, c.held))
+    entry: dict[str, frozenset] = {}
+    for m in methods:
+        if m.startswith("_") and not m.startswith("__") and sites[m]:
+            entry[m] = None  # unconstrained until first round
+        else:
+            entry[m] = empty
+    for _ in range(len(methods) + 1):
+        changed = False
+        for m, callers in sites.items():
+            if entry[m] == empty or not callers:
+                continue
+            acc = None  # TOP: no call site has constrained it yet
+            for caller, held in callers:
+                caller_entry = entry.get(caller, empty)
+                if caller_entry is None:
+                    continue  # caller itself unresolved: contributes TOP
+                site = held | caller_entry
+                acc = site if acc is None else (acc & site)
+            if acc is not None and acc != entry[m]:
+                entry[m] = acc
+                changed = True
+        if not changed:
+            break
+    # a private-only cycle can stay TOP forever: it over-claims locks,
+    # which only SILENCES findings — the safe direction
+    return {m: (h if h is not None else empty) for m, h in entry.items()}
+
+
+@register
+class LostUpdateRule(Rule):
+    id = "FT018"
+    name = "lost-update"
+    severity = "error"
+    description = (
+        "flags unlocked read-modify-write of a self-attribute "
+        "(augmented assign, read-then-store, check-then-act) in a "
+        "class that guards the same attribute under a lock elsewhere "
+        "— interleaved writers silently drop an update, the "
+        "lost-actuation class of bug"
+    )
+
+    def check_module(self, ctx: ModuleCtx) -> list[Finding]:
+        idx = module_index(ctx)
+        out: list[Finding] = []
+        for cls in idx.classes:
+            methods = idx.class_methods(cls)
+            lock_names, scans = scan_class(cls, methods, idx.imports)
+            if not lock_names:
+                continue  # lock-free class: guards-elsewhere unprovable
+            guarded = {
+                a.attr
+                for accs, _ in scans.values()
+                for a in accs
+                if a.held
+            }
+            if not guarded:
+                continue
+            entry = _entry_held(methods, scans)
+            for mname, fn in methods.items():
+                if mname == "__init__":
+                    continue  # construction precedes sharing
+                flagged: set[tuple] = set()
+                self._scan_rmw(
+                    ctx, cls, fn, idx.scope(fn), lock_names, guarded,
+                    entry.get(mname, frozenset()), flagged, out,
+                )
+        out.sort(key=lambda f: (f.line, f.col))
+        return out
+
+    def _scan_rmw(self, ctx, cls, fn, scope, lock_names, guarded,
+                  entry_held, flagged, out):
+        def emit(attr: str, node: ast.AST, shape: str):
+            key = (attr, node.lineno)
+            if key in flagged:
+                return
+            flagged.add(key)
+            out.append(self.finding(
+                ctx, node.lineno, node.col_offset,
+                f"unlocked read-modify-write ({shape}) of "
+                f"self.{attr} in {cls.name}.{fn.name} — the class "
+                f"guards self.{attr} under a lock elsewhere, so a "
+                f"concurrent writer can interleave between this "
+                f"read and write and one update silently vanishes; "
+                f"hold the lock across the whole read-modify-write, "
+                f"or carry a # fabtpu: noqa(FT018) saying why no "
+                f"second writer can exist here",
+            ))
+
+        def visit(node: ast.AST, held: frozenset):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = set(held)
+                for item in node.items:
+                    tok = _with_lock_token(item, lock_names)
+                    if tok is not None:
+                        inner.add(tok)
+                inner_f = frozenset(inner)
+                for stmt in node.body:
+                    visit(stmt, inner_f)
+                return
+            if not held:
+                if isinstance(node, ast.AugAssign):
+                    attr = self_attr(node.target)
+                    if attr in guarded:
+                        emit(attr, node, "augmented assign")
+                elif (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1):
+                    attr = self_attr(node.targets[0])
+                    if (attr in guarded
+                            and _refs_attr(node.value, attr, scope)):
+                        emit(attr, node, "read-then-store")
+                elif isinstance(node, ast.If):
+                    self._check_then_act(node, held, guarded, emit)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fn.body:
+            visit(stmt, frozenset(entry_held))
+
+    @staticmethod
+    def _check_then_act(node: ast.If, held, guarded, emit):
+        tested = {
+            self_attr(n) for n in ast.walk(node.test)
+            if self_attr(n) in guarded
+        }
+        if not tested:
+            return
+
+        def find_stores(stmt: ast.AST):
+            # a store under a With in the body re-checks under lock
+            # (double-checked idiom) — don't cross it; nested defs run
+            # on their own schedule
+            if isinstance(stmt, (ast.With, ast.AsyncWith,
+                                 ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return
+            target = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target = self_attr(stmt.targets[0])
+            elif isinstance(stmt, ast.AugAssign):
+                target = self_attr(stmt.target)
+            if target in tested:
+                emit(target, stmt, "check-then-act")
+            for child in ast.iter_child_nodes(stmt):
+                find_stores(child)
+
+        for stmt in node.body:
+            find_stores(stmt)

@@ -12,7 +12,8 @@ in this repo is small closed sets — channel, tenant, stage, status,
 knob, point, kind — and this rule polices it.
 
 Mechanics (strictly under-approximating, per the FT003..FT012
-contract — a finding is always real):
+contract — a finding is always real), on the shared provenance
+engine (:mod:`fabric_tpu.analysis.provenance`):
 
 1. **Metric receiver match** — a write call ``<recv>.add(...)`` /
    ``<recv>.set(...)`` / ``<recv>.observe(...)`` counts only when
@@ -22,11 +23,11 @@ contract — a finding is always real):
      ``.gauge(...)`` / ``.histogram(...)`` whose FIRST argument is a
      string literal (every registry registration passes the metric
      name; a same-named method on an unrelated object does not), or
-   * a local assigned once in the same scope from such a constructor
-     call, or
+   * a single-assignment local bound from such a constructor call
+     (``SingleAssignScope``), or
    * a ``self.<attr>`` assigned from such a constructor call anywhere
-     in the same class (the repo's ``self._ctr = registry.counter``
-     idiom).
+     in the same class (``class_self_attrs`` — the repo's
+     ``self._ctr = registry.counter`` idiom).
 
 2. **Unbounded label value** — a keyword argument (label) flags only
    when its value expression provably carries per-request identity:
@@ -34,7 +35,8 @@ contract — a finding is always real):
    * an attribute chain ending in ``.txid`` / ``.tx_id``, or
      containing ``header.number`` (the block-number chain), or
    * a bare name exactly ``txid`` / ``tx_id`` / ``request_id`` /
-     ``req_id``, or a local assigned once from one of the above, or
+     ``req_id``, or a single-assignment local bound from one of the
+     above, or
    * any of those wrapped in ``str()`` / ``int()`` / ``repr()`` /
      ``format()``, an f-string, or a ``%``/``+`` format expression.
 
@@ -42,12 +44,9 @@ contract — a finding is always real):
    never flags: the closed-set discipline cannot be proven violated,
    so the rule stays silent (under-approximation).
 
-3. **Test code is exempt** (``tests/``, ``test_*.py``,
-   ``conftest.py``) — a test labeling a throwaway registry with a
-   txid is pinning behavior, not leaking cardinality.
-
-Suppress a deliberate bounded-by-construction case with
-``# fabtpu: noqa(FT013)`` on the write line.
+Test code is exempt engine-wide; suppress a deliberate
+bounded-by-construction case with ``# fabtpu: noqa(FT013)`` on the
+write line.
 """
 
 from __future__ import annotations
@@ -60,6 +59,11 @@ from fabric_tpu.analysis.core import (
     Rule,
     dotted_name,
     register,
+)
+from fabric_tpu.analysis.provenance import (
+    class_self_attrs,
+    module_index,
+    walk_scope,
 )
 
 _CTORS = {"counter", "gauge", "histogram"}
@@ -83,56 +87,16 @@ def _is_metric_ctor(call: ast.AST) -> bool:
     )
 
 
-def _scopes(tree: ast.Module):
-    """(scope, own-statement nodes) pairs: module + every function,
-    nested defs excluded from the parent's own set."""
-    yield tree
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            yield node
-
-
-def _own_nodes(scope: ast.AST):
-    stack = list(ast.iter_child_nodes(scope))
-    while stack:
-        node = stack.pop()
-        yield node
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef, ast.Lambda)):
-            continue
-        stack.extend(ast.iter_child_nodes(node))
-
-
-def _single_assigns(scope: ast.AST) -> dict[str, ast.AST | None]:
-    """{name: value expr} for locals assigned exactly once in the
-    scope (None marks a re-assigned name — unusable for resolution)."""
-    out: dict[str, ast.AST | None] = {}
-    for node in _own_nodes(scope):
-        if (isinstance(node, ast.Assign) and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)):
-            name = node.targets[0].id
-            out[name] = None if name in out else node.value
-        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
-            tgt = node.target
-            if isinstance(tgt, ast.Name):
-                out[tgt.id] = None
-        elif isinstance(node, ast.For) and isinstance(node.target,
-                                                      ast.Name):
-            out[node.target.id] = None
-    return out
-
-
-def _unbounded_reason(expr: ast.AST, assigns: dict,
-                      depth: int = 0) -> str | None:
+def _unbounded_reason(expr: ast.AST, scope, depth: int = 0) -> str | None:
     """Why ``expr`` carries per-request identity, or None."""
     if depth > 3:
         return None
     if isinstance(expr, ast.Name):
         if expr.id in _BAD_NAMES:
             return f"per-request identifier {expr.id!r}"
-        src = assigns.get(expr.id)
+        src = scope.value_of(expr.id)
         if src is not None:
-            return _unbounded_reason(src, assigns, depth + 1)
+            return _unbounded_reason(src, scope, depth + 1)
         return None
     if isinstance(expr, ast.Attribute):
         dn = dotted_name(expr)
@@ -144,37 +108,19 @@ def _unbounded_reason(expr: ast.AST, assigns: dict,
     if isinstance(expr, ast.Call):
         name = dotted_name(expr.func)
         if name in _WRAPPERS and expr.args:
-            return _unbounded_reason(expr.args[0], assigns, depth + 1)
+            return _unbounded_reason(expr.args[0], scope, depth + 1)
         return None
     if isinstance(expr, ast.JoinedStr):
         for v in expr.values:
             if isinstance(v, ast.FormattedValue):
-                r = _unbounded_reason(v.value, assigns, depth + 1)
+                r = _unbounded_reason(v.value, scope, depth + 1)
                 if r is not None:
                     return r
         return None
     if isinstance(expr, ast.BinOp):
-        return (_unbounded_reason(expr.left, assigns, depth + 1)
-                or _unbounded_reason(expr.right, assigns, depth + 1))
+        return (_unbounded_reason(expr.left, scope, depth + 1)
+                or _unbounded_reason(expr.right, scope, depth + 1))
     return None
-
-
-def _class_metric_attrs(tree: ast.Module) -> dict[ast.ClassDef, set]:
-    """{class: self-attr names assigned from metric constructors}."""
-    out: dict[ast.ClassDef, set] = {}
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ClassDef):
-            continue
-        attrs: set = set()
-        for sub in ast.walk(node):
-            if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
-                    and isinstance(sub.targets[0], ast.Attribute)
-                    and isinstance(sub.targets[0].value, ast.Name)
-                    and sub.targets[0].value.id == "self"
-                    and _is_metric_ctor(sub.value)):
-                attrs.add(sub.targets[0].attr)
-        out[node] = attrs
-    return out
 
 
 @register
@@ -191,29 +137,18 @@ class MetricLabelCardinalityRule(Rule):
     )
 
     def check_module(self, ctx: ModuleCtx) -> list[Finding]:
-        rel = ctx.relpath
-        base = rel.rsplit("/", 1)[-1]
-        if ("tests/" in rel or rel.startswith("tests")
-                or base.startswith("test_") or base == "conftest.py"):
-            return []
-        class_attrs = _class_metric_attrs(ctx.tree)
-        # map each function scope to its enclosing class (if any)
-        owner: dict[int, ast.ClassDef] = {}
-        for cls in class_attrs:
-            for sub in ast.walk(cls):
-                if isinstance(sub, (ast.FunctionDef,
-                                    ast.AsyncFunctionDef)):
-                    owner.setdefault(id(sub), cls)
+        idx = module_index(ctx)
+        class_attrs = {
+            cls: class_self_attrs(cls, _is_metric_ctor)
+            for cls in idx.classes
+        }
         out: list[Finding] = []
-        for scope in _scopes(ctx.tree):
-            assigns = _single_assigns(scope)
-            metric_locals = {
-                name for name, val in assigns.items()
-                if val is not None and _is_metric_ctor(val)
-            }
-            cls = owner.get(id(scope))
+        for scope_node in [ctx.tree] + idx.functions:
+            scope = idx.scope(scope_node)
+            metric_locals = scope.names_where(_is_metric_ctor)
+            cls = idx.enclosing_class(scope_node)
             self_metrics = class_attrs.get(cls, set()) if cls else set()
-            for node in _own_nodes(scope):
+            for node in walk_scope(scope_node):
                 if not (isinstance(node, ast.Call)
                         and isinstance(node.func, ast.Attribute)
                         and node.func.attr in _WRITES):
@@ -233,7 +168,7 @@ class MetricLabelCardinalityRule(Rule):
                 for kw in node.keywords:
                     if kw.arg is None:
                         continue  # **labels: unresolvable, stay silent
-                    reason = _unbounded_reason(kw.value, assigns)
+                    reason = _unbounded_reason(kw.value, scope)
                     if reason is None:
                         continue
                     out.append(self.finding(

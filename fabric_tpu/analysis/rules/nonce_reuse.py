@@ -12,10 +12,11 @@ contract: the caller now owns uniqueness across every signature the
 key will ever make, silently, with no replay story — exactly the
 hazard the deterministic default exists to remove.  (Explicit k is
 legitimate ONLY for pinned test vectors, and test code is exempt
-below.)
+engine-wide.)
 
 Mechanics (strictly under-approximating, per the FT003..FT013
-contract — a finding is always real):
+contract — a finding is always real), on the shared provenance
+engine (:mod:`fabric_tpu.analysis.provenance`):
 
 1. **Sign call sites** — calls whose callee name (attribute or bare)
    is ``sign_digest`` or ``sign`` AND that pass a nonce argument: the
@@ -23,112 +24,38 @@ contract — a finding is always real):
    ``sign_digest``.  (Receivers are not resolved — ANY sign-family
    call passing a random k is a hazard worth a look; the randomness
    requirement below is what keeps findings real.)
-2. **Randomness provenance, import-aware** (the FT003 lesson — a
-   same-named local helper never matches):
-
-   * module-attr calls whose root is an alias of ``secrets``
-     (``randbelow``/``randbits``/``token_bytes``), ``random``
-     (``randrange``/``randint``/``getrandbits``/``random``), or
-     ``os`` (``urandom``), with ``import m as a`` tracked;
-   * bare calls whose name was from-imported from those modules
-     (renames tracked);
-   * ``SystemRandom`` method chains: ``SystemRandom().randrange(n)``
-     with the ctor resolved the same way.
-
-   The nonce expression is random if it IS such a call, or reaches
-   one through ``int(...)`` / ``int.from_bytes(...)`` wrappers,
-   unary/binary arithmetic (the ``% n`` / ``+ 1`` range-fitting
-   idioms), or ONE same-scope single-assignment local.  Anything
-   else — constants, loop counters, function parameters — stays
-   silent: those may still be wrong, but the rule cannot prove it.
-3. **Test code is exempt** (``tests/``, ``test_*.py``,
-   ``conftest.py``) — pinned RFC vectors and edge-scalar
-   differentials pass explicit k on purpose.
+2. **Randomness provenance, import-aware** (``ImportMap`` — aliases
+   and from-import renames tracked, a same-named local helper never
+   matches): ``secrets.randbelow``/``randbits``/``token_bytes``,
+   ``random.randrange``/``randint``/``getrandbits``/``random``,
+   ``os.urandom``, and ``SystemRandom()`` method chains.  The nonce
+   expression is random if it IS such a call, or reaches one through
+   ``int(...)`` / ``int.from_bytes(...)`` wrappers, unary/binary
+   arithmetic (the ``% n`` / ``+ 1`` range-fitting idioms), or ONE
+   same-scope single-assignment local (``SingleAssignScope`` — every
+   other binding form poisons).  Anything else — constants, loop
+   counters, function parameters — stays silent: those may still be
+   wrong, but the rule cannot prove it.
 """
 
 from __future__ import annotations
 
 import ast
 
-from fabric_tpu.analysis.core import (
-    Finding,
-    ModuleCtx,
-    Rule,
-    register,
-    walk_functions,
-)
+from fabric_tpu.analysis.core import Finding, ModuleCtx, Rule, register
+from fabric_tpu.analysis.provenance import module_index, walk_scope
 
 _SIGN_NAMES = {"sign_digest", "sign"}
 
-#: per-module randomness attributes (module alias → flagged attrs)
-_MOD_ATTRS = {
-    "secrets": {"randbelow", "randbits", "token_bytes"},
-    "random": {"randrange", "randint", "getrandbits", "random"},
-    "os": {"urandom"},
+#: canonical dotted names of the flagged randomness sources
+_RANDOM_SOURCES = {
+    "secrets.randbelow", "secrets.randbits", "secrets.token_bytes",
+    "random.randrange", "random.randint", "random.getrandbits",
+    "random.random",
+    "os.urandom",
 }
-
-
-def _bindings(tree: ast.Module):
-    """Import map: ({local alias → canonical module}, {bare name →
-    canonical module.attr}, {SystemRandom ctor names})."""
-    mod_alias: dict[str, str] = {}
-    bare: dict[str, str] = {}
-    sysrand: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name in _MOD_ATTRS:
-                    mod_alias[a.asname or a.name] = a.name
-        elif isinstance(node, ast.ImportFrom):
-            mod = node.module or ""
-            if mod not in _MOD_ATTRS and mod != "random":
-                continue
-            for a in node.names:
-                name = a.asname or a.name
-                if mod in _MOD_ATTRS and a.name in _MOD_ATTRS[mod]:
-                    bare[name] = f"{mod}.{a.name}"
-                if mod == "random" and a.name == "SystemRandom":
-                    sysrand.add(name)
-    return mod_alias, bare, sysrand
-
-
-class _Scope:
-    """One function scope's single-assignment locals.  EVERY other
-    binding form — tuple/starred unpacking, aug/ann assignment, for
-    targets, comprehensions, walrus, ``with ... as`` — poisons the
-    name: its value is then unprovable and the rule stays silent (the
-    under-approximation contract; a k rebound by ``k, tag = ...``
-    after a random seed must NOT count as the random value)."""
-
-    def __init__(self, fn: ast.AST):
-        counts: dict[str, int] = {}
-        values: dict[str, ast.expr] = {}
-
-        def poison(target):
-            for sub in ast.walk(target):
-                if isinstance(sub, ast.Name):
-                    counts[sub.id] = counts.get(sub.id, 0) + 99
-
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Assign):
-                if (len(node.targets) == 1
-                        and isinstance(node.targets[0], ast.Name)):
-                    t = node.targets[0]
-                    counts[t.id] = counts.get(t.id, 0) + 1
-                    values[t.id] = node.value
-                else:
-                    for t in node.targets:
-                        poison(t)
-            elif isinstance(node, (ast.AugAssign, ast.AnnAssign,
-                                   ast.For, ast.AsyncFor,
-                                   ast.comprehension, ast.NamedExpr)):
-                poison(node.target)
-            elif isinstance(node, ast.withitem):
-                if node.optional_vars is not None:
-                    poison(node.optional_vars)
-        self.single: dict[str, ast.expr] = {
-            n: v for n, v in values.items() if counts.get(n) == 1
-        }
+_RANDOM_ROOTS = {"secrets", "random", "os"}
+_SYSRAND = "random.SystemRandom"
 
 
 @register
@@ -143,18 +70,16 @@ class NonceReuseHazardRule(Rule):
     )
 
     def check_module(self, ctx: ModuleCtx) -> list[Finding]:
-        rel = ctx.relpath.replace("\\", "/")
-        base = rel.rsplit("/", 1)[-1]
-        if ("tests/" in rel or rel.startswith("tests")
-                or base.startswith("test_") or base == "conftest.py"):
-            return []
-        mod_alias, bare, sysrand = _bindings(ctx.tree)
-        if not (mod_alias or bare or sysrand):
+        idx = module_index(ctx)
+        imports = idx.imports
+        if not imports.any_binding(
+            lambda c: c.split(".")[0] in _RANDOM_ROOTS
+        ):
             return []  # no randomness source in scope at all
         out: list[Finding] = []
-        for fn in walk_functions(ctx.tree):
-            scope = _Scope(fn)
-            for node in ast.walk(fn):
+        for fn in idx.functions:
+            scope = idx.scope(fn)
+            for node in walk_scope(fn):
                 if not isinstance(node, ast.Call):
                     continue
                 callee = (node.func.attr
@@ -172,9 +97,7 @@ class NonceReuseHazardRule(Rule):
                     k_arg = node.args[1]
                 if k_arg is None:
                     continue
-                src = self._random_source(
-                    k_arg, scope, mod_alias, bare, sysrand, depth=0
-                )
+                src = self._random_source(k_arg, scope, imports, depth=0)
                 if src is None:
                     continue
                 if ctx.suppressed(self, node.lineno):
@@ -192,36 +115,30 @@ class NonceReuseHazardRule(Rule):
 
     # -- provenance --------------------------------------------------------
 
-    def _random_source(self, node, scope, mod_alias, bare, sysrand,
-                       depth: int):
+    def _random_source(self, node, scope, imports, depth: int):
         """The randomness source name if ``node`` provably derives
         from one, else None."""
         if depth > 6:
             return None
-        rec = lambda n: self._random_source(
-            n, scope, mod_alias, bare, sysrand, depth + 1
-        )
+        rec = lambda n: self._random_source(n, scope, imports, depth + 1)
         if isinstance(node, ast.Call):
             f = node.func
             # secrets.randbelow(...) / rnd.urandom(...) module attrs
             if (isinstance(f, ast.Attribute)
                     and isinstance(f.value, ast.Name)):
-                mod = mod_alias.get(f.value.id)
-                if mod is not None and f.attr in _MOD_ATTRS[mod]:
+                mod = imports.resolve(f.value.id)
+                if mod is not None and f"{mod}.{f.attr}" in _RANDOM_SOURCES:
                     return f"{mod}.{f.attr}"
             # SystemRandom().randrange(...)
             if (isinstance(f, ast.Attribute)
-                    and isinstance(f.value, ast.Call)):
-                ctor = f.value.func
-                if ((isinstance(ctor, ast.Name) and ctor.id in sysrand)
-                        or (isinstance(ctor, ast.Attribute)
-                            and isinstance(ctor.value, ast.Name)
-                            and mod_alias.get(ctor.value.id) == "random"
-                            and ctor.attr == "SystemRandom")):
-                    return f"random.SystemRandom().{f.attr}"
+                    and isinstance(f.value, ast.Call)
+                    and imports.resolve_node(f.value.func) == _SYSRAND):
+                return f"random.SystemRandom().{f.attr}"
             # from-imported bare names (renames tracked)
-            if isinstance(f, ast.Name) and f.id in bare:
-                return bare[f.id]
+            if isinstance(f, ast.Name):
+                canon = imports.resolve(f.id)
+                if canon in _RANDOM_SOURCES:
+                    return canon
             # int(x) / int.from_bytes(x, ...) wrappers
             if ((isinstance(f, ast.Name) and f.id == "int")
                     or (isinstance(f, ast.Attribute)
@@ -236,7 +153,7 @@ class NonceReuseHazardRule(Rule):
         if isinstance(node, ast.UnaryOp):
             return rec(node.operand)
         if isinstance(node, ast.Name):  # one single-assignment local
-            val = scope.single.get(node.id)
+            val = scope.value_of(node.id)
             if val is not None:
                 return rec(val)
         return None

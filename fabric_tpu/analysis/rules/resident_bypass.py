@@ -14,16 +14,17 @@ corruption, the worst failure class this repo has (verdicts fork from
 the host oracle with no error anywhere).
 
 Mechanics (strictly under-approximating, per the FT003..FT014
-contract — a finding is always real):
+contract — a finding is always real), on the shared provenance
+engine (:mod:`fabric_tpu.analysis.provenance`):
 
 1. **A manager must be provably in hand.**  Two binding shapes count,
-   both import-aware (the FT003 lesson — a same-named local helper
-   never matches):
+   both import-aware (``ImportMap`` — a same-named local helper never
+   matches):
 
-   * a LOCAL assigned exactly once from ``ResidencyManager(...)`` or
-     ``resolve_residency(...)`` — bare from-imports of
-     ``fabric_tpu.state`` / ``fabric_tpu.state.residency`` (aliases
-     tracked) or dotted calls through a tracked module alias;
+   * a single-assignment LOCAL bound from ``ResidencyManager(...)``
+     or ``resolve_residency(...)`` (bare from-imports or dotted calls
+     through a module alias of ``fabric_tpu.state`` /
+     ``fabric_tpu.state.residency``);
    * a SELF-ATTR assigned from one of those ctors anywhere in the
      same class (``self.resident = ResidencyManager(...)``).
 
@@ -40,9 +41,9 @@ contract — a finding is always real):
    touches the manager's coherence family — ``apply_batch``,
    ``invalidate_keys`` or ``disable`` — on a bound manager (local or
    class self-attr).
-4. **Test code is exempt** (``tests/``, ``test_*.py``,
-   ``conftest.py``) — differentials drive stale-cache shapes on
-   purpose.
+
+Test code is exempt engine-wide — differentials drive stale-cache
+shapes on purpose.
 """
 
 from __future__ import annotations
@@ -55,101 +56,19 @@ from fabric_tpu.analysis.core import (
     Rule,
     dotted_name,
     register,
-    walk_functions,
+)
+from fabric_tpu.analysis.provenance import (
+    class_self_attrs,
+    module_index,
+    walk_scope,
 )
 
 _CTORS = {"ResidencyManager", "resolve_residency"}
 _HOOKS = {"apply_batch", "invalidate_keys", "disable"}
 _WRITER = "apply_updates"
 _STATE_MODULES = ("fabric_tpu.state", "fabric_tpu.state.residency")
-
-
-def _bindings(tree: ast.Module):
-    """→ (bare ctor names, module aliases) from the module's imports.
-    A local def/class named like a ctor SHADOWS the bare import —
-    dropped from the bare set."""
-    bare: set[str] = set()
-    aliases: set[str] = set()
-    local_defs: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom):
-            mod = node.module or ""
-            for a in node.names:
-                if mod in _STATE_MODULES and a.name in _CTORS:
-                    bare.add(a.asname or a.name)
-                elif mod == "fabric_tpu" and a.name == "state":
-                    aliases.add(a.asname or a.name)
-                elif mod == "fabric_tpu.state" and a.name == "residency":
-                    aliases.add(a.asname or a.name)
-        elif isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name in _STATE_MODULES and a.asname:
-                    aliases.add(a.asname)
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                               ast.ClassDef)):
-            local_defs.add(node.name)
-    return bare - local_defs, aliases
-
-
-def _is_mgr_ctor(call: ast.Call, bare: set, aliases: set) -> bool:
-    name = dotted_name(call.func)
-    if name is None:
-        return False
-    parts = name.split(".")
-    if len(parts) == 1:
-        return parts[0] in bare
-    return parts[0] in aliases and parts[-1] in _CTORS
-
-
-def _walk_own(scope: ast.AST):
-    """A scope's own nodes; nested defs are their own scopes."""
-    stack = list(ast.iter_child_nodes(scope))
-    while stack:
-        node = stack.pop()
-        yield node
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            continue
-        stack.extend(ast.iter_child_nodes(node))
-
-
-def _mgr_locals(scope: ast.AST, bare: set, aliases: set) -> set:
-    """Local names assigned EXACTLY once in the scope, from a manager
-    ctor — a reassigned name has unknown provenance and never counts
-    (the under-approximation contract)."""
-    assigns: dict[str, int] = {}
-    from_ctor: set[str] = set()
-    for node in _walk_own(scope):
-        if (isinstance(node, ast.Assign) and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)):
-            name = node.targets[0].id
-            assigns[name] = assigns.get(name, 0) + 1
-            if (isinstance(node.value, ast.Call)
-                    and _is_mgr_ctor(node.value, bare, aliases)):
-                from_ctor.add(name)
-        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
-            t = node.target
-            if isinstance(t, ast.Name):
-                assigns[t.id] = assigns.get(t.id, 0) + 1
-    return {n for n in from_ctor if assigns.get(n) == 1}
-
-
-def _class_mgr_attrs(cls: ast.ClassDef, bare: set, aliases: set) -> set:
-    """self-attr names assigned from a manager ctor anywhere in the
-    class's methods."""
-    out: set[str] = set()
-    for node in ast.walk(cls):
-        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
-            continue
-        t = node.targets[0]
-        if not (isinstance(t, ast.Attribute)
-                and isinstance(t.value, ast.Name)
-                and t.value.id == "self"):
-            continue
-        if (isinstance(node.value, ast.Call)
-                and _is_mgr_ctor(node.value, bare, aliases)):
-            out.add(t.attr)
-    return out
+#: canonical dotted names of the manager constructors
+_CTOR_CANON = {f"{m}.{c}" for m in _STATE_MODULES for c in _CTORS}
 
 
 def _scan_scope(scope: ast.AST, mgr_recvs: set):
@@ -158,7 +77,7 @@ def _scan_scope(scope: ast.AST, mgr_recvs: set):
     a ``self.<attr>`` the class assigned from a ctor)."""
     writers: list[int] = []
     hooked = False
-    for node in _walk_own(scope):
+    for node in walk_scope(scope):
         if not isinstance(node, ast.Attribute):
             continue
         if node.attr == _WRITER:
@@ -184,14 +103,14 @@ class ResidentStateBypassRule(Rule):
     )
 
     def check_module(self, ctx: ModuleCtx) -> list[Finding]:
-        rel = ctx.relpath
-        base = rel.rsplit("/", 1)[-1]
-        if ("tests/" in rel or rel.startswith("tests")
-                or base.startswith("test_") or base == "conftest.py"):
-            return []
-        bare, aliases = _bindings(ctx.tree)
-        if not bare and not aliases:
+        idx = module_index(ctx)
+        imports = idx.imports
+        if not imports.any_binding(
+            lambda c: c.startswith("fabric_tpu.state")
+        ):
             return []  # the module never imports the subsystem
+        is_ctor = lambda v: (isinstance(v, ast.Call)
+                             and imports.resolve_call(v) in _CTOR_CANON)
         out: list[Finding] = []
 
         def check(scope: ast.AST, mgr_recvs: set, where: str):
@@ -219,25 +138,20 @@ class ResidentStateBypassRule(Rule):
         # method count too); checked scopes are remembered so the
         # function pass below never double-reports a method
         seen: set[int] = set()
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.ClassDef):
-                continue
-            attrs = _class_mgr_attrs(node, bare, aliases)
+        for cls in idx.classes:
+            attrs = class_self_attrs(cls, is_ctor)
             if not attrs:
                 continue
             recvs = {f"self.{a}" for a in attrs}
-            for child in ast.iter_child_nodes(node):
-                if isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef)):
-                    seen.add(id(child))
-                    local = _mgr_locals(child, bare, aliases)
-                    check(child, recvs | local,
-                          f"class {node.name}")
+            for fn in idx.class_methods(cls).values():
+                seen.add(id(fn))
+                local = idx.scope(fn).names_where(is_ctor)
+                check(fn, recvs | local, f"class {cls.name}")
         # plain function scopes (and the module body): local managers
-        for scope in [ctx.tree] + list(walk_functions(ctx.tree)):
+        for scope in [ctx.tree] + idx.functions:
             if id(scope) in seen:
                 continue
-            local = _mgr_locals(scope, bare, aliases)
+            local = idx.scope(scope).names_where(is_ctor)
             if not local:
                 continue
             where = (

@@ -11,12 +11,13 @@ device pressure.  This rule flags sync constructs that PROVABLY
 bypass the wrapper.
 
 Mechanics (strictly under-approximating, per the FT003..FT015
-contract — a finding is always real):
+contract — a finding is always real), on the shared provenance
+engine (:mod:`fabric_tpu.analysis.provenance`):
 
 1. **A sync construct**, one of:
 
    * ``jax.device_get(...)`` / ``jax.block_until_ready(...)`` —
-     import-aware (``import jax as j`` aliases and
+     import-aware (``ImportMap``: ``import jax as j`` aliases and
      ``from jax import device_get as dg`` renames tracked; a
      same-named local def shadows — the FT003 lesson);
    * a zero-arg ``.block_until_ready()`` attribute call (the method
@@ -24,9 +25,10 @@ contract — a finding is always real):
    * ``np.asarray(E)`` / ``np.array(E)`` where ``np`` provably
      resolves to numpy through the module's imports AND ``E`` is a
      provable device value: an attribute chain ending
-     ``.device_out`` (the repo's device-handle idiom), or a local
-     assigned EXACTLY once in the scope from such a chain
-     (reassigned locals have unknown provenance and never count).
+     ``.device_out`` (the repo's device-handle idiom), or a
+     single-assignment local bound from such a chain (reassigned
+     locals have unknown provenance and never count —
+     ``SingleAssignScope``).
 
 2. **The bypass must be provable**: the finding is suppressed when
    the enclosing function touches the ledger API at all — any
@@ -37,9 +39,7 @@ contract — a finding is always real):
    scope that touches the ledger anywhere is assumed to be doing its
    own bracketing — over-suppression is the safe direction here.
 
-3. **Test code is exempt** (``tests/``, ``test_*.py``,
-   ``conftest.py``) — differentials sync on purpose.
-
+Test code is exempt engine-wide — differentials sync on purpose.
 Intended unledgered syncs carry ``# fabtpu: noqa(FT016)`` with a
 comment saying why.
 """
@@ -55,72 +55,19 @@ from fabric_tpu.analysis.core import (
     call_name,
     dotted_name,
     register,
-    walk_functions,
 )
+from fabric_tpu.analysis.provenance import module_index, walk_scope
 
-_LEDGER_MODULES = ("fabric_tpu.observe.ledger",)
-_LEDGER_PKG = "fabric_tpu.observe"
+_LEDGER_MODULE = "fabric_tpu.observe.ledger"
 #: LaunchRecord / module-API attribute touches that prove the scope
 #: participates in the ledger protocol
 _RECORD_ATTRS = {"sync_begin", "sync_end", "complete", "dispatched",
                  "note_h2d"}
 _LEDGER_FNS = {"launch", "global_ledger"}
+_LEDGER_BARE = {f"{_LEDGER_MODULE}.{fn}" for fn in _LEDGER_FNS}
+_SYNC_FNS = {"device_get", "block_until_ready"}
 _NP_CONVERTERS = {"asarray", "array"}
 _DEVICE_ATTR = "device_out"
-
-
-def _bindings(tree: ast.Module):
-    """→ (jax aliases, bare jax sync names, numpy aliases, ledger
-    module aliases, bare ledger fn names) from the module's imports
-    (function-local imports included — this codebase imports lazily).
-    A local def named like a bare import SHADOWS it."""
-    jax_aliases: set[str] = set()
-    jax_bare: dict[str, str] = {}   # local name -> original fn name
-    np_aliases: set[str] = set()
-    led_aliases: set[str] = set()
-    led_bare: set[str] = set()
-    local_defs: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                local = a.asname or a.name.split(".")[0]
-                if a.name == "jax" or a.name.startswith("jax."):
-                    jax_aliases.add(local if a.asname else "jax")
-                elif a.name in ("numpy",):
-                    np_aliases.add(local if a.asname else "numpy")
-                elif a.name in _LEDGER_MODULES and a.asname:
-                    led_aliases.add(a.asname)
-        elif isinstance(node, ast.ImportFrom):
-            mod = node.module or ""
-            for a in node.names:
-                local = a.asname or a.name
-                if mod == "jax" and a.name in ("device_get",
-                                               "block_until_ready"):
-                    jax_bare[local] = a.name
-                elif mod == _LEDGER_PKG and a.name == "ledger":
-                    led_aliases.add(local)
-                elif mod in _LEDGER_MODULES and a.name in _LEDGER_FNS:
-                    led_bare.add(local)
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                               ast.ClassDef)):
-            local_defs.add(node.name)
-    jax_bare = {k: v for k, v in jax_bare.items()
-                if k not in local_defs}
-    return (jax_aliases - local_defs, jax_bare,
-            np_aliases - local_defs, led_aliases - local_defs,
-            led_bare - local_defs)
-
-
-def _walk_own(scope: ast.AST):
-    """A scope's own nodes; nested defs are their own scopes."""
-    stack = list(ast.iter_child_nodes(scope))
-    while stack:
-        node = stack.pop()
-        yield node
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            continue
-        stack.extend(ast.iter_child_nodes(node))
 
 
 def _is_device_chain(node: ast.AST) -> bool:
@@ -130,39 +77,18 @@ def _is_device_chain(node: ast.AST) -> bool:
             and dotted_name(node) is not None)
 
 
-def _device_locals(scope: ast.AST) -> set:
-    """Locals assigned EXACTLY once in the scope, from a
-    ``.device_out`` chain."""
-    assigns: dict[str, int] = {}
-    from_dev: set[str] = set()
-    for node in _walk_own(scope):
-        if (isinstance(node, ast.Assign) and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)):
-            name = node.targets[0].id
-            assigns[name] = assigns.get(name, 0) + 1
-            if _is_device_chain(node.value):
-                from_dev.add(name)
-        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
-            t = node.target
-            if isinstance(t, ast.Name):
-                assigns[t.id] = assigns.get(t.id, 0) + 1
-    return {n for n in from_dev if assigns.get(n) == 1}
-
-
-def _touches_ledger(scope: ast.AST, led_aliases: set,
-                    led_bare: set) -> bool:
-    for node in _walk_own(scope):
+def _touches_ledger(scope: ast.AST, imports) -> bool:
+    for node in walk_scope(scope):
         if isinstance(node, ast.Attribute):
             if node.attr in _RECORD_ATTRS:
                 return True
             if node.attr in _LEDGER_FNS:
-                recv = dotted_name(node.value)
-                if recv is not None and recv in led_aliases:
+                if imports.resolve_node(node.value) == _LEDGER_MODULE:
                     return True
         elif isinstance(node, ast.Call):
             name = call_name(node)
-            if name is not None and "." not in name and \
-                    name in led_bare:
+            if (name is not None and "." not in name
+                    and imports.resolve(name) in _LEDGER_BARE):
                 return True
     return False
 
@@ -181,23 +107,17 @@ class UnattributedDeviceSyncRule(Rule):
     )
 
     def check_module(self, ctx: ModuleCtx) -> list[Finding]:
-        rel = ctx.relpath
-        base = rel.rsplit("/", 1)[-1]
-        if ("tests/" in rel or rel.startswith("tests")
-                or base.startswith("test_") or base == "conftest.py"):
-            return []
-        (jax_aliases, jax_bare, np_aliases, led_aliases,
-         led_bare) = _bindings(ctx.tree)
+        idx = module_index(ctx)
+        imports = idx.imports
         out: list[Finding] = []
-        for fn in walk_functions(ctx.tree):
-            if _touches_ledger(fn, led_aliases, led_bare):
+        for fn in idx.functions:
+            if _touches_ledger(fn, imports):
                 continue
-            dev_locals = _device_locals(fn)
-            for node in _walk_own(fn):
+            dev_locals = idx.scope(fn).names_where(_is_device_chain)
+            for node in walk_scope(fn):
                 if not isinstance(node, ast.Call):
                     continue
-                msg = self._sync_message(node, fn.name, jax_aliases,
-                                         jax_bare, np_aliases,
+                msg = self._sync_message(node, fn.name, imports,
                                          dev_locals)
                 if msg is not None:
                     out.append(self.finding(
@@ -206,8 +126,7 @@ class UnattributedDeviceSyncRule(Rule):
         return out
 
     @staticmethod
-    def _sync_message(node: ast.Call, fname: str, jax_aliases: set,
-                      jax_bare: dict, np_aliases: set,
+    def _sync_message(node: ast.Call, fname: str, imports,
                       dev_locals: set) -> str | None:
         name = call_name(node)
         fix = ("wrap the dispatch in observe.ledger.launch() and "
@@ -218,19 +137,19 @@ class UnattributedDeviceSyncRule(Rule):
         # jax.device_get / jax.block_until_ready through an alias,
         # or their bare from-imports
         if name is not None:
-            parts = name.split(".")
-            if (len(parts) == 2 and parts[0] in jax_aliases
-                    and parts[1] in ("device_get",
-                                     "block_until_ready")):
+            canon = imports.resolve_dotted(name)
+            if (canon is not None
+                    and canon.split(".")[0] == "jax"
+                    and canon.split(".")[-1] in _SYNC_FNS):
+                if "." in name:
+                    return (
+                        f"'{name}' in '{fname}' syncs the device "
+                        f"outside any launch-ledger record — this "
+                        f"wall is invisible to the compile/queue/"
+                        f"execute attribution; {fix}"
+                    )
                 return (
-                    f"'{name}' in '{fname}' syncs the device outside "
-                    f"any launch-ledger record — this wall is "
-                    f"invisible to the compile/queue/execute "
-                    f"attribution; {fix}"
-                )
-            if len(parts) == 1 and parts[0] in jax_bare:
-                return (
-                    f"'{parts[0]}' ({jax_bare[parts[0]]}) in "
+                    f"'{name}' ({canon.split('.')[-1]}) in "
                     f"'{fname}' syncs the device outside any "
                     f"launch-ledger record; {fix}"
                 )
@@ -247,7 +166,8 @@ class UnattributedDeviceSyncRule(Rule):
         # np.asarray / np.array on a provable device value
         if name is not None and node.args:
             parts = name.split(".")
-            if (len(parts) == 2 and parts[0] in np_aliases
+            if (len(parts) == 2
+                    and imports.resolve(parts[0]) == "numpy"
                     and parts[1] in _NP_CONVERTERS):
                 arg = node.args[0]
                 is_dev = _is_device_chain(arg) or (

@@ -169,7 +169,11 @@ class SignBatcher:
 
     def start(self) -> "SignBatcher":
         if self._thread is None:
-            self._stopped = False
+            # under the cond even though the flusher is not spawned
+            # yet: a start() racing a stop()'s locked _stopped=True
+            # must not interleave between its write and the join
+            with self._cond:
+                self._stopped = False
             self._thread = threading.Thread(
                 target=self._run, name="fabtpu-signlane", daemon=True
             )

@@ -2296,4 +2296,727 @@ def test_rule_battery_registered():
         "FT014": "nonce-reuse-hazard",
         "FT015": "resident-state-bypass",
         "FT016": "unattributed-device-sync",
+        "FT017": "cross-thread-state",
+        "FT018": "lost-update",
     }
+
+
+# -- FT017 cross-thread-state -----------------------------------------------
+
+# the PR-13 shape: ingest appends with no lock, the flusher drains
+# under the condition — the deque corrupts under load, never under test
+BAD_CROSS_THREAD = """\
+import threading
+from collections import deque
+
+
+class SignLane:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._pending = deque()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def submit(self, item):
+        self._pending.append(item)
+        return item
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._pending:
+                    self._cond.wait()
+                self._pending.popleft()
+"""
+
+# worker role from an executor submit: the pool thread writes the
+# stats dict bare while readers take the lock
+BAD_CROSS_THREAD_EXECUTOR = """\
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ex = ThreadPoolExecutor(2)
+        self._stats = {}
+
+    def kick(self, key):
+        self._ex.submit(self._work, key)
+
+    def totals(self):
+        with self._lock:
+            return dict(self._stats)
+
+    def _work(self, key):
+        self._stats[key] = self._stats.get(key, 0) + 1
+"""
+
+# every cross-thread path holds the condition — including the ingest
+# side, which reaches the deque through a *_locked helper (the
+# interprocedural held-set propagation)
+CLEAN_CROSS_THREAD = """\
+import threading
+from collections import deque
+
+
+class LockedLane:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._pending = deque()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def submit(self, item):
+        with self._cond:
+            self._append_locked(item)
+            self._cond.notify()
+
+    def _append_locked(self, item):
+        self._pending.append(item)
+
+    def _run(self):
+        with self._cond:
+            while not self._pending:
+                self._cond.wait()
+            self._pending.popleft()
+"""
+
+# unprovable shapes stay silent: an attr-chain thread target (unknown
+# provenance — not a class method), and a class that never locks the
+# shared flag anywhere (a different discipline the rule cannot prove
+# wrong)
+CLEAN_CROSS_THREAD_UNKNOWN = """\
+import threading
+
+
+class Looper:
+    def __init__(self, loop):
+        self.loop = loop
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self.loop.run_forever)
+        self._thread.start()
+
+
+class Flag:
+    def __init__(self):
+        self._stop = False
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def halt(self):
+        self._stop = True
+
+    def _run(self):
+        while not self._stop:
+            pass
+"""
+
+
+class TestCrossThreadState:
+    def _rule(self):
+        from fabric_tpu.analysis.rules.cross_thread_state import (
+            CrossThreadStateRule,
+        )
+
+        return CrossThreadStateRule()
+
+    def test_flags_unlocked_deque_shape(self, tmp_path):
+        got = run_rule(tmp_path, self._rule(),
+                       {"mod.py": BAD_CROSS_THREAD})
+        assert [(f.rule, f.path, f.line) for f in got] == [
+            ("FT017", "mod.py", 16),
+        ]
+        assert "_pending" in got[0].message
+        assert "thread(_run)" in got[0].message
+
+    def test_flags_executor_worker_role(self, tmp_path):
+        got = run_rule(tmp_path, self._rule(),
+                       {"mod.py": BAD_CROSS_THREAD_EXECUTOR})
+        assert [(f.line,) for f in got] == [(19,)]
+        assert "_stats" in got[0].message
+        assert "worker(_work)" in got[0].message
+
+    def test_lock_held_paths_clean(self, tmp_path):
+        assert run_rule(tmp_path, self._rule(),
+                        {"mod.py": CLEAN_CROSS_THREAD}) == []
+
+    def test_unknown_provenance_and_no_locks_clean(self, tmp_path):
+        assert run_rule(tmp_path, self._rule(),
+                        {"mod.py": CLEAN_CROSS_THREAD_UNKNOWN}) == []
+
+    def test_test_code_exempt(self, tmp_path):
+        got = run_rule(tmp_path, self._rule(), {
+            "test_mod.py": BAD_CROSS_THREAD,
+            "tests/helper.py": BAD_CROSS_THREAD,
+            "conftest.py": BAD_CROSS_THREAD,
+        })
+        assert got == []
+
+    def test_noqa_suppresses(self, tmp_path):
+        src = BAD_CROSS_THREAD.replace(
+            "        self._pending.append(item)",
+            "        self._pending.append(item)"
+            "  # fabtpu: noqa(FT017)",
+        )
+        assert run_rule(tmp_path, self._rule(), {"mod.py": src}) == []
+
+
+# -- FT018 lost-update ------------------------------------------------------
+
+# the PR-12 lost-actuation class: three unlocked read-modify-write
+# shapes of attrs the class reads under its lock in snapshot()
+BAD_LOST_UPDATE = """\
+import threading
+
+
+class Pilot:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._knob = 0
+        self._limit = None
+
+    def snapshot(self):
+        with self._lock:
+            return (self._knob, self._limit)
+
+    def actuate(self, step):
+        self._knob += step
+
+    def rescale(self):
+        cur = self._knob
+        self._knob = cur * 2
+
+    def ensure_limit(self):
+        if self._limit is None:
+            self._limit = 16
+"""
+
+CLEAN_LOST_UPDATE = """\
+import threading
+
+
+class SafePilot:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._knob = 0
+        self._limit = None
+
+    def snapshot(self):
+        with self._lock:
+            return (self._knob, self._limit)
+
+    def actuate(self, step):
+        with self._lock:
+            self._knob += step
+
+    def bump(self):
+        with self._lock:
+            self._bump_locked()
+
+    def _bump_locked(self):
+        self._knob += 1
+
+    def rebound(self):
+        cur = 0
+        cur = self._knob
+        self._knob = cur * 2
+
+    def ensure_limit(self):
+        if self._limit is None:
+            with self._lock:
+                if self._limit is None:
+                    self._limit = 16
+
+
+class NoLocks:
+    def __init__(self):
+        self._n = 0
+
+    def inc(self):
+        self._n += 1
+"""
+
+
+class TestLostUpdate:
+    def _rule(self):
+        from fabric_tpu.analysis.rules.lost_update import LostUpdateRule
+
+        return LostUpdateRule()
+
+    def test_flags_all_three_rmw_shapes(self, tmp_path):
+        got = run_rule(tmp_path, self._rule(),
+                       {"mod.py": BAD_LOST_UPDATE})
+        assert [(f.rule, f.line) for f in got] == [
+            ("FT018", 15),   # augmented assign
+            ("FT018", 19),   # read-then-store through a local
+            ("FT018", 23),   # check-then-act
+        ]
+        assert "augmented assign" in got[0].message
+        assert "read-then-store" in got[1].message
+        assert "check-then-act" in got[2].message
+
+    def test_clean_shapes_never_flag(self, tmp_path):
+        # locked RMW, the *_locked helper (entry-held propagation),
+        # a POISONED local (reassigned → unknown provenance), the
+        # double-checked idiom, and a lock-free class
+        assert run_rule(tmp_path, self._rule(),
+                        {"mod.py": CLEAN_LOST_UPDATE}) == []
+
+    def test_test_code_exempt(self, tmp_path):
+        got = run_rule(tmp_path, self._rule(), {
+            "test_mod.py": BAD_LOST_UPDATE,
+            "tests/helper.py": BAD_LOST_UPDATE,
+            "conftest.py": BAD_LOST_UPDATE,
+        })
+        assert got == []
+
+    def test_noqa_suppresses_one_site(self, tmp_path):
+        src = BAD_LOST_UPDATE.replace(
+            "        self._knob += step",
+            "        self._knob += step  # fabtpu: noqa(FT018)",
+        )
+        got = run_rule(tmp_path, self._rule(), {"mod.py": src})
+        assert [(f.line,) for f in got] == [(19,), (23,)]
+
+
+# -- the ported-rule differential pin ---------------------------------------
+
+
+def test_ported_rules_match_pre_port_pin(tmp_path):
+    """FT013/FT014/FT015/FT016 were rewritten onto the shared
+    provenance engine; this pin (captured from the pre-port rules on
+    the same fixtures) proves the port changed NOTHING — path, line,
+    col, severity, and message, byte for byte."""
+    import json
+
+    from fabric_tpu.analysis import analyze_paths as run
+    from fabric_tpu.analysis import all_rules
+
+    pin_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "data", "ported_rules_pin.json",
+    )
+    with open(pin_path, encoding="utf-8") as f:
+        pin = json.load(f)
+
+    fixtures = {
+        "FT013": {"bad.py": BAD_LABELS, "clean.py": CLEAN_LABELS},
+        "FT014": {"bad.py": BAD_NONCES, "clean.py": CLEAN_NONCES},
+        "FT015": {"bad.py": BAD_RESIDENT,
+                  "alias.py": BAD_RESIDENT_ALIAS,
+                  "clean.py": CLEAN_RESIDENT,
+                  "shadow.py": CLEAN_RESIDENT_SHADOW},
+        "FT016": {"bad.py": BAD_UNATTRIBUTED,
+                  "alias.py": BAD_UNATTRIBUTED_ALIASES,
+                  "clean.py": CLEAN_UNATTRIBUTED,
+                  "shadow.py": CLEAN_UNATTRIBUTED_SHADOW},
+    }
+    rules = {r.id: r for r in all_rules()}
+    assert set(fixtures) == set(pin)
+    for rid, files in fixtures.items():
+        d = tmp_path / rid
+        for rel, src in files.items():
+            p = d / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(src))
+        res = run([str(d)], root=str(d), rules=[rules[rid]],
+                  baseline=None)
+        got = sorted(
+            [f.path, f.line, f.col, f.severity, f.message]
+            for f in res.findings
+        )
+        assert got == sorted(pin[rid]), (
+            f"{rid}: ported rule drifted from the pre-port pin"
+        )
+
+
+# -- registry-wide meta-battery ---------------------------------------------
+
+# one representative bad + clean fixture per registered rule; the
+# meta-test below proves EVERY rule has a working fixture pair,
+# honors # fabtpu: noqa(FTnnn) at its finding lines, and exempts
+# test paths engine-wide
+_META_MUTABLE_DEFAULT = """\
+import jax
+
+
+@jax.jit
+def f(x, opts={}):
+    return x
+"""
+
+_META_JIT_CLEAN = """\
+import jax
+
+
+@jax.jit
+def kernel(x, y):
+    local = {}
+    local["t"] = x + y
+    return local["t"] * 2
+"""
+
+_META_RETRACE_CLEAN = """\
+import jax
+
+SCALE = (1.0, 2.0)
+
+
+@jax.jit
+def f(x, n=4):
+    return x * SCALE[0] + n
+"""
+
+_META_SYNC_FILES = {
+    "peer/validator.py": """\
+    from ops import helper
+
+
+    def validate(block):
+        return helper(block)
+    """,
+    "ops.py": """\
+    import jax
+
+
+    def helper(x):
+        y = jax.device_get(x)
+        return y
+    """,
+}
+
+_META_SYNC_CLEAN = {
+    "peer/validator.py": """\
+    def validate(block):
+        return block
+    """,
+}
+
+_META_SELF_DEADLOCK = """\
+def nested(self):
+    with self._lock:
+        with self._lock:
+            pass
+"""
+
+_META_LOCK_CLEAN = """\
+def flush(self):
+    with self._lock:
+        return self.queue.copy()
+"""
+
+_META_SWALLOW = """\
+def f():
+    try:
+        cleanup()
+    except Exception:
+        pass
+"""
+
+_META_SWALLOW_CLEAN = """\
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def g(x):
+    try:
+        return parse(x)
+    except Exception as e:
+        log.warning("parse failed: %s", e)
+        return False
+"""
+
+_META_ENV_CLEAN = """\
+from dataclasses import dataclass
+
+
+@dataclass
+class Holder:
+    payload: dict | None = None
+"""
+
+_META_TASK_CLEAN = """\
+import asyncio
+
+
+async def run(coro):
+    t = asyncio.create_task(coro())
+    try:
+        return await asyncio.wait_for(asyncio.shield(t), 1.0)
+    finally:
+        if not t.done():
+            t.cancel()
+"""
+
+
+def _meta_fixtures():
+    kernel_caller_clean = BAD_CALLER.replace("np.int64", "np.int32").replace(
+        "np.arange(n)[:, None]",
+        "np.arange(n, dtype=np.int32)[:, None]",
+    )
+    bad = {
+        "FT001": {"mod.py": BAD_JIT},
+        "FT002": {"mod.py": _META_MUTABLE_DEFAULT},
+        "FT003": dict(_META_SYNC_FILES),
+        "FT004": {"mod.py": _META_SELF_DEADLOCK},
+        "FT005": {"mod.py": _META_SWALLOW},
+        "FT006": {"mod.py": PRE_FIX_ENV},
+        "FT007": {"ops/kern.py": KERNEL_MOD, "peer/caller.py": BAD_CALLER},
+        "FT008": {"mod.py": BAD_TASK_LEAK},
+        "FT009": {"mod.py": BAD_WAITS},
+        "FT010": {"mod.py": BAD_SPANS},
+        "FT011": {"mod.py": BAD_BUFFER},
+        "FT012": {"mod.py": BAD_PURGE},
+        "FT013": {"mod.py": BAD_LABELS},
+        "FT014": {"mod.py": BAD_NONCES},
+        "FT015": {"mod.py": BAD_RESIDENT},
+        "FT016": {"mod.py": BAD_UNATTRIBUTED},
+        "FT017": {"mod.py": BAD_CROSS_THREAD},
+        "FT018": {"mod.py": BAD_LOST_UPDATE},
+    }
+    clean = {
+        "FT001": {"mod.py": _META_JIT_CLEAN},
+        "FT002": {"mod.py": _META_RETRACE_CLEAN},
+        "FT003": dict(_META_SYNC_CLEAN),
+        "FT004": {"mod.py": _META_LOCK_CLEAN},
+        "FT005": {"mod.py": _META_SWALLOW_CLEAN},
+        "FT006": {"mod.py": _META_ENV_CLEAN},
+        "FT007": {"ops/kern.py": KERNEL_MOD,
+                  "peer/caller.py": kernel_caller_clean},
+        "FT008": {"mod.py": _META_TASK_CLEAN},
+        "FT009": {"mod.py": CLEAN_WAITS},
+        "FT010": {"mod.py": CLEAN_SPANS},
+        "FT011": {"mod.py": CLEAN_BUFFER},
+        "FT012": {"mod.py": CLEAN_PURGE},
+        "FT013": {"mod.py": CLEAN_LABELS},
+        "FT014": {"mod.py": CLEAN_NONCES},
+        "FT015": {"mod.py": CLEAN_RESIDENT},
+        "FT016": {"mod.py": CLEAN_UNATTRIBUTED},
+        "FT017": {"mod.py": CLEAN_CROSS_THREAD},
+        "FT018": {"mod.py": CLEAN_LOST_UPDATE},
+    }
+    return bad, clean
+
+
+def _inject_noqa(files, findings, rule_id):
+    """Append ``# fabtpu: noqa(rule)`` to every finding line."""
+    by_path: dict[str, set] = {}
+    for f in findings:
+        by_path.setdefault(f.path, set()).add(f.line)
+    out = {}
+    for rel, src in files.items():
+        src = textwrap.dedent(src)
+        if rel in by_path:
+            lines = src.splitlines()
+            for ln in by_path[rel]:
+                lines[ln - 1] += f"  # fabtpu: noqa({rule_id})"
+            src = "\n".join(lines) + "\n"
+        out[rel] = src
+    return out
+
+
+def test_registry_meta_battery(tmp_path):
+    """Every registered rule: non-empty description, a bad fixture
+    that fires, a clean fixture that stays silent, line-anchored
+    noqa suppression, and tests/-path exemption."""
+    from fabric_tpu.analysis import all_rules
+
+    rules = all_rules()
+    assert len(rules) == 18
+    bad_fixtures, clean_fixtures = _meta_fixtures()
+    for rule in rules:
+        assert rule.description.strip(), f"{rule.id}: empty description"
+        assert rule.exempt_tests, f"{rule.id}: must exempt test code"
+        assert rule.id in bad_fixtures, f"{rule.id}: no bad fixture"
+        assert rule.id in clean_fixtures, f"{rule.id}: no clean fixture"
+
+        bad = run_rule(tmp_path / rule.id / "bad", rule,
+                       bad_fixtures[rule.id])
+        assert bad, f"{rule.id}: bad fixture produced no findings"
+        assert all(f.rule == rule.id for f in bad)
+
+        clean = run_rule(tmp_path / rule.id / "clean", rule,
+                         clean_fixtures[rule.id])
+        assert clean == [], (
+            f"{rule.id}: clean fixture flagged: "
+            + "; ".join(f.render() for f in clean)
+        )
+
+        noqa = run_rule(
+            tmp_path / rule.id / "noqa", rule,
+            _inject_noqa(bad_fixtures[rule.id], bad, rule.id),
+        )
+        assert noqa == [], f"{rule.id}: noqa(...) not honored"
+
+        exempt = run_rule(
+            tmp_path / rule.id / "exempt", rule,
+            {f"tests/{rel}": src
+             for rel, src in bad_fixtures[rule.id].items()},
+        )
+        assert exempt == [], f"{rule.id}: tests/ paths not exempt"
+
+
+# -- battery wall-time budget -----------------------------------------------
+
+
+def test_battery_wall_time_budget():
+    """The full 18-rule sweep of fabric_tpu/ must stay comfortably
+    interactive — per-rule wall time is reported by analyze_paths so
+    a quadratic regression names its culprit."""
+    from fabric_tpu.analysis import all_rules
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = analyze_paths(
+        [os.path.join(pkg, "fabric_tpu")], root=pkg,
+        baseline=load_baseline(default_baseline_path()),
+    )
+    assert set(res.timings) == {r.id for r in all_rules()}
+    total = sum(res.timings.values())
+    worst = max(res.timings, key=res.timings.get)
+    assert total < 60.0, (
+        f"battery took {total:.1f}s (worst: {worst} "
+        f"{res.timings[worst]:.1f}s) — a rule went quadratic"
+    )
+
+
+# -- CLI round-trips --------------------------------------------------------
+
+
+class TestCliRoundTrips:
+    def _write(self, d, files):
+        for rel, src in files.items():
+            p = d / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(src))
+        return str(d)
+
+    def test_exit_codes(self, tmp_path, capsys):
+        from fabric_tpu.analysis.__main__ import main
+
+        clean = self._write(tmp_path / "clean", {"mod.py": "X = 1\n"})
+        bad = self._write(tmp_path / "bad", {"mod.py": BAD_JIT})
+        assert main([clean, "--no-baseline"]) == 0
+        assert main([bad, "--no-baseline"]) == 1
+        assert main([bad, "--rule", "FTnope"]) == 2
+        capsys.readouterr()
+
+    def test_json_reports_per_rule_timings(self, tmp_path, capsys):
+        import json
+
+        from fabric_tpu.analysis.__main__ import main
+
+        bad = self._write(tmp_path / "bad", {"mod.py": BAD_JIT})
+        rc = main([bad, "--json", "--no-baseline", "--rule", "FT001"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert list(out["timings"]) == ["FT001"]
+        assert out["timings"]["FT001"] >= 0.0
+        assert out["findings"][0]["rule"] == "FT001"
+
+    def test_sarif_round_trip(self, tmp_path, capsys, monkeypatch):
+        import json
+
+        import fabric_tpu.analysis.__main__ as cli
+        from fabric_tpu.analysis.__main__ import main
+
+        bad = self._write(tmp_path / "bad", {"mod.py": BAD_JIT})
+        monkeypatch.setattr(cli, "_repo_root", lambda: bad)
+        rc = main([bad, "--sarif", "--no-baseline", "--rule", "FT001"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "fabric_tpu.analysis"
+        assert [r["id"] for r in run["tool"]["driver"]["rules"]] == [
+            "FT001",
+        ]
+        res = run["results"][0]
+        assert res["ruleId"] == "FT001"
+        assert res["level"] == "error"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "mod.py"
+        assert loc["region"]["startLine"] == 8
+        # --sarif and --json together is a usage error
+        assert main([bad, "--sarif", "--json"]) == 2
+
+    def test_stale_baseline_fails_and_fix_rewrites(self, tmp_path, capsys,
+                                                   monkeypatch):
+        import json
+
+        import fabric_tpu.analysis.__main__ as cli
+        from fabric_tpu.analysis.__main__ import main
+
+        clean = self._write(tmp_path / "clean", {"mod.py": "X = 1\n"})
+        bad = self._write(tmp_path / "bad", {"mod.py": BAD_JIT})
+        monkeypatch.setattr(cli, "_repo_root", lambda: bad)
+        bfile = tmp_path / "baseline.json"
+        bfile.write_text(json.dumps({"findings": [
+            {"rule": "FT001", "path": "gone.py", "message": "old"},
+        ]}))
+
+        # a baseline entry nothing matches is a FAILURE, not a shrug
+        rc = main([clean, "--baseline", str(bfile)])
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "STALE" in err and "gone.py" in err
+
+        # --fix-baseline rewrites from the live run and exits 0
+        rc = main([bad, "--baseline", str(bfile), "--fix-baseline"])
+        capsys.readouterr()
+        assert rc == 0
+        rewritten = json.loads(bfile.read_text())
+        assert [e["rule"] for e in rewritten["findings"]] == ["FT001"]
+        assert rewritten["findings"][0]["path"] == "mod.py"
+
+        # the rewritten baseline absorbs the finding
+        rc = main([bad, "--baseline", str(bfile)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "1 baselined" in out
+
+    def test_changed_mode_analyzes_only_the_diff(self, tmp_path, capsys,
+                                                 monkeypatch):
+        import subprocess
+
+        import fabric_tpu.analysis.__main__ as cli
+
+        repo = tmp_path / "repo"
+        repo.mkdir()
+        git = ["git", "-C", str(repo),
+               "-c", "user.email=ci@example.invalid",
+               "-c", "user.name=ci"]
+        subprocess.run(git[:3] + ["init", "-q"], check=True)
+        (repo / "clean.py").write_text("X = 1\n")
+        subprocess.run(git + ["add", "."], check=True)
+        subprocess.run(git + ["commit", "-qm", "seed"], check=True)
+
+        monkeypatch.setattr(cli, "_repo_root", lambda: str(repo))
+
+        # an uncommitted bad module is picked up via the diff
+        (repo / "bad.py").write_text(textwrap.dedent(BAD_JIT))
+        rc = cli.main(["--changed", "--no-baseline", str(repo)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "bad.py:8" in out
+
+        # committed → nothing differs from HEAD → clean exit
+        subprocess.run(git + ["add", "."], check=True)
+        subprocess.run(git + ["commit", "-qm", "more"], check=True)
+        rc = cli.main(["--changed", "--no-baseline", str(repo)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "0 finding(s)" in out
