@@ -213,12 +213,14 @@ def _bench_sha256():
     blocks, nb = sha256.pad_messages(msgs)
     db, dn = jnp.asarray(blocks), jnp.asarray(nb)
     out = sha256.sha256_blocks_jit(db, dn)  # compile
-    jax.block_until_ready(out)
+    # raw-kernel microbench: the whole wall IS the measurement — no
+    # commit-path launch ledger exists to attribute it to
+    jax.block_until_ready(out)  # fabtpu: noqa(FT016)
     reps = 20
     t0 = time.perf_counter()
     for _ in range(reps):
         out = sha256.sha256_blocks_jit(db, dn)
-    jax.block_until_ready(out)
+    jax.block_until_ready(out)  # fabtpu: noqa(FT016)
     tpu_s = (time.perf_counter() - t0) / reps
 
     tpu_rate = n / tpu_s
@@ -425,7 +427,24 @@ def _bench_knobs() -> dict:
         # 1 = block-independent read-only working set (see
         # _build_commit_network hot_readonly)
         "hot_readonly": int(os.environ.get("FABTPU_BENCH_HOT", "0")),
+        # decoupled commit engine (ledger/committer.py): 1 = block-store
+        # append stays on the critical path, state-DB apply drains on
+        # the background applier (default — the peer node's production
+        # setting); 0 = the serial engine for the A/B.  The A/B number
+        # to watch is per_block_ms.ledger_commit: async ON removes the
+        # state_apply portion from the submit→commit critical path.
+        "async_commit": int(
+            os.environ.get("FABTPU_BENCH_ASYNC_COMMIT", "1")
+        ),
     }
+
+
+def _bench_async_commit() -> bool:
+    """FABTPU_BENCH_ASYNC_COMMIT=0 pins the serial commit engine for
+    the A/B; default 1 benches the decoupled committer."""
+    import os
+
+    return os.environ.get("FABTPU_BENCH_ASYNC_COMMIT", "1") == "1"
 
 
 def _vitals_capture(interval_s: float = 0.25):
@@ -645,13 +664,20 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5,
             out.append(b)
         return out
 
+    engine_stats: dict | None = None
+
     def run_tpu(timings=None):
+        nonlocal engine_stats
         state = fresh_state()
-        v = fresh_validator(state)
-        v.timings = timings
         stream = copy_blocks()
         tmp = tempfile.mkdtemp(prefix="benchledger")
-        lg = KVLedger(tmp, state_db=state, enable_history=True)
+        lg = KVLedger(tmp, state_db=state, enable_history=True,
+                      async_commit=_bench_async_commit())
+        # the validator reads through lg.state: under the async engine
+        # that is the pending-batch overlay, so MVCC preloads see
+        # queued-but-unapplied batches exactly like committed state
+        v = fresh_validator(lg.state)
+        v.timings = timings
         n_valid = 0
 
         def commit_fn(res):
@@ -664,6 +690,10 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5,
                     timings.get("ledger_commit", 0.0)
                     + time.perf_counter() - t0
                 )
+                # critical-path decomposition: block-store append vs
+                # state apply (under async the latter is submit cost)
+                for tk, tv in lg.last_commit_timings.items():
+                    timings[tk] = timings.get(tk, 0.0) + tv
 
         # the production CommitPipeline (peer/pipeline.py — the same
         # subsystem the peer node's deliver loop commits through):
@@ -683,6 +713,8 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5,
             if res is not None:
                 n_valid += res.n_valid
             dt = time.perf_counter() - t0
+        if lg.engine is not None:
+            engine_stats = lg.engine.stats()
         lg.close()
         shutil.rmtree(tmp, ignore_errors=True)
         return dt, n_valid
@@ -806,6 +838,9 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5,
         # the resident A/B record: hit rate / evictions / uploaded
         # state bytes next to the state_fill ms in per_block_ms
         "resident_state": resident,
+        # apply-queue telemetry of the final timed run (None when the
+        # serial engine ran, i.e. FABTPU_BENCH_ASYNC_COMMIT=0)
+        "commit_engine": engine_stats,
         "trace": trace_extras,
         "pipeline_overlap_coverage": overlap_cov,
     }
@@ -839,22 +874,26 @@ def _bench_block_commit_sustained(n_tx: int = 1000, n_blocks: int = 50):
     expected_valid = (n_tx - n_invalid) * n_blocks
 
     state = fresh_state()
-    v = fresh_validator(state)
     stream = []
     for blk in blocks:
         b = common_pb2.Block()
         b.CopyFrom(blk)
         stream.append(b)
     tmp = tempfile.mkdtemp(prefix="benchsustained")
-    lg = KVLedger(tmp, state_db=state, enable_history=True)
+    lg = KVLedger(tmp, state_db=state, enable_history=True,
+                  async_commit=_bench_async_commit())
+    v = fresh_validator(lg.state)
     n_valid = 0
     submit_t: dict[int, float] = {}
     commit_t: dict[int, float] = {}
+    commit_path: dict[str, float] = {}
 
     def commit_fn(res):
         lg.commit_block(res.block, res.tx_filter, res.batch,
                         res.history, None, res.txids, res.pend.hd_bytes)
         commit_t[res.block.header.number] = time.perf_counter()
+        for tk, tv in lg.last_commit_timings.items():
+            commit_path[tk] = commit_path.get(tk, 0.0) + tv
 
     coalesce = knobs["coalesce_blocks"]
     t0 = time.perf_counter()
@@ -879,6 +918,7 @@ def _bench_block_commit_sustained(n_tx: int = 1000, n_blocks: int = 50):
             n_valid += res.n_valid
         dt = time.perf_counter() - t0
     group_commit = lg.blocks.group_commit
+    engine_stats = lg.engine.stats() if lg.engine is not None else None
     lg.close()
     shutil.rmtree(tmp, ignore_errors=True)
     assert n_valid == expected_valid, (n_valid, expected_valid)
@@ -922,6 +962,13 @@ def _bench_block_commit_sustained(n_tx: int = 1000, n_blocks: int = 50):
             "host_stage": host_stage,
             "resident_state": resident,
             "group_commit": group_commit,
+            # submit→commit critical-path decomposition (ms/block):
+            # under async the state_apply row is the queue submit cost
+            "commit_path_ms": {
+                tk: round(1000.0 * tv / n_blocks, 3)
+                for tk, tv in sorted(commit_path.items())
+            },
+            "commit_engine": engine_stats,
             "pipeline_overlap_coverage": overlap_cov,
         },
     }
@@ -967,14 +1014,15 @@ def _bench_block_commit_chaos(n_tx: int = 200, n_blocks: int = 24,
     expected_valid = (n_tx - n_invalid) * n_blocks
 
     state = fresh_state()
-    v = fresh_validator(state)
     stream = []
     for blk in blocks:
         b = common_pb2.Block()
         b.CopyFrom(blk)
         stream.append(b)
     tmp = tempfile.mkdtemp(prefix="benchchaos")
-    lg = KVLedger(tmp, state_db=state, enable_history=True)
+    lg = KVLedger(tmp, state_db=state, enable_history=True,
+                  async_commit=_bench_async_commit())
+    v = fresh_validator(lg.state)
 
     height = [0]
     submit_t: dict[int, float] = {}
@@ -1115,8 +1163,11 @@ def _chaos_sidecar_kill(blocks, fresh_state, mgr, prov, n_tx) -> dict:
     n_blocks = len(blocks)
     host = _SidecarHost(queue_blocks=8, coalesce=2)
     state = fresh_state()
+    tmp = tempfile.mkdtemp(prefix="benchsidecarkill")
+    lg = KVLedger(tmp, state_db=state, enable_history=True,
+                  async_commit=_bench_async_commit())
     v = SidecarValidator(
-        mgr, prov, state,
+        mgr, prov, lg.state,
         sidecar_endpoint=f"127.0.0.1:{host.port}",
         channel="sidecar-kill",
         sidecar_fail_threshold=1, sidecar_recovery_s=0.05,
@@ -1127,8 +1178,6 @@ def _chaos_sidecar_kill(blocks, fresh_state, mgr, prov, n_tx) -> dict:
         b = common_pb2.Block()
         b.CopyFrom(blk)
         stream.append(b)
-    tmp = tempfile.mkdtemp(prefix="benchsidecarkill")
-    lg = KVLedger(tmp, state_db=state, enable_history=True)
     fallback_ctr = global_registry().counter("fallback_blocks_total")
     fallback0 = fallback_ctr.value(channel="sidecar-kill")
 
@@ -1173,8 +1222,9 @@ def _chaos_sidecar_kill(blocks, fresh_state, mgr, prov, n_tx) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
         try:
             host.stop_server()
-        except Exception:
-            pass  # already stopped by the kill when the run failed early
+        except Exception:  # fabtpu: noqa(FT005)
+            # already stopped by the kill when the run failed early
+            pass
         host.close()
 
 
@@ -1259,8 +1309,11 @@ def _bench_block_commit_sidecar(n_tx: int = 200, n_blocks: int = 12):
 
     def drive(name: str, weight: float):
         state = fresh_state()
+        tmp = tempfile.mkdtemp(prefix=f"benchsidecar-{name}")
+        lg = KVLedger(tmp, state_db=state, enable_history=True,
+                      async_commit=_bench_async_commit())
         v = SidecarValidator(
-            mgr, prov, state,
+            mgr, prov, lg.state,
             sidecar_endpoint=f"127.0.0.1:{host.port}",
             sidecar_weight=weight, channel=name,
             sidecar_fail_threshold=2, sidecar_recovery_s=0.5,
@@ -1271,8 +1324,6 @@ def _bench_block_commit_sidecar(n_tx: int = 200, n_blocks: int = 12):
             b = common_pb2.Block()
             b.CopyFrom(blk)
             stream.append(b)
-        tmp = tempfile.mkdtemp(prefix=f"benchsidecar-{name}")
-        lg = KVLedger(tmp, state_db=state, enable_history=True)
         submit_t: dict[int, float] = {}
         commit_t: dict[int, float] = {}
         n_valid = [0]
@@ -1492,8 +1543,11 @@ def _bench_block_commit_bursty(n_blocks: int = 18,
 
     def drive(name: str, weight: float):
         state = fresh_state()
+        tmp = tempfile.mkdtemp(prefix=f"benchbursty-{name}")
+        lg = KVLedger(tmp, state_db=state, enable_history=True,
+                      async_commit=_bench_async_commit())
         v = SidecarValidator(
-            mgr, prov, state,
+            mgr, prov, lg.state,
             sidecar_endpoint=f"127.0.0.1:{host.port}",
             sidecar_weight=weight, channel=name,
             sidecar_fail_threshold=1, sidecar_recovery_s=0.5,
@@ -1505,8 +1559,6 @@ def _bench_block_commit_bursty(n_blocks: int = 18,
             b = common_pb2.Block()
             b.CopyFrom(blk)
             stream.append(b)
-        tmp = tempfile.mkdtemp(prefix=f"benchbursty-{name}")
-        lg = KVLedger(tmp, state_db=state, enable_history=True)
         commit_t: dict[int, float] = {}
         arrive_t: dict[int, float] = {}
 

@@ -216,14 +216,25 @@ def thread_spawn_roles(cls: ast.ClassDef, methods: dict, imports) -> dict[str, s
     """Spawn-site inference: which methods of ``cls`` run on their
     own thread.  → ``{method name: role label}``.
 
-    Two provable shapes (anything else — attr-chain targets, closures,
-    externally-passed callables — has unknown provenance and stays
-    silent):
+    Four provable shapes (anything else — attr-chain targets,
+    closures, externally-passed callables — has unknown provenance and
+    stays silent):
 
     * ``threading.Thread(target=self.m, ...)`` resolved import-aware
       to the canonical ``threading.Thread``;
     * ``<self.ex>.submit(self.m, ...)`` where ``self.ex`` is a
-      ctor-proven pool executor attr of the same class.
+      ctor-proven pool executor attr of the same class;
+    * ``asyncio.create_task(self.m(...))`` / ``asyncio.ensure_future(
+      self.m(...))`` / ``asyncio.run_coroutine_threadsafe(
+      self.m(...), loop)`` resolved import-aware — the coroutine runs
+      interleaved with every other task on the loop (awaits are the
+      preemption points), so against a real THREAD its state shares
+      exactly like a thread's, while two tasks on the same loop are
+      cooperatively scheduled (FT017 models that with the implicit
+      ``<event-loop>`` token);
+    * ``<loop>.run_in_executor(executor, self.m, ...)`` — the method
+      runs on a pool thread regardless of which loop or executor
+      object carries it, so the receiver is not constrained.
     """
     executors = executor_attr_names(cls, imports)
     roles: dict[str, str] = {}
@@ -237,6 +248,14 @@ def thread_spawn_roles(cls: ast.ClassDef, methods: dict, imports) -> dict[str, s
                         m = self_attr(kw.value)
                         if m is not None and m in methods:
                             roles[m] = f"thread({m})"
+            if (imports.resolve_call(node) in (
+                    "asyncio.create_task", "asyncio.ensure_future",
+                    "asyncio.run_coroutine_threadsafe")
+                    and node.args
+                    and isinstance(node.args[0], ast.Call)):
+                m = self_attr(node.args[0].func)
+                if m is not None and m in methods:
+                    roles[m] = f"task({m})"
             f = node.func
             if (isinstance(f, ast.Attribute) and f.attr == "submit"
                     and self_attr(f.value) in executors
@@ -244,4 +263,10 @@ def thread_spawn_roles(cls: ast.ClassDef, methods: dict, imports) -> dict[str, s
                 m = self_attr(node.args[0])
                 if m is not None and m in methods:
                     roles[m] = f"worker({m})"
+            if (isinstance(f, ast.Attribute)
+                    and f.attr == "run_in_executor"
+                    and len(node.args) >= 2):
+                m = self_attr(node.args[1])
+                if m is not None and m in methods:
+                    roles[m] = f"executor({m})"
     return roles

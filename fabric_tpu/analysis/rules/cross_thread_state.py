@@ -16,6 +16,17 @@ anything unprovable stays silent):
   this shape);
 * ``self.<ex>.submit(self.m, ...)`` where ``<ex>`` is a ctor-proven
   ``ThreadPoolExecutor`` / ``ProcessPoolExecutor`` attr;
+* ``asyncio.create_task(self.m(...))`` / ``asyncio.ensure_future`` /
+  ``asyncio.run_coroutine_threadsafe`` — import-aware; the coroutine
+  interleaves with every other task on the loop (awaits are the
+  preemption points), so against a real thread its state shares
+  exactly like a thread's.  Between two tasks on the SAME loop the
+  scheduling is cooperative: every task role (and every ``async def``
+  caller entry) implicitly holds the synthetic ``<event-loop>``
+  token, so loop-internal sharing — the sync-surface-plus-loop-thread
+  idiom — never pairs task-vs-task, only task-vs-thread;
+* ``<loop>.run_in_executor(executor, self.m, ...)`` — the method runs
+  on a pool thread regardless of which loop object carries the call;
 * the **caller role**: every public method that is not itself a spawn
   entry — the application thread driving the object.  ``__init__`` is
   excluded outright: it runs before any thread exists.
@@ -24,7 +35,10 @@ Each role's reachable accesses close over the intra-class call graph
 (``self.m()`` edges) with the held-lock set propagated
 interprocedurally — a ``_flush_locked`` helper invoked under ``with
 self._cond:`` counts as locked, so the repo's ``*_locked`` idiom is
-clean by construction.
+clean by construction.  A call edge INTO a spawn-entry method does
+not extend the caller's body: ``run_coroutine_threadsafe(
+self._asubmit(...))`` ships the coroutine to the loop thread, so
+``_asubmit``'s accesses belong to its task role, not to the caller.
 
 **The race predicate**, strictly under-approximating:
 
@@ -63,7 +77,8 @@ class CrossThreadStateRule(Rule):
     severity = "error"
     description = (
         "flags self-attributes reached from two inferred thread roles "
-        "(Thread targets, executor submits, public-method callers) "
+        "(Thread targets, executor submits, asyncio task spawns, "
+        "run_in_executor dispatches, public-method callers) "
         "where some cross-role access pair provably holds no common "
         "lock while the class locks the same attr elsewhere — the "
         "unlocked-deque class of race"
@@ -113,17 +128,33 @@ class CrossThreadStateRule(Rule):
                 for a in accesses
             ]
             for c in calls:
+                if c.callee in spawned:
+                    # a spawn entry's body runs on ITS role's
+                    # schedule, not the caller's — the syntactic edge
+                    # (e.g. run_coroutine_threadsafe(self.m())) does
+                    # not extend the calling body
+                    continue
                 got.extend(collect(
                     c.callee, entry_held | c.held, stack | {mname},
                 ))
             memo[key] = got
             return got
 
+        # cooperative scheduling on one loop: task roles (and async
+        # caller entries, which await on the same loop) mutually
+        # exclude between awaits — modeled as an implicit common token
+        loop_seed = frozenset({"<event-loop>"})
+
         per_attr: dict[str, dict[str, list]] = {}
         empty = frozenset()
         for role, entries in roles.items():
             for entry in entries:
-                for a in collect(entry, empty, frozenset()):
+                seed = empty
+                if (role.startswith("task(")
+                        or isinstance(methods.get(entry),
+                                      ast.AsyncFunctionDef)):
+                    seed = loop_seed
+                for a in collect(entry, seed, frozenset()):
                     per_attr.setdefault(a.attr, {}) \
                             .setdefault(role, []).append(a)
 
@@ -137,8 +168,9 @@ class CrossThreadStateRule(Rule):
             every = [a for accs in by_role.values() for a in accs]
             if not any(a.kind == "write" for a in every):
                 continue
-            if not any(a.held for a in every):
+            if not any(a.held - loop_seed for a in every):
                 continue  # never locked anywhere: different discipline
+                # (the synthetic loop token is not a chosen lock)
             pair = self._racing_pair(by_role)
             if pair is None:
                 continue

@@ -176,6 +176,8 @@ async def _run_peer(cfg):
         sidecar_listen=cfg.sidecar_listen,
         sidecar_queue_blocks=cfg.sidecar_queue_blocks,
         sidecar_coalesce=cfg.sidecar_coalesce,
+        async_commit=cfg.async_commit,
+        apply_queue_blocks=cfg.apply_queue_blocks,
     )
     await node.start(operations_port=cfg.operations_port)
     print(f"peer {node.id} serving on :{node.port}", flush=True)

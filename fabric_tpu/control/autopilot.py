@@ -146,6 +146,12 @@ DEFAULT_BANDS = {
                               # coalescing window (wait_ms down)
     "sign_fill_lo": 0.25,   # occupancy p50 / batch_max below (lane
                             # flowing) → linger longer (wait_ms up)
+    "apply_hi_ms": 100.0,  # state-apply queue age above → the
+                           # applier is the bottleneck: coalesce
+                           # DOWN (bigger groups only grow the lag)
+    "apply_lo_ms": 10.0,   # below → the apply lane is keeping up;
+                           # the coalesce rule is free to act on
+                           # admission-queue age again
     "burn_hi": 1.5,        # tenant burn above → halve its weight
     "burn_lo": 0.5,        # below → restore toward its hello weight
     "shed_hi": 4.0,        # tenant fast burn above → shed mode ON
@@ -401,6 +407,12 @@ class Signals:
     #: phantom decisions.
     sign_busy_rate: float | None = None
     sign_wait_p99_ms: float | None = None
+    #: age of the OLDEST batch waiting in the async commit engine's
+    #: state-apply queue (AsyncApplyEngine.stats()) — the trailing-
+    #: apply pressure signal: blocks are durable and acked, but the
+    #: state DB lags by this much.  None = serial commit engine (or
+    #: no channel yet): the apply rule skips entirely.
+    apply_queue_age_ms: float | None = None
     #: trailing batch-occupancy p50 as a fraction of batch_max — the
     #: sign_batch_wait_ms knob's efficiency signal: a flowing lane
     #: flushing nearly-empty batches wastes device dispatches
@@ -473,7 +485,7 @@ class Autopilot:
     def __init__(self, knob_specs=None, apply_knob=None, *,
                  set_weight=None, set_shed=None, slo=None,
                  scheduler=None, tracer=None, sign_source=None,
-                 initial=None,
+                 commit_source=None, initial=None,
                  tick_s: float = 1.0, clock=time.monotonic,
                  registry=None, enabled: bool = True, bands=None):
         if knob_specs is None or isinstance(knob_specs, str):
@@ -487,6 +499,9 @@ class Autopilot:
         # anything with the SignBatcher stats() shape (busy_rate +
         # wait_ms percentiles) — None on peers without a sign lane
         self.sign_source = sign_source
+        # anything with the AsyncApplyEngine stats() shape
+        # (oldest_age_ms) — None on serial-commit peers
+        self.commit_source = commit_source
         if tracer is None:
             from fabric_tpu.observe import global_tracer
 
@@ -584,6 +599,14 @@ class Autopilot:
                     s.sign_fill = float(occ.get("p50") or 0.0) / bm
             except Exception as e:
                 _log.debug("autopilot: sign signal read failed: %s", e)
+        if self.commit_source is not None:
+            try:
+                st = self.commit_source.stats()
+                age = st.get("oldest_age_ms")
+                if age is not None:
+                    s.apply_queue_age_ms = float(age)
+            except Exception as e:
+                _log.debug("autopilot: commit signal read failed: %s", e)
         try:
             from fabric_tpu.observe import ledger as _ledger
 
@@ -743,10 +766,28 @@ class Autopilot:
                         value=burn, threshold=b["burn_hi"],
                         tenant=tenant,
                     )
-        # 3) queue backlog: coalesce more blocks per dispatch
+        # 3) queue backlog: coalesce more blocks per dispatch — UNLESS
+        #    the async commit engine's state-apply queue is itself
+        #    aging past its band: then the applier (not dispatch
+        #    overhead) is the bottleneck, and bigger groups only grow
+        #    the trailing lag.  High apply age instead steps coalesce
+        #    DOWN, shrinking the batches the applier must absorb.
         ages = [v for v in s.queue_age_p99_ms.values()]
         age_p99 = max(ages) if ages else None
-        if "coalesce_blocks" in self.values and age_p99 is not None:
+        apply_age = s.apply_queue_age_ms
+        apply_hot = (apply_age is not None
+                     and apply_age > b["apply_hi_ms"])
+        if "coalesce_blocks" in self.values and apply_hot:
+            if self._cool("coalesce_blocks", "", now):
+                step = self._step("coalesce_blocks", -1)
+                if step is not None:
+                    return Decision(
+                        t=now, knob="coalesce_blocks", direction="down",
+                        old=step[0], new=step[1],
+                        signal="apply_queue_age_ms", value=apply_age,
+                        threshold=b["apply_hi_ms"],
+                    )
+        elif "coalesce_blocks" in self.values and age_p99 is not None:
             if (age_p99 > b["queue_hi_ms"]
                     and self._cool("coalesce_blocks", "", now)):
                 step = self._step("coalesce_blocks", +1)
@@ -1065,6 +1106,7 @@ class Autopilot:
                 "busy_rate": dict(sorted(sigs.busy_rate.items())),
                 "launch_p99_ms": sigs.launch_p99_ms,
                 "device_queue_p99_ms": sigs.device_queue_p99_ms,
+                "apply_queue_age_ms": sigs.apply_queue_age_ms,
                 "overlap_coverage": sigs.overlap_coverage,
                 "prefetch_p99_ms": sigs.prefetch_p99_ms,
                 "clock_s": round(sigs.clock_s, 3),

@@ -15,6 +15,7 @@ from __future__ import annotations
 import os
 import sqlite3
 import struct
+import threading
 
 from google.protobuf.message import DecodeError
 
@@ -50,6 +51,11 @@ class BlockStore:
         self._unsynced = 0
         self._oldest_unsynced: float | None = None
         self._fsync_ctr = None  # lazy blockstore_fsync_total counter
+        # serializes segment-file writes/fsyncs between the committer
+        # thread (add_block) and the async engine's applier thread
+        # (ensure_synced — the durability fence); uncontended cost is
+        # one futex op per block
+        self._io_lock = threading.Lock()
         os.makedirs(dirpath, exist_ok=True)
         self._idx = sqlite3.connect(
             os.path.join(dirpath, "index.db"), check_same_thread=False
@@ -78,6 +84,11 @@ class BlockStore:
             " first_block INTEGER, prev_hash BLOB, commit_hash BLOB)"
         )
         self._recover()
+        # fsync watermark in block numbers: everything recovery left in
+        # the files is already durable (or was truncated away), so the
+        # synced watermark starts at the tip
+        self._last_appended = self.height - 1
+        self._synced_num = self._last_appended
 
     # -- segment file plumbing --------------------------------------------
 
@@ -298,41 +309,40 @@ class BlockStore:
             data = protoutil.append_block_metadata(hd_bytes, block)
         else:
             data = block.SerializeToString()
-        if self._fh.tell() + len(data) > _SEGMENT_MAX and self._fh.tell() > 0:
-            self.sync()  # a finished segment must be durable
-            self._fh.close()
-            self._seg += 1
-            self._fh = open(self._seg_path(self._seg), "ab")
-        off = self._fh.tell()
-        self._fh.write(_LEN.pack(len(data)))
-        self._fh.write(data)
-        self._fh.flush()
-        # group commit: amortize the fsync over a window of blocks
-        # (see __init__ for the replay-safety argument)
         import time as _time
 
-        self._unsynced += 1
-        if self._oldest_unsynced is None:
-            self._oldest_unsynced = _time.monotonic()
-        if (
-            self._unsynced >= self.group_commit
-            or _time.monotonic() - self._oldest_unsynced
-            >= self.group_max_lag_s
-        ):
-            # crash-consistency hooks: the kill-mid-fsync chaos tests
-            # exit the process HERE (before = the whole window is lost
-            # and _recover must truncate the torn tail; after = the
-            # window is durable) and assert replay to a consistent
-            # height on reopen
-            self._count_fsync(
-                "group" if self._unsynced >= self.group_commit
-                else "lag"
-            )
-            _faults.fire("ledger.fsync.before")
-            os.fsync(self._fh.fileno())
-            _faults.fire("ledger.fsync.after")
-            self._unsynced = 0
-            self._oldest_unsynced = None
+        with self._io_lock:
+            if (self._fh.tell() + len(data) > _SEGMENT_MAX
+                    and self._fh.tell() > 0):
+                # a finished segment must be durable
+                self._sync_locked("forced")
+                self._fh.close()
+                self._seg += 1
+                self._fh = open(self._seg_path(self._seg), "ab")
+            off = self._fh.tell()
+            self._fh.write(_LEN.pack(len(data)))
+            self._fh.write(data)
+            self._fh.flush()
+            self._last_appended = block.header.number
+            # group commit: amortize the fsync over a window of blocks
+            # (see __init__ for the replay-safety argument)
+            self._unsynced += 1
+            if self._oldest_unsynced is None:
+                self._oldest_unsynced = _time.monotonic()
+            if (
+                self._unsynced >= self.group_commit
+                or _time.monotonic() - self._oldest_unsynced
+                >= self.group_max_lag_s
+            ):
+                # crash-consistency hooks: the kill-mid-fsync chaos
+                # tests exit the process inside _sync_locked (before =
+                # the whole window is lost and _recover must truncate
+                # the torn tail; after = the window is durable) and
+                # assert replay to a consistent height on reopen
+                self._sync_locked(
+                    "group" if self._unsynced >= self.group_commit
+                    else "lag"
+                )
         self._index_block(block, self._seg, off, txids=txids)
         self._idx.commit()
         self._last_hash = protoutil.block_header_hash(block.header)
@@ -380,16 +390,40 @@ class BlockStore:
             yield blk
             num += 1
 
-    def sync(self) -> None:
-        """Force-fsync any group-commit window still open."""
+    def _sync_locked(self, trigger: str) -> None:
+        # caller holds self._io_lock
         if self._unsynced:
-            self._count_fsync("forced")
+            self._count_fsync(trigger)
             self._fh.flush()
             _faults.fire("ledger.fsync.before")
             os.fsync(self._fh.fileno())
             _faults.fire("ledger.fsync.after")
             self._unsynced = 0
             self._oldest_unsynced = None
+        self._synced_num = self._last_appended
+
+    def sync(self) -> None:
+        """Force-fsync any group-commit window still open."""
+        with self._io_lock:
+            self._sync_locked("forced")
+
+    @property
+    def synced_height(self) -> int:
+        """Highest block number known durable + 1 (mirrors ``height``
+        for the appended side) — the commit-engine postmortem reads
+        appended vs synced vs applied off these watermarks."""
+        return self._synced_num + 1
+
+    def ensure_synced(self, num: int) -> None:
+        """Durability fence: make every block up to ``num`` durable
+        before returning.  The async apply engine's applier calls this
+        in front of each state-DB apply so the durable savepoint can
+        never get ahead of the block files; when the group-commit
+        window already closed past ``num`` this is one lock op."""
+        with self._io_lock:
+            if num <= self._synced_num:
+                return
+            self._sync_locked("apply")
 
     def close(self):
         self.sync()

@@ -1,0 +1,436 @@
+"""Asynchronous group-commit storage engine: state apply off the
+block critical path.
+
+Analog of the reference committer's split (core/ledger/kvledger
+kvLedger.commit): the BLOCK-STORE append is the durability boundary —
+a block is committed once it is in the chain files — while the
+state-DB apply merely *trails* it and is reconstructible from those
+files through the savepoint/replay machinery (recoverDBs,
+kv_ledger.go:357).  Our serial engine paid the full SQLite apply on
+the commit critical path anyway; :class:`AsyncApplyEngine` moves it to
+an ordered background queue drained by one dedicated applier thread so
+the host side of a committed block approaches pure dispatch: append +
+enqueue.
+
+The engine is itself a :class:`~fabric_tpu.ledger.statedb.VersionedDB`
+wrapping the real backend, which is what makes the move safe:
+
+* **ordering** — one FIFO queue, one applier: batches land in commit
+  order, each under its own ``(block, 0)`` savepoint, exactly as the
+  serial engine would have landed them;
+* **read-your-writes** — every read (``get_state``, the bulk/column
+  version gathers, range scans, rich queries) consults the pending
+  overlay (newest batch first) in front of the inner DB, so MVCC
+  preloads, lifecycle queries and the resident-cache commit scatter
+  observe *identical* state to the synchronous engine — verdicts are
+  bit-equal by construction, not by luck;
+* **durability fence** — before applying block N against a *durable*
+  backend the applier calls ``blocks.ensure_synced(N)``: the durable
+  savepoint can never get ahead of the block files (the invariant the
+  serial engine enforced with an inline ``sync()`` per commit — moved
+  here, it also pulls those per-commit fsyncs off the critical path);
+* **backpressure** — the queue is bounded in BLOCKS; ``submit`` parks
+  the committer at the block boundary until the applier catches up, so
+  lag is never unbounded and crash-recovery replay stays short;
+* **crash recovery** — a crash loses at most the queued tail; on
+  reopen the state savepoint trails the block height and
+  ``KVLedger.recover`` replays the gap from the chain files.  The
+  ``ledger.apply.before``/``ledger.apply.after`` fault points let the
+  differential battery kill the applier at every queue depth.
+
+A failed apply latches: the applier stops (ordered apply cannot skip),
+and the error re-raises at the next ``submit``/``drain`` — fail-stop,
+never fail-skip.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+
+from fabric_tpu import faults as _faults
+from fabric_tpu.ledger.statedb import VersionedDB
+
+_log = logging.getLogger("fabric_tpu.ledger.committer")
+
+
+class _Pending:
+    """One queued block apply."""
+
+    __slots__ = ("num", "batch", "sp", "post_apply", "enqueued_at")
+
+    def __init__(self, num, batch, sp, post_apply, enqueued_at):
+        self.num = num
+        self.batch = batch
+        self.sp = sp
+        self.post_apply = post_apply
+        self.enqueued_at = enqueued_at
+
+
+def _merge_overlay(inner_iter, ov: dict):
+    """Merge a sorted ``(key, VersionedValue)`` iterator with an
+    overlay dict ``{key: VersionedValue | None}`` (None = the overlay
+    suppresses the row: a pending delete, or a pending rewrite that no
+    longer matches the caller's predicate).  Overlay wins on key
+    collision; output stays in key order."""
+    ks = sorted(ov)
+    i, n = 0, len(ks)
+    for key, vv in inner_iter:
+        while i < n and ks[i] < key:
+            o = ov[ks[i]]
+            if o is not None:
+                yield ks[i], o
+            i += 1
+        if i < n and ks[i] == key:
+            o = ov[ks[i]]
+            i += 1
+            if o is not None:
+                yield key, o
+        else:
+            yield key, vv
+    while i < n:
+        o = ov[ks[i]]
+        if o is not None:
+            yield ks[i], o
+        i += 1
+
+
+class AsyncApplyEngine(VersionedDB):
+    """Ordered background applier in front of a real VersionedDB.
+
+    The inner backend must already be open; ``close()`` drains the
+    queue, joins the applier and closes the inner DB.  The applier
+    thread starts lazily on the first ``submit`` so idle ledgers
+    (tests open hundreds) never park a thread.
+    """
+
+    def __init__(self, inner: VersionedDB, blocks=None,
+                 queue_blocks: int = 4, name: str = "state-applier"):
+        self._inner = inner
+        self._blocks = blocks  # durability fence (BlockStore), optional
+        self._capacity = max(1, int(queue_blocks))
+        self._name = name
+        self._cond = threading.Condition()
+        self._queue: deque[_Pending] = deque()
+        self._thread: threading.Thread | None = None
+        self._closing = False
+        self._error: BaseException | None = None
+        self._applied_num = -1
+        self._applies_total = 0
+        self._apply_s_total = 0.0
+        self._backpressure_total = 0
+        self._metrics = None  # lazy (gauge, hist, counter) bundle
+        # mirrored so KVLedger's getattr(state, "durable") keeps working
+        self.durable = getattr(inner, "durable", True)
+
+    # -- write side --------------------------------------------------------
+
+    def submit(self, num: int, batch, savepoint, post_apply=None) -> None:
+        """Enqueue one block's batch for ordered background apply.
+        Blocks at the block boundary while the queue is at capacity
+        (the backpressure latch).  ``post_apply`` (optional, no-arg)
+        runs on the applier thread after the batch lands — the
+        history-DB commit rides here."""
+        entry = _Pending(num, batch, savepoint, post_apply,
+                         time.monotonic())
+        with self._cond:
+            self._raise_if_failed()
+            waited = False
+            while (len(self._queue) >= self._capacity
+                   and self._error is None and not self._closing):
+                waited = True
+                self._cond.wait()
+            self._raise_if_failed()
+            if waited:
+                self._backpressure_total += 1
+            self._queue.append(entry)
+            if self._thread is None:
+                t = threading.Thread(target=self._apply_loop,
+                                     name=f"fabtpu-{self._name}",
+                                     daemon=True)
+                self._thread = t
+                t.start()
+            self._cond.notify_all()
+
+    def apply_updates(self, batch, savepoint) -> None:
+        """VersionedDB SPI: enqueue, preserving order with every
+        in-flight commit (recovery replay and the pvt BTL purge come
+        through here)."""
+        self.submit(savepoint[0] if savepoint else -1, batch, savepoint)
+
+    def _raise_if_failed(self):
+        # callers hold self._cond
+        if self._error is not None:
+            raise RuntimeError(
+                "state applier failed; the apply queue is fail-stop"
+            ) from self._error
+
+    def _apply_loop(self):
+        while True:
+            with self._cond:
+                while (not self._queue and not self._closing
+                       and self._error is None):
+                    self._cond.wait()
+                if self._error is not None or (self._closing
+                                               and not self._queue):
+                    return
+                entry = self._queue[0]  # stays queued: overlay serves it
+            try:
+                dur = self._apply_one(entry)
+            except BaseException as e:  # latch: ordered apply can't skip
+                _log.error("state apply of block %d failed: %s",
+                           entry.num, e)
+                with self._cond:
+                    self._error = e
+                    self._cond.notify_all()
+                return
+            with self._cond:
+                # abort() may have dropped the queue mid-apply
+                if self._queue and self._queue[0] is entry:
+                    self._queue.popleft()
+                self._applied_num = entry.num
+                self._applies_total += 1
+                self._apply_s_total += dur
+                self._cond.notify_all()
+            self._observe(dur)
+
+    def _apply_one(self, entry: _Pending) -> float:
+        _faults.fire("ledger.apply.before", block=entry.num)
+        if self._blocks is not None and getattr(self._inner, "durable",
+                                                True):
+            # a DURABLE savepoint must never get ahead of the block
+            # files (see module docstring) — fence before the apply
+            self._blocks.ensure_synced(entry.num)
+        t0 = time.perf_counter()
+        self._inner.apply_updates(entry.batch, entry.sp)
+        if entry.post_apply is not None:
+            entry.post_apply()
+        dur = time.perf_counter() - t0
+        _faults.fire("ledger.apply.after", block=entry.num)
+        return dur
+
+    # -- read side: pending overlay in front of the inner DB ---------------
+
+    def _pending(self) -> list[_Pending]:
+        with self._cond:
+            return list(self._queue)
+
+    def get_state(self, ns, key):
+        for entry in reversed(self._pending()):
+            vv = entry.batch.updates.get((ns, key))
+            if vv is not None:
+                return None if vv.value is None else vv
+        return self._inner.get_state(ns, key)
+
+    def get_versions_bulk(self, keys):
+        pend = self._pending()
+        if not pend:
+            return self._inner.get_versions_bulk(keys)
+        out, rest = {}, []
+        for k in keys:
+            for entry in reversed(pend):
+                vv = entry.batch.updates.get(k)
+                if vv is not None:
+                    if vv.value is not None:
+                        out[k] = vv.version
+                    break
+            else:
+                rest.append(k)
+        if rest:
+            out.update(self._inner.get_versions_bulk(rest))
+        return out
+
+    def get_versions_cols(self, keys):
+        present, vers = self._inner.get_versions_cols(keys)
+        pend = self._pending()
+        if pend:
+            for i, k in enumerate(keys):
+                for entry in reversed(pend):
+                    vv = entry.batch.updates.get(k)
+                    if vv is not None:
+                        if vv.value is None:
+                            present[i] = False
+                            vers[i] = 0
+                        else:
+                            present[i] = True
+                            vers[i] = vv.version
+                        break
+        return present, vers
+
+    def _overlay_for(self, ns, pend, keep):
+        """{key: vv-or-None} for every pending write in ``ns``;
+        ``keep(vv)`` False maps to None (suppress the row)."""
+        ov = {}
+        for entry in pend:  # oldest → newest: newest wins
+            for (n, k), vv in entry.batch.updates.items():
+                if n == ns:
+                    ov[k] = vv if keep(vv) else None
+        return ov
+
+    def get_state_range(self, ns, start, end, limit=0):
+        pend = self._pending()
+        if not pend:
+            yield from self._inner.get_state_range(ns, start, end, limit)
+            return
+        ov = self._overlay_for(
+            ns, pend,
+            lambda vv: vv.value is not None,
+        )
+        ov = {k: v for k, v in ov.items()
+              if k >= start and (not end or k < end)}
+        # pending deletes/rewrites can drop at most len(ov) inner rows
+        inner_limit = (limit + len(ov)) if limit else 0
+        n = 0
+        for key, vv in _merge_overlay(
+                self._inner.get_state_range(ns, start, end, inner_limit),
+                ov):
+            yield key, vv
+            n += 1
+            if limit and n >= limit:
+                return
+
+    def execute_query(self, ns, query, limit=0):
+        pend = self._pending()
+        if not pend:
+            yield from self._inner.execute_query(ns, query, limit)
+            return
+        import json
+
+        sel = query.get("selector", {})
+
+        def match(vv):
+            if vv.value is None:
+                return False
+            try:
+                doc = json.loads(vv.value)
+            except (ValueError, UnicodeDecodeError):
+                return False
+            return all(doc.get(f) == want for f, want in sel.items())
+
+        # a pending rewrite that no longer matches must SUPPRESS the
+        # committed row (the inner DB would still match it)
+        ov = self._overlay_for(ns, pend, match)
+        inner_limit = (limit + len(ov)) if limit else 0
+        n = 0
+        for key, vv in _merge_overlay(
+                self._inner.execute_query(ns, query, inner_limit), ov):
+            yield key, vv
+            n += 1
+            if limit and n >= limit:
+                return
+
+    def iter_all(self):
+        # snapshot export wants the WHOLE committed state: barrier
+        self.drain()
+        yield from self._inner.iter_all()
+
+    def savepoint(self):
+        with self._cond:
+            for entry in reversed(self._queue):
+                if entry.sp is not None:
+                    return entry.sp
+        return self._inner.savepoint()
+
+    @property
+    def meta_count(self):
+        """SBE gate: conservative — a pending batch carrying metadata
+        counts before the inner DB has seen it."""
+        with self._cond:
+            pend = sum(1 for e in self._queue
+                       if getattr(e.batch, "has_meta", False))
+        return self._inner.meta_count + pend
+
+    # -- lifecycle / introspection -----------------------------------------
+
+    def drain(self) -> None:
+        """Barrier: block until every queued batch has applied; raises
+        if the applier latched a failure."""
+        with self._cond:
+            while self._queue and self._error is None:
+                self._cond.wait(0.5)
+            self._raise_if_failed()
+
+    def wait_applied(self, num: int, timeout: float = 30.0) -> bool:
+        """Block until block ``num`` has applied (or timeout)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while (self._applied_num < num and self._error is None
+                   and time.monotonic() < deadline):
+                self._cond.wait(0.2)
+            self._raise_if_failed()
+            return self._applied_num >= num
+
+    def stats(self) -> dict:
+        """Queue telemetry for /vitals, bench extras, the autopilot's
+        apply-age signal and the blackbox postmortem."""
+        with self._cond:
+            depth = len(self._queue)
+            oldest = self._queue[0].enqueued_at if self._queue else None
+            out = {
+                "queue_depth": depth,
+                "queue_capacity": self._capacity,
+                "oldest_age_ms": ((time.monotonic() - oldest) * 1000.0
+                                  if oldest is not None else 0.0),
+                "applied_num": self._applied_num,
+                "applies_total": self._applies_total,
+                "apply_ms_total": self._apply_s_total * 1000.0,
+                "backpressure_total": self._backpressure_total,
+                "failed": self._error is not None,
+            }
+        return out
+
+    def _observe(self, dur: float) -> None:
+        m = self._metrics
+        if m is None:
+            from fabric_tpu.ops_metrics import global_registry
+
+            reg = global_registry()
+            m = self._metrics = (
+                reg.gauge("commit_apply_queue_depth",
+                          "pending state-apply batches"),
+                reg.histogram("commit_state_apply_seconds",
+                              "background state-DB apply per block"),
+                reg.counter("commit_state_applies_total",
+                            "state batches applied in the background"),
+            )
+        gauge, hist, ctr = m
+        with self._cond:
+            gauge.set(float(len(self._queue)))
+        hist.observe(dur)
+        ctr.add(1)
+
+    def abort(self) -> None:
+        """Crash-simulation seam for the differential battery: DROP the
+        pending queue without applying, stop the applier and close the
+        inner DB — the state the process would leave behind had it
+        died mid-queue.  Never called on a live peer."""
+        with self._cond:
+            self._queue.clear()
+            self._closing = True
+            self._cond.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+        self._inner.close()
+
+    def close(self) -> None:
+        abandoned = 0
+        with self._cond:
+            while self._queue and self._error is None:
+                self._cond.wait(0.5)
+            abandoned = len(self._queue)
+            self._closing = True
+            self._cond.notify_all()
+            err = self._error
+            t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+        self._inner.close()
+        if err is not None:
+            _log.error(
+                "state applier closed after a latched failure; %d "
+                "queued batches abandoned (recover() replays them "
+                "from the block files on reopen): %s", abandoned, err,
+            )

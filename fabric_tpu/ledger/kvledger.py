@@ -43,17 +43,63 @@ class KVLedger:
         ledger_dir: str,
         state_db: VersionedDB | None = None,
         enable_history: bool = True,
+        async_commit: bool = False,
+        apply_queue_blocks: int = 4,
     ):
+        """``async_commit``: state-DB apply trails the block append on
+        the background applier (ledger/committer.py) — reads stay
+        consistent through the engine's pending overlay, the bounded
+        queue (``apply_queue_blocks``) backpressures at the block
+        boundary.  The peer/bench layers turn this ON by default
+        (nodeconfig ``async_commit``, ``FABTPU_BENCH_ASYNC_COMMIT``);
+        the library default stays serial so direct KVLedger users get
+        apply-on-return semantics unless they opt in."""
         os.makedirs(ledger_dir, exist_ok=True)
         self.dir = ledger_dir
         self.blocks = BlockStore(os.path.join(ledger_dir, "chains"))
-        self.state = state_db or SqliteVersionedDB(os.path.join(ledger_dir, "state.db"))
-        self.state.open()
+        inner = state_db or SqliteVersionedDB(os.path.join(ledger_dir, "state.db"))
+        inner.open()
+        self.engine = None
+        if async_commit:
+            from fabric_tpu.ledger.committer import AsyncApplyEngine
+
+            self.engine = AsyncApplyEngine(
+                inner, blocks=self.blocks,
+                queue_blocks=apply_queue_blocks,
+            )
+        self.state = self.engine if self.engine is not None else inner
+        self._reconcile_on_open()
         self.history = (
             HistoryDB(os.path.join(ledger_dir, "history.db")) if enable_history else None
         )
         self.pvtdata = PvtDataStore(os.path.join(ledger_dir, "pvtdata.db"))
         self._commit_hash: bytes | None = self._load_last_commit_hash()
+        # per-commit critical-path decomposition (ledger_append = block
+        # store + pvtdata, state_apply = state/history/purge — under
+        # the async engine the latter is enqueue + backpressure only)
+        self.last_commit_timings: dict = {}
+        self._commit_hists = None  # lazy registry histograms
+
+    def _reconcile_on_open(self) -> None:
+        """Height/savepoint reconciliation (recoverDBs preamble): the
+        savepoint BEHIND the block height is the normal crash shape —
+        recover() replays the gap from the chain files.  A savepoint
+        AHEAD of the files (a crash-truncated block tail under a
+        durable state DB) cannot be replayed from anywhere; flag it
+        loudly — redelivery from ordering re-commits the missing
+        blocks and the savepoint self-heals by overwrite."""
+        try:
+            sp = self.state.savepoint()
+        except Exception as e:
+            _log.debug("savepoint unreadable at open (fresh or "
+                       "still-initializing state DB): %s", e)
+            return
+        height = self.blocks.height
+        if sp is not None and sp[0] + 1 > height:
+            _log.warning(
+                "state savepoint %s is ahead of block height %d; "
+                "awaiting block redelivery to reconcile", sp, height,
+            )
 
     # -- commit hash chain -------------------------------------------------
 
@@ -91,6 +137,8 @@ class KVLedger:
         txids: list | None = None,
         hd_bytes: bytes | None = None,
     ) -> None:
+        import time as _time
+
         num = block.header.number
         if num != self.blocks.height:
             raise ValueError(f"commit out of order: {num} vs height {self.blocks.height}")
@@ -101,23 +149,58 @@ class KVLedger:
             block.metadata.metadata.append(b"")
         block.metadata.metadata[idx] = commit_hash
 
+        t0 = _time.perf_counter()
         self.blocks.add_block(block, txids=txids, hd_bytes=hd_bytes)
         if pvt_data:
             self.pvtdata.commit_block(num, pvt_data)
-        if getattr(self.state, "durable", True):
-            # a DURABLE state savepoint must never get ahead of the
-            # block files (recover() replays forward from the
-            # savepoint; a savepoint past a crash-truncated store
-            # would skip replay and fork the peer) — close the group
-            # window before the state commit.  Non-durable backends
-            # (mem) recover by full replay, so they keep the
-            # amortized-fsync fast path.
-            self.blocks.sync()
-        self.state.apply_updates(batch, (num, 0))
-        if self.history is not None and history_writes:
-            self.history.commit_block(num, history_writes)
+        t1 = _time.perf_counter()
+        if self.engine is not None:
+            # decoupled committer: the block is committed (appended);
+            # state apply trails on the applier thread, which also
+            # enforces the durability fence (ensure_synced) and runs
+            # the history commit post-apply.  Cost here is enqueue +
+            # any backpressure wait.
+            post_apply = None
+            if self.history is not None and history_writes:
+                hist = self.history
+
+                def post_apply(hist=hist, num=num, hw=history_writes):
+                    hist.commit_block(num, hw)
+
+            self.engine.submit(num, batch, (num, 0), post_apply=post_apply)
+        else:
+            if getattr(self.state, "durable", True):
+                # a DURABLE state savepoint must never get ahead of the
+                # block files (recover() replays forward from the
+                # savepoint; a savepoint past a crash-truncated store
+                # would skip replay and fork the peer) — close the
+                # group window before the state commit.  Non-durable
+                # backends (mem) recover by full replay, so they keep
+                # the amortized-fsync fast path.
+                self.blocks.sync()
+            self.state.apply_updates(batch, (num, 0))
+            if self.history is not None and history_writes:
+                self.history.commit_block(num, history_writes)
         self._purge_expired_pvt(num)
+        t2 = _time.perf_counter()
         self._commit_hash = commit_hash
+        self.last_commit_timings = {
+            "ledger_append": t1 - t0,
+            "state_apply": t2 - t1,
+        }
+        hists = self._commit_hists
+        if hists is None:
+            from fabric_tpu.ops_metrics import global_registry
+
+            reg = global_registry()
+            hists = self._commit_hists = (
+                reg.histogram("ledger_append_seconds",
+                              "block-store append on the commit path"),
+                reg.histogram("ledger_state_apply_seconds",
+                              "state apply (or enqueue) on the commit path"),
+            )
+        hists[0].observe(t1 - t0)
+        hists[1].observe(t2 - t1)
 
     def _purge_expired_pvt(self, num: int) -> None:
         """BTL expiry at the block boundary (pvtstatepurgemgmt analog):
@@ -181,7 +264,16 @@ class KVLedger:
                 if hsp is None or hsp < num:
                     self.history.commit_block(num, history_writes)
             replayed += 1
+        # replay applies ride the normal queue under the async engine;
+        # recovery is a barrier — callers read state right after
+        self.drain_state()
         return replayed
+
+    def drain_state(self) -> None:
+        """Barrier on the async apply queue (no-op for the serial
+        engine): returns once every enqueued batch has applied."""
+        if self.engine is not None:
+            self.engine.drain()
 
     @property
     def height(self) -> int:
@@ -197,8 +289,13 @@ class KVLedger:
         self._commit_hash = h
 
     def close(self):
-        self.blocks.close()
-        self.state.close()
-        if self.history is not None:
-            self.history.close()
-        self.pvtdata.close()
+        try:
+            # state first: the async engine drains here, and its
+            # applier fences against self.blocks / commits history —
+            # both must still be open
+            self.state.close()
+        finally:
+            self.blocks.close()
+            if self.history is not None:
+                self.history.close()
+            self.pvtdata.close()

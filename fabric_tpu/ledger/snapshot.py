@@ -146,7 +146,9 @@ def verify_snapshot(snap_dir: str) -> dict:
 
 
 def create_from_snapshot(snap_dir: str, ledger_dir: str, state_db=None,
-                         enable_history: bool = True):
+                         enable_history: bool = True,
+                         async_commit: bool = False,
+                         apply_queue_blocks: int = 4):
     """Build a fresh KVLedger positioned at the snapshot boundary
     (CreateFromSnapshot, kvledger/snapshot.go:222).
 
@@ -156,7 +158,9 @@ def create_from_snapshot(snap_dir: str, ledger_dir: str, state_db=None,
     from fabric_tpu.ledger.kvledger import KVLedger
 
     meta = verify_snapshot(snap_dir)
-    lg = KVLedger(ledger_dir, state_db=state_db, enable_history=enable_history)
+    lg = KVLedger(ledger_dir, state_db=state_db, enable_history=enable_history,
+                  async_commit=async_commit,
+                  apply_queue_blocks=apply_queue_blocks)
     if lg.blocks.height != 0:
         raise ValueError("ledger directory is not empty")
 

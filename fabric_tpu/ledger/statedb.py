@@ -54,6 +54,12 @@ class UpdateBatch:
     def items(self):
         return self.updates.items()
 
+    def touches_namespace(self, ns: str) -> bool:
+        """True when any entry writes ``ns`` — the lifecycle-barrier
+        and post-commit scans use this instead of walking (and, for
+        the columnar form, materializing) the full update dict."""
+        return any(k[0] == ns for k in self.updates)
+
     @classmethod
     def merged(cls, batches):
         """One overlay view over a CHAIN of in-flight predecessor
@@ -81,6 +87,138 @@ class UpdateBatch:
             if b.has_meta:
                 out.has_meta = True
         return out
+
+
+class ColumnarUpdateBatch(UpdateBatch):
+    """Columnar UpdateBatch built straight off the validator's flat
+    write slabs — no per-key Python tuples or VersionedValue objects
+    on the commit path.
+
+    Rows live in numpy arrays in FINAL APPLY ORDER (the concatenation,
+    tx by tx, of each valid tx's (ns, key)-sorted writes — exactly the
+    store order of ``_build_updates_flat``); key/namespace strings are
+    indices into the block's shared unique-key table, and values are
+    offset/length spans over the shared ``blob``.  The classic dict
+    form stays available through the lazy ``updates`` property
+    (identical content AND insertion order, so every overlay consumer
+    — launch overlays, ``merged()``, the mem backend — behaves
+    byte-for-byte like the dict batch), while
+    ``SqliteVersionedDB.apply_updates`` consumes the slabs directly:
+    one ``executemany`` per namespace, zero-copy memoryview value
+    slices.
+
+    ``put``/``delete`` after construction (the pvt hashed-write phase,
+    BTL purge) land in a small ``_extra`` override dict that shadows
+    the slab rows everywhere.
+    """
+
+    def __init__(self, block_num: int, ns_names: list, ukeys: list,
+                 ns_of, row_uid, row_del, row_voff, row_vlen,
+                 row_txnum, blob: bytes):
+        # no super().__init__: ``updates`` is a lazy property here
+        self.block_num = block_num
+        self.ns_names = ns_names
+        self.ukeys = ukeys
+        self.ns_of = ns_of          # [n_keys] uid -> ns index
+        self.row_uid = row_uid      # [R] apply-ordered key ids
+        self.row_del = row_del      # [R] bool
+        self.row_voff = row_voff    # [R] value span over blob
+        self.row_vlen = row_vlen
+        self.row_txnum = row_txnum  # [R] tx num (version minor)
+        self.blob = blob
+        self.has_meta = False
+        self._extra: dict = {}      # post-build overrides
+        self._updates: dict | None = None
+
+    @property
+    def updates(self):
+        u = self._updates
+        if u is None:
+            # build into a local and publish last: readers on other
+            # threads (the background applier vs. an overlay read) may
+            # materialize concurrently — both build the same dict and
+            # the single attribute store keeps it race-free
+            u = self._materialize()
+            self._updates = u
+        return u
+
+    def _materialize(self) -> dict:
+        d: dict = {}
+        ns_names, ukeys, ns_of = self.ns_names, self.ukeys, self.ns_of
+        blob, bn = self.blob, self.block_num
+        uid_l = self.row_uid.tolist()
+        del_l = self.row_del.tolist()
+        vo_l = self.row_voff.tolist()
+        vl_l = self.row_vlen.tolist()
+        tx_l = self.row_txnum.tolist()
+        for r, uid in enumerate(uid_l):
+            if del_l[r]:
+                val = None
+            else:
+                vo = vo_l[r]
+                val = blob[vo:vo + vl_l[r]]
+            d[(ns_names[ns_of[uid]], ukeys[uid])] = VersionedValue(
+                val, None, (bn, tx_l[r])
+            )
+        d.update(self._extra)
+        return d
+
+    def put(self, ns, key, value, version, metadata=None):
+        if metadata:
+            self.has_meta = True
+        vv = VersionedValue(value, metadata, version)
+        self._extra[(ns, key)] = vv
+        if self._updates is not None:
+            self._updates[(ns, key)] = vv
+
+    def touches_namespace(self, ns: str) -> bool:
+        if any(k[0] == ns for k in self._extra):
+            return True
+        try:
+            idx = self.ns_names.index(ns)
+        except ValueError:
+            return False
+        if not len(self.row_uid):
+            return False
+        import numpy as np
+
+        return bool(np.any(np.asarray(self.ns_of)[self.row_uid] == idx))
+
+    def sqlite_columns(self):
+        """→ yields ``(deletes, rows)`` per namespace for the sqlite
+        fast path: ``deletes`` = [(ns, key)], ``rows`` = executemany
+        tuples with zero-copy memoryview value slices.  Per-key
+        last-wins dedupe (a later tx's write of the same key shadows
+        the earlier row, exactly like the dict build), and rows
+        shadowed by ``_extra`` overrides are skipped — the caller
+        applies the extras through the classic per-key path."""
+        last: dict = {}  # uid -> last row index
+        for r, uid in enumerate(self.row_uid.tolist()):
+            last[uid] = r
+        extras = self._extra
+        ns_names, ukeys, ns_of = self.ns_names, self.ukeys, self.ns_of
+        mv = memoryview(self.blob)
+        bn = self.block_num
+        per_ns_del: dict = {}
+        per_ns_row: dict = {}
+        for uid, r in last.items():
+            ns = ns_names[ns_of[uid]]
+            key = ukeys[uid]
+            if extras and (ns, key) in extras:
+                continue
+            if self.row_del[r]:
+                per_ns_del.setdefault(ns, []).append((ns, key))
+            else:
+                vo = int(self.row_voff[r])
+                per_ns_row.setdefault(ns, []).append(
+                    (ns, key, mv[vo:vo + int(self.row_vlen[r])], None,
+                     bn, int(self.row_txnum[r]))
+                )
+        for ns in sorted(set(per_ns_del) | set(per_ns_row)):
+            yield per_ns_del.get(ns, ()), per_ns_row.get(ns, ())
+
+    def extra_items(self):
+        return self._extra.items()
 
 
 class VersionedDB:
@@ -380,7 +518,25 @@ class SqliteVersionedDB(VersionedDB):
         # per-key decrement probe is skippable (keeps the common
         # no-SBE channel free of per-write SELECTs)
         track = self.meta_count > 0
-        for (ns, key), vv in batch.items():
+        if (not track and not batch.has_meta
+                and isinstance(batch, ColumnarUpdateBatch)):
+            # columnar fast path: one executemany per namespace over
+            # the validator's slabs — no dict materialization, no
+            # VersionedValue churn, zero-copy value blobs
+            for dels, rows in batch.sqlite_columns():
+                if dels:
+                    cur.executemany(
+                        "DELETE FROM state WHERE ns=? AND key=?", dels
+                    )
+                if rows:
+                    cur.executemany(
+                        "INSERT OR REPLACE INTO state VALUES (?,?,?,?,?,?)",
+                        rows,
+                    )
+            items = batch.extra_items()
+        else:
+            items = batch.items()
+        for (ns, key), vv in items:
             if track:
                 row = cur.execute(
                     "SELECT metadata FROM state WHERE ns=? AND key=?",

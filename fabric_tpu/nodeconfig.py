@@ -95,6 +95,17 @@ class PeerConfig:
     tls: TlsConfig | None = None
     # ledger/commit knobs
     group_commit: int = 8            # blockstore fsync window (blocks)
+    # async group-commit storage engine (ledger/committer.py): block
+    # append stays synchronous (the durability boundary), state-DB
+    # apply trails on a dedicated applier thread behind a pending-batch
+    # read overlay — verdicts stay bit-equal to the serial engine.
+    # False = serial fallback (state applied before commit_block
+    # returns, the pre-PR-17 critical path).
+    async_commit: bool = True
+    # apply-queue bound in BLOCKS: commit_block backpressures at the
+    # block boundary once this many batches trail, so apply lag (and
+    # crash-recovery replay) stays bounded
+    apply_queue_blocks: int = 4
     transient_retention: int = 100   # transient-store purge horizon
     deliver_censorship_check_s: float = 2.0
     # commit pipeline (peer/pipeline.py CommitPipeline): depth 2 =
@@ -535,6 +546,12 @@ def _load(cls, source, environ=None):
         raise ConfigError(
             f"key 'pipeline_depth': must be >= 1 (1 = serial, 2 = "
             f"classic overlap, N = deep window), got {cfg.pipeline_depth}"
+        )
+    if isinstance(cfg, PeerConfig) and cfg.apply_queue_blocks < 1:
+        raise ConfigError(
+            f"key 'apply_queue_blocks': must be >= 1 trailing batch "
+            f"(the bound is what keeps apply lag and crash-recovery "
+            f"replay finite), got {cfg.apply_queue_blocks}"
         )
     if isinstance(cfg, PeerConfig) and cfg.host_stage_mode not in (
             "thread", "process"):

@@ -90,7 +90,7 @@ class BlackBox:
                  max_bytes: int = DEFAULT_MAX_BYTES,
                  sampler=None, tracer=None, scheduler=None,
                  autopilot=None, slo=None, registry=None,
-                 clock=time.monotonic):
+                 commit_source=None, clock=time.monotonic):
         self.out_dir = str(out_dir or "")
         self.max_bundles = max(1, int(max_bundles))
         self.min_interval_s = float(min_interval_s)
@@ -103,6 +103,10 @@ class BlackBox:
         self.scheduler = scheduler
         self._autopilot = autopilot
         self._slo = slo
+        # commit-engine postmortem source: anything with report() →
+        # per-channel apply-queue stats + applied-vs-appended heights
+        # (PeerNode wires its channels; absent on engine-less hosts)
+        self.commit_source = commit_source
         self.clock = clock
         self._lock = threading.Lock()
         self._bundles: deque = deque(maxlen=self.max_bundles)
@@ -240,6 +244,10 @@ class BlackBox:
                  lambda: exemplars_report(self._registry) or None)
         if self.scheduler is not None:
             grab("scheduler", self.scheduler.stats)
+        if self.commit_source is not None:
+            # the decoupled committer's last word: how far state apply
+            # trailed the appended chain when the incident fired
+            grab("commit_engine", self.commit_source.report)
         if slo is not None and getattr(slo, "objectives", ()):
             grab("slo", slo.report)
         from fabric_tpu import faults
@@ -321,7 +329,7 @@ class BlackBox:
                     k for k in b
                     if k in ("vitals", "traces", "autopilot",
                              "scheduler", "slo", "faults", "launches",
-                             "exemplars")
+                             "exemplars", "commit_engine")
                 ),
                 "truncated": b.get("truncated", []),
             })
@@ -427,7 +435,8 @@ def configure(out_dir: str = "",
               max_bytes: int = DEFAULT_MAX_BYTES,
               sampler=None, tracer=None, scheduler=None,
               autopilot=None, slo=None, registry=None,
-              clock=time.monotonic, enabled: bool = True,
+              commit_source=None, clock=time.monotonic,
+              enabled: bool = True,
               ) -> BlackBox | None:
     """Arm (or, with ``enabled=False``, disarm) the process-global
     recorder — the nodeconfig ``blackbox_dir`` knob lands here.  The
@@ -442,7 +451,8 @@ def configure(out_dir: str = "",
         out_dir=out_dir, max_bundles=max_bundles,
         min_interval_s=min_interval_s, max_bytes=max_bytes,
         sampler=sampler, tracer=tracer, scheduler=scheduler,
-        autopilot=autopilot, slo=slo, registry=registry, clock=clock,
+        autopilot=autopilot, slo=slo, registry=registry,
+        commit_source=commit_source, clock=clock,
     )
     if not _hooks_installed:
         import atexit

@@ -73,7 +73,9 @@ class PeerChannel:
                  sidecar_endpoint: str = "",
                  sidecar_weight: float = 1.0,
                  sidecar_recovery_s: float = 5.0,
-                 sidecar_ssl=None):
+                 sidecar_ssl=None,
+                 async_commit: bool = True,
+                 apply_queue_blocks: int = 4):
         self.id = channel_id
         # block-commit span tracer knobs (nodeconfig trace_ring_blocks
         # / trace_slow_factor): configure the process-global tracer the
@@ -99,10 +101,17 @@ class PeerChannel:
             from fabric_tpu.ledger.snapshot import create_from_snapshot
 
             self.ledger, snap_meta = create_from_snapshot(
-                snapshot_dir, data_dir, state_db=state_db or MemVersionedDB()
+                snapshot_dir, data_dir, state_db=state_db or MemVersionedDB(),
+                async_commit=async_commit,
+                apply_queue_blocks=apply_queue_blocks,
             )
         else:
-            self.ledger = KVLedger(data_dir, state_db=state_db or MemVersionedDB())
+            # async group-commit storage engine (nodeconfig
+            # ``async_commit``, default ON): state apply trails the
+            # block append on the ledger's applier thread
+            self.ledger = KVLedger(data_dir, state_db=state_db or MemVersionedDB(),
+                                   async_commit=async_commit,
+                                   apply_queue_blocks=apply_queue_blocks)
         config = None
         if genesis_block is not None:
             from fabric_tpu.protos import configtx_pb2
@@ -512,19 +521,19 @@ class PeerChannel:
 
         # an upgrade (new committed sequence → possibly a new package/
         # endpoint) must drop lazily-resolved ccaas bindings
+        wrote_lifecycle = batch.touches_namespace(LIFECYCLE_NS)
         rt = getattr(self, "runtime", None)
-        if rt is not None and any(
-            ns == LIFECYCLE_NS for (ns, _k) in batch.updates
-        ):
+        if rt is not None and wrote_lifecycle:
             rt.invalidate_resolved()
 
-        prefix = "namespaces/fields/"
-        for (ns, key), vv in batch.items():
-            if ns == LIFECYCLE_NS and key.startswith(prefix)                     and key.endswith("/Definition") and vv.value:
-                cc_name = key[len(prefix):-len("/Definition")]
-                self.confighistory.record(
-                    block.header.number, cc_name, vv.value
-                )
+        if wrote_lifecycle:
+            prefix = "namespaces/fields/"
+            for (ns, key), vv in batch.items():
+                if ns == LIFECYCLE_NS and key.startswith(prefix)                         and key.endswith("/Definition") and vv.value:
+                    cc_name = key[len(prefix):-len("/Definition")]
+                    self.confighistory.record(
+                        block.header.number, cc_name, vv.value
+                    )
         proc = self.validator.config_processor
         if proc is None or not hasattr(proc, "apply"):
             return
@@ -1126,7 +1135,9 @@ class PeerNode:
                  sidecar_recovery_s: float = 5.0,
                  sidecar_listen: str = "",
                  sidecar_queue_blocks: int = 8,
-                 sidecar_coalesce: int = 4):
+                 sidecar_coalesce: int = 4,
+                 async_commit: bool = True,
+                 apply_queue_blocks: int = 4):
         self.id = node_id
         self.dir = data_dir
         self.msp = msp_manager
@@ -1135,6 +1146,10 @@ class PeerNode:
         # commit-path knobs every joined channel inherits (nodeconfig
         # pipeline_depth / verify_chunk / mesh_devices / coalesce_blocks)
         self.pipeline_depth = int(pipeline_depth)
+        # async group-commit storage engine (nodeconfig async_commit /
+        # apply_queue_blocks, default ON; False = serial fallback)
+        self.async_commit = bool(async_commit)
+        self.apply_queue_blocks = int(apply_queue_blocks)
         self.verify_chunk = int(verify_chunk)
         self.mesh_devices = int(mesh_devices)
         self.coalesce_blocks = int(coalesce_blocks)
@@ -1399,6 +1414,8 @@ class PeerNode:
             sidecar_weight=self.sidecar_weight,
             sidecar_recovery_s=self.sidecar_recovery_s,
             sidecar_ssl=self.tls.client_ctx() if self.tls else None,
+            async_commit=self.async_commit,
+            apply_queue_blocks=self.apply_queue_blocks,
         )
         ch.client_ssl = self.tls.client_ctx() if self.tls else None
         ch.runtime = self.runtime  # resolved-binding invalidation hook
@@ -1537,6 +1554,25 @@ class PeerNode:
                         and self.sign_batcher is not None):
                     self.sign_batcher.set_wait_ms(float(value))
 
+            def _commit_stats():
+                # worst trailing state-apply queue age across this
+                # node's channels (same snapshot idiom as _apply:
+                # join_channel mutates the dict on the event loop).
+                # Serial-commit channels have no engine and contribute
+                # nothing — an empty dict reads as signal-absent, so a
+                # fully-serial node never fires the apply rule.
+                ages = [
+                    float(ch.ledger.engine.stats()
+                          .get("oldest_age_ms", 0.0))
+                    for ch in list(self.channels.values())
+                    if getattr(ch.ledger, "engine", None) is not None
+                ]
+                return {"oldest_age_ms": max(ages)} if ages else {}
+
+            from types import SimpleNamespace
+
+            commit_src = SimpleNamespace(stats=_commit_stats)
+
             sched = (self.sidecar_server.scheduler
                      if self.sidecar_server is not None else None)
             # the host-workers ladder clamps to this machine's cores
@@ -1561,6 +1597,7 @@ class PeerNode:
                 set_shed=(sched.set_shed if sched else None),
                 slo=global_engine(), scheduler=sched,
                 sign_source=self.sign_batcher,
+                commit_source=commit_src,
                 tick_s=self.autopilot_tick_s,
                 initial={
                     "coalesce_blocks": self.coalesce_blocks,
@@ -1597,10 +1634,29 @@ class PeerNode:
                     interval_s=self.vitals_interval_s,
                     retention=self.vitals_retention,
                 )
+            def _commit_report():
+                # the commit-engine postmortem rows: apply-queue stats
+                # plus applied-vs-appended height per async channel —
+                # a crash bundle must answer "how far did state apply
+                # trail the durable chain" without the process
+                out = {}
+                for cid, ch in list(self.channels.items()):
+                    eng = getattr(ch.ledger, "engine", None)
+                    if eng is None:
+                        continue
+                    st = eng.stats()
+                    st["appended_height"] = ch.ledger.height
+                    st["synced_height"] = ch.ledger.blocks.synced_height
+                    out[cid] = st
+                return out or None
+
+            from types import SimpleNamespace as _NS
+
             self.blackbox = _blackbox.acquire(
                 out_dir=self.blackbox_dir,
                 scheduler=(self.sidecar_server.scheduler
                            if self.sidecar_server is not None else None),
+                commit_source=_NS(report=_commit_report),
             )
         if self.device_ledger:
             # device-time launch ledger: per-launch compile/queue/

@@ -162,7 +162,7 @@ class _SliceFuture:
 def _is_barrier(pend, batch) -> bool:
     """True for blocks that rotate validation inputs: commit fully,
     drop the overlay, before the successor may launch."""
-    return any(k[0] == "_lifecycle" for k in batch.updates) or any(
+    return batch.touches_namespace("_lifecycle") or any(
         p.is_config for p in pend.txs
     )
 

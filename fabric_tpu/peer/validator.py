@@ -2282,24 +2282,37 @@ class BlockValidator:
         return tx_filter, batch, history
 
     def _build_updates_flat(self, block_num: int, txs, rwp, ns_names, ukeys):
-        """Update batch + history from the native flat write arrays —
-        byte-identical output (incl. per-tx (ns, key) sort order) to
-        _build_updates over parsed rwsets.  Key strings come from the
+        """Columnar update batch + history from the native flat write
+        arrays — the batch keeps the validator's numpy slabs
+        (ColumnarUpdateBatch) so the sqlite backend can apply it with
+        one executemany per namespace, and its lazy ``updates`` dict is
+        byte-identical (incl. per-tx (ns, key) sort order) to the old
+        eager build over parsed rwsets.  Key strings come from the
         already-decoded unique-key table (``ukeys``)."""
-        from fabric_tpu.ledger.statedb import VersionedValue
+        from fabric_tpu.ledger.statedb import ColumnarUpdateBatch
 
-        batch = UpdateBatch()
-        updates = batch.updates
         history = []
-        blob = rwp.blob
         nw = rwp.n_writes  # slice REAL rows; the arrays are capacity-sized
-        w_uid = rwp.w_uid[:nw].tolist()
-        w_is_del = rwp.w_is_del[:nw].tolist()
-        vo_l = rwp.w_val_span[:nw, 0].tolist()
-        vl_l = rwp.w_val_span[:nw, 1].tolist()
-        ns_of = rwp.ns_of_ukey[:rwp.n_keys].tolist()
+        nk = rwp.n_keys
+        w_uid = rwp.w_uid[:nw]
+        w_is_del = rwp.w_is_del[:nw]
+        vo = rwp.w_val_span[:nw, 0]
+        vl = rwp.w_val_span[:nw, 1]
+        neg = vo < 0
+        if neg.any():  # negative span = empty value, normalize to b""
+            vo = np.where(neg, 0, vo)
+            vl = np.where(neg, 0, vl)
+        ns_of = rwp.ns_of_ukey[:nk].tolist()
+        # per-uid apply rank: ONE sort of the unique-key table by
+        # (ns, key) replaces the old per-tx row-tuple sorts
+        order = sorted(range(nk),
+                       key=lambda u: (ns_names[ns_of[u]], ukeys[u]))
+        rank = np.empty(nk, np.int64)
+        rank[order] = np.arange(nk)
         w_start = rwp.w_start.tolist()
         w_count = rwp.w_count.tolist()
+        row_sel = []   # global row indices in final apply order
+        txn_chunks = []
         for ptx in txs:
             if ptx.code != C.VALID:
                 continue
@@ -2307,20 +2320,23 @@ class BlockValidator:
             s, c = w_start[i], w_count[i]
             if not c:
                 continue
-            rows = []
-            for k in range(s, s + c):
-                uid = w_uid[k]
-                if w_is_del[k]:
-                    val = None
-                else:
-                    vo = vo_l[k]
-                    val = blob[vo:vo + vl_l[k]] if vo >= 0 else b""
-                rows.append((ns_names[ns_of[uid]], ukeys[uid], val))
-            rows.sort(key=lambda t: (t[0], t[1]))
-            ver = (block_num, i)
-            for ns, key, val in rows:
-                updates[(ns, key)] = VersionedValue(val, None, ver)
-                history.append((ns, key, i))
+            uids = w_uid[s:s + c]
+            ord_ = np.argsort(rank[uids], kind="stable")
+            row_sel.append(np.arange(s, s + c)[ord_])
+            txn_chunks.append(np.full(c, i, np.int64))
+            for uid in uids[ord_].tolist():
+                history.append((ns_names[ns_of[uid]], ukeys[uid], i))
+        if row_sel:
+            rows = np.concatenate(row_sel)
+            row_txnum = np.concatenate(txn_chunks)
+        else:
+            rows = np.zeros(0, np.int64)
+            row_txnum = np.zeros(0, np.int64)
+        batch = ColumnarUpdateBatch(
+            block_num, ns_names, ukeys, ns_of,
+            w_uid[rows], w_is_del[rows], vo[rows], vl[rows],
+            row_txnum, rwp.blob,
+        )
         return batch, history
 
     def _mvcc_inputs(self, txs, overlay=None):

@@ -190,7 +190,10 @@ class SidecarLink:
         disconnect/re-register).  Returns True on a server ack; False
         when detached (the new weight still rides the next hello, so
         the change survives a reconnect either way)."""
-        self.weight = float(weight)
+        # GIL-atomic float publish read by the loop at the next
+        # (re)hello; a one-frame-stale weight is the documented
+        # semantics, not corruption
+        self.weight = float(weight)  # fabtpu: noqa(FT017)
         if self._closed or self._stream is None:
             return False
         try:
@@ -391,7 +394,11 @@ class SidecarLink:
         """Drop the dead connection and fail everything in flight —
         callers fall back locally and the NEXT submit reconnects."""
         cli, self._client = self._client, None
-        self._stream = None
+        # GIL-atomic pointer clear; the sync surface's only unlocked
+        # access is the `attached` liveness peek, where a one-frame
+        # stale answer is indistinguishable from losing the
+        # connection a microsecond later
+        self._stream = None  # fabtpu: noqa(FT017)
         task, self._reader_task = self._reader_task, None
         if task is not None and not task.done():
             task.cancel()

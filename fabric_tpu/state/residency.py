@@ -198,13 +198,16 @@ class ResidencyManager:
 
     def __init__(self, capacity_mb: int = 64, range_bits: int = 12,
                  mesh=None, channel: str = "", registry=None,
-                 slots: int | None = None):
+                 slots: int | None = None,
+                 write_admit_budget: int = 2):
         if capacity_mb < 1:
             raise ValueError("state_resident_mb must be >= 1")
         if not (1 <= int(range_bits) <= 24):
             raise ValueError(
                 "state_resident_range_bits must be in [1, 24]"
             )
+        if int(write_admit_budget) < 0:
+            raise ValueError("write_admit_budget must be >= 0")
         if slots is not None:
             # explicit slot count — the test seam that makes eviction
             # churn drivable without a megabyte working set
@@ -219,6 +222,12 @@ class ResidencyManager:
                 MIN_SLOTS, 1 << (max(want, 1).bit_length() - 1)
             )
         self.range_bits = int(range_bits)
+        # per-apply_batch cap on BRAND-NEW ranges a block's write-set
+        # may open in the table (free slots only, never evicting):
+        # write-once traffic shapes (serial keys, audit logs) would
+        # otherwise open a new range every block and starve the
+        # read-tuned LRU of free slots
+        self.write_admit_budget = int(write_admit_budget)
         self.mesh = mesh
         self.channel = channel
         self._lock = threading.Lock()
@@ -237,6 +246,7 @@ class ResidencyManager:
         self._misses_total = 0
         self._overlay_forced_total = 0
         self._evictions_total = 0
+        self._write_admits_total = 0
         self._h2d_bytes_total = 0
         if registry is None:
             from fabric_tpu.ops_metrics import global_registry
@@ -258,6 +268,11 @@ class ResidencyManager:
         self._evict_ctr = registry.counter(
             "state_resident_evictions_total",
             "key ranges evicted from the device-resident table (LRU)",
+        )
+        self._write_admit_ctr = registry.counter(
+            "state_resident_write_admits_total",
+            "brand-new key ranges the commit write path admitted into "
+            "the resident table (budgeted per block, free slots only)",
         )
         self._hit_gauge = registry.gauge(
             "state_resident_hit_rate",
@@ -534,9 +549,13 @@ class ResidencyManager:
 
         Keys with a slot are updated in place (deletes scatter
         present=0 — cached absence).  A written key WITHOUT a slot is
-        admitted only when its range is already resident and a slot is
-        free (the value is known, so admission is free); commits never
-        evict — eviction pressure belongs to the read path.  Returns
+        admitted into a free slot when its range is already resident
+        (the value is known, so admission is free); a write touching a
+        BRAND-NEW range may open it, but only within
+        ``write_admit_budget`` new ranges per call and only into free
+        slots — commits never evict, eviction pressure belongs to the
+        read path, and an unbudgeted write-shaped working set must not
+        drain the free pool out from under read admissions.  Returns
         the bytes scattered (h2d accounting).  Idempotent: replaying
         a batch scatters the same values."""
         if not self._enabled or batch is None:
@@ -549,13 +568,21 @@ class ResidencyManager:
                 return 0
             idx: list[int] = []
             rows: list[tuple] = []
+            new_rids: set[int] = set()
             for (ns, key), vv in updates.items():
                 pr = (ns, key)
                 e = self._dir.get(pr)
                 if e is None:
                     rid = self.range_of(ns, key)
-                    if rid not in self._ranges or not self._free:
+                    if not self._free:
                         continue
+                    if rid not in self._ranges:
+                        # brand-new range discovered by a write:
+                        # admit within this call's budget only
+                        if len(new_rids) >= self.write_admit_budget:
+                            continue
+                        new_rids.add(rid)
+                        self._ranges[rid] = []
                     slot = self._free.pop()
                     self._dir[pr] = (slot, rid)
                     self._ranges[rid].append(pr)
@@ -582,6 +609,10 @@ class ResidencyManager:
                 return 0
             nbytes = len(idx) * SLOT_BYTES
             self._h2d_bytes_total += nbytes
+            self._write_admits_total += len(new_rids)
+        if new_rids:
+            self._write_admit_ctr.add(len(new_rids),
+                                      channel=self.channel)
         return nbytes
 
     def invalidate_keys(self, pairs) -> None:
@@ -648,5 +679,7 @@ class ResidencyManager:
                 "overlay_forced_total": self._overlay_forced_total,
                 "hit_rate": round(wh / wt, 4) if wt else None,
                 "evictions_total": self._evictions_total,
+                "write_admits_total": self._write_admits_total,
+                "write_admit_budget": self.write_admit_budget,
                 "h2d_bytes_total": self._h2d_bytes_total,
             }

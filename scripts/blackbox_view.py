@@ -16,6 +16,8 @@ Output, in postmortem reading order:
 * the SLO burn snapshot and scheduler per-tenant rows,
 * the device ledger (per-kernel compile/queue/execute decomposition,
   cache hit rates, HBM watermarks, the last raw launch rows),
+* the commit-engine postmortem (apply-queue depth trail, last applied
+  vs appended block height per async-commit channel),
 * the fault-injection stats (what the chaos plan actually did), and
 * the captured trace trees, rendered through scripts/traceview.py's
   waterfall.
@@ -180,6 +182,51 @@ def render_scheduler(sched: dict) -> list[str]:
     return lines
 
 
+def render_commit_engine(ce: dict, vitals: dict | None) -> list[str]:
+    """The decoupled committer at the moment of death: per channel,
+    how far the state-DB apply trailed the appended (durable) chain,
+    the apply-queue posture, and — when the vitals sampler was armed —
+    the queue-depth trail leading into the incident."""
+    lines = ["", "-- commit engine (state apply vs appended chain) "
+             + "-" * 21]
+    for cid in sorted(ce):
+        st = ce[cid] or {}
+        applied = st.get("applied_num")
+        appended = st.get("appended_height")
+        lag = (appended - 1 - applied
+               if isinstance(appended, (int, float))
+               and isinstance(applied, (int, float)) else None)
+        lines.append(
+            "  %-12s applied block %s / appended height %s"
+            " (synced %s)%s" % (
+                cid, _fmt(applied), _fmt(appended),
+                _fmt(st.get("synced_height")),
+                f"  << {int(lag)} block(s) UNAPPLIED" if lag else "",
+            )
+        )
+        lines.append(
+            "  %-12s queue %s/%s  oldest %s ms  applies %s  "
+            "backpressure %s%s" % (
+                "", _fmt(st.get("queue_depth")),
+                _fmt(st.get("queue_capacity")),
+                _fmt(st.get("oldest_age_ms")),
+                _fmt(st.get("applies_total")),
+                _fmt(st.get("backpressure_total")),
+                "  !! APPLIER FAILED (fail-stop latch)"
+                if st.get("failed") else "",
+            )
+        )
+    if not ce:
+        lines.append("  (no async-commit channels)")
+    depth = (vitals or {}).get("commit_apply_queue_depth") or {}
+    for labels, series in sorted(depth.items()):
+        vals = [p[1] for p in series.get("points", [])]
+        if any(isinstance(v, (int, float)) for v in vals):
+            lines.append("  depth trail %-32s %s" % (
+                f"{{{labels}}}"[:32], spark(vals[-48:])))
+    return lines
+
+
 def render_faults(stats: dict) -> list[str]:
     lines = ["", "-- fault plan " + "-" * 56]
     for point, rules in sorted(stats.items()):
@@ -271,6 +318,9 @@ def render_bundle(b: dict, series_limit: int | None = 24,
         lines += render_scheduler(b["scheduler"])
     if "launches" in b:
         lines += render_launches(b["launches"])
+    if "commit_engine" in b:
+        lines += render_commit_engine(b["commit_engine"],
+                                      b.get("vitals"))
     if "faults" in b:
         lines += render_faults(b["faults"])
     if traces and "traces" in b:

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Tier-1 CI gate: the ROADMAP's verify line plus the static-analysis
+# battery, as one command with one exit code.
+#
+#   scripts/ci_tier1.sh            # full gate (tests + analyzer)
+#   scripts/ci_tier1.sh --lint     # analyzer battery only
+#
+# The test half is the EXACT tier-1 line from ROADMAP.md (same
+# markers, same plugin set, same DOTS_PASSED accounting) so CI and a
+# laptop measure the identical thing; the analyzer half is the full
+# fabric_tpu/ battery (scripts/lint.py) whose findings are errors —
+# a clean tree prints 0 finding(s).
+set -u
+
+cd "$(dirname "$0")/.."
+
+lint_only=0
+[ "${1:-}" = "--lint" ] && lint_only=1
+
+echo "== fabric_tpu analyzer battery =="
+python scripts/lint.py
+lint_rc=$?
+
+if [ "$lint_only" = "1" ]; then
+    exit "$lint_rc"
+fi
+
+echo "== tier-1 tests =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+    | tee /tmp/_t1.log
+t1_rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+
+[ "$lint_rc" -ne 0 ] && echo "analyzer battery FAILED (rc=$lint_rc)"
+[ "$t1_rc" -ne 0 ] && echo "tier-1 tests FAILED (rc=$t1_rc)"
+[ "$lint_rc" -eq 0 ] && [ "$t1_rc" -eq 0 ]

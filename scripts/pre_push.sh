@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Pre-push lint gate: analyze only the files this push changes, emit
+# SARIF for code-scanning upload, fail the push on any finding.
+#
+# Install as a git hook (runs on every `git push` from then on):
+#
+#   ln -sf ../../scripts/pre_push.sh .git/hooks/pre-push
+#
+# Or run it directly before pushing.  The diff base is the upstream
+# of the current branch when one exists, else HEAD (covers the
+# uncommitted + unpushed work either way); project-wide rules
+# (FT017/FT018 provenance closure) still scan the full tree, so a
+# changed module that breaks an UNCHANGED one is caught.
+#
+# SARIF lands in .git/pre-push.sarif (ignored by git); the human
+# findings print on stderr via a second, cheap, cache-warm pass only
+# when the SARIF pass fails.
+set -u
+
+cd "$(dirname "$0")/.."
+
+base="HEAD"
+if git rev-parse --abbrev-ref --symbolic-full-name '@{upstream}' \
+        >/dev/null 2>&1; then
+    base="@{upstream}"
+fi
+
+out=".git/pre-push.sarif"
+if python scripts/lint.py --changed "$base" --sarif > "$out"; then
+    exit 0
+fi
+echo "pre-push lint found problems (SARIF: $out):" >&2
+python scripts/lint.py --changed "$base" >&2
+exit 1

@@ -104,7 +104,10 @@ def test_manager_admit_hit_and_values():
 
 def test_manager_commit_delta_scatter():
     state = _seed_state(8, stale_every=0, absent_every=0)
-    res = ResidencyManager(slots=32, range_bits=6)
+    # budget 0: the write path may never OPEN a range here, so the
+    # brand-new-range-stays-a-miss contract below is exact
+    res = ResidencyManager(slots=32, range_bits=6,
+                           write_admit_budget=0)
     pairs = [("ns", f"k{u}") for u in range(8)]
     build_launch_pack(res, pairs, state)          # admit
     cb = UpdateBatch()
@@ -116,7 +119,7 @@ def test_manager_commit_delta_scatter():
     arr = np.asarray(table)
     assert list(arr[u[0, 0]]) == [1, 5, 2]        # updated in place
     assert arr[u[1, 0]][0] == 0                   # delete → cached absence
-    # a brand-new key in a non-resident range stays a miss
+    # a brand-new key in a non-resident range stays a miss (budget 0)
     slots, _t = res.lookup([("ns", "brand_new")])
     assert slots[0] == -1
     # ... but a write into an ALREADY-resident range is admitted free
@@ -133,6 +136,43 @@ def test_manager_commit_delta_scatter():
     slots, table = res.lookup([("ns", rid_key)])
     assert slots[0] >= 0
     assert list(np.asarray(table)[slots[0]]) == [1, 6, 0]
+
+
+def test_manager_write_admission_budget():
+    with pytest.raises(ValueError):
+        ResidencyManager(write_admit_budget=-1)
+    res = ResidencyManager(slots=32, range_bits=6,
+                           write_admit_budget=2)
+    # writes into 4 DISTINCT brand-new ranges in one committed block:
+    # only the per-block budget's worth of ranges may open
+    picks, seen = [], set()
+    i = 0
+    while len(picks) < 4:
+        pr = ("ns", "w%d" % i)
+        rid = res.range_of(*pr)
+        if rid not in seen:
+            seen.add(rid)
+            picks.append(pr)
+        i += 1
+    cb = UpdateBatch()
+    for j, (ns, k) in enumerate(picks):
+        cb.put(ns, k, b"v", (3, j))
+    res.apply_batch(cb)
+    slots, _t = res.lookup(picks)
+    assert int((slots >= 0).sum()) == 2
+    st = res.stats()
+    assert st["write_admits_total"] == 2
+    assert st["write_admit_budget"] == 2
+    # the NEXT block's write-set gets a fresh budget — the two ranges
+    # skipped above open now, and the already-resident keys update in
+    # place without recharging it
+    cb2 = UpdateBatch()
+    for j, (ns, k) in enumerate(picks):
+        cb2.put(ns, k, b"v2", (4, j))
+    res.apply_batch(cb2)
+    slots2, _t = res.lookup(picks)
+    assert int((slots2 >= 0).sum()) == 4
+    assert res.stats()["write_admits_total"] == 4
 
 
 def test_manager_lru_eviction_pins_touched_ranges():

@@ -2425,6 +2425,116 @@ class Flag:
 """
 
 
+# task role from an asyncio spawn: the coroutine drains under the
+# lock while the synchronous caller appends bare — awaits are the
+# preemption points, so the interleaving races exactly like a thread's
+BAD_CROSS_THREAD_TASK = """\
+import asyncio
+
+
+class Feeder:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._buf = []
+        self._task = None
+
+    def start(self):
+        self._task = asyncio.create_task(self._drain())
+
+    def push(self, item):
+        self._buf.append(item)
+
+    async def _drain(self):
+        async with self._lock:
+            self._buf.clear()
+"""
+
+# executor role from a loop.run_in_executor dispatch: the pool thread
+# writes the totals dict bare while the snapshot reader takes the lock
+BAD_CROSS_THREAD_RUN_IN_EXECUTOR = """\
+import threading
+
+
+class Offloader:
+    def __init__(self, loop):
+        self._lock = threading.Lock()
+        self._loop = loop
+        self._totals = {}
+
+    def kick(self, key):
+        self._loop.run_in_executor(None, self._work, key)
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._totals)
+
+    def _work(self, key):
+        self._totals[key] = self._totals.get(key, 0) + 1
+"""
+
+# unprovable asyncio shapes stay silent: a task over a free-function
+# coroutine, and a create_task handed a bound method WITHOUT calling
+# it (not the provable ``self.m()`` coroutine shape)
+CLEAN_CROSS_THREAD_TASK_UNKNOWN = """\
+import asyncio
+
+
+async def pump():
+    pass
+
+
+class Quiet:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._buf = []
+        self._t1 = None
+        self._t2 = None
+
+    def start(self):
+        self._t1 = asyncio.create_task(pump())
+        self._t2 = asyncio.ensure_future(self._gen)
+
+    def push(self, item):
+        with self._lock:
+            self._buf.append(item)
+
+    async def _gen(self):
+        async with self._lock:
+            self._buf.clear()
+"""
+
+# the async commit applier shape (ledger/committer.py): a LAZILY
+# spawned apply thread draining a deque the submitter appends — the
+# role must stay visible so a lock regression in the real engine can
+# never go quiet.  This variant drops the lock on the submit side.
+BAD_CROSS_THREAD_APPLIER = """\
+import threading
+from collections import deque
+
+
+class ApplyEngine:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._queue = deque()
+        self._thread = None
+
+    def submit(self, entry):
+        self._queue.append(entry)
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._apply_loop, daemon=True
+            )
+            self._thread.start()
+
+    def _apply_loop(self):
+        while True:
+            with self._cond:
+                while not self._queue:
+                    self._cond.wait()
+                self._queue.popleft()
+"""
+
+
 class TestCrossThreadState:
     def _rule(self):
         from fabric_tpu.analysis.rules.cross_thread_state import (
@@ -2448,6 +2558,50 @@ class TestCrossThreadState:
         assert [(f.line,) for f in got] == [(19,)]
         assert "_stats" in got[0].message
         assert "worker(_work)" in got[0].message
+
+    def test_flags_asyncio_task_role(self, tmp_path):
+        got = run_rule(tmp_path, self._rule(),
+                       {"mod.py": BAD_CROSS_THREAD_TASK})
+        assert [(f.rule, f.path, f.line) for f in got] == [
+            ("FT017", "mod.py", 14),
+        ]
+        assert "_buf" in got[0].message
+        assert "task(_drain)" in got[0].message
+
+    def test_flags_run_in_executor_role(self, tmp_path):
+        got = run_rule(tmp_path, self._rule(),
+                       {"mod.py": BAD_CROSS_THREAD_RUN_IN_EXECUTOR})
+        assert [(f.line,) for f in got] == [(18,)]
+        assert "_totals" in got[0].message
+        assert "executor(_work)" in got[0].message
+
+    def test_asyncio_unprovable_shapes_silent(self, tmp_path):
+        assert run_rule(
+            tmp_path, self._rule(),
+            {"mod.py": CLEAN_CROSS_THREAD_TASK_UNKNOWN},
+        ) == []
+
+    def test_flags_lazy_applier_thread_role(self, tmp_path):
+        # the commit-engine applier shape: lazy spawn inside the very
+        # method that races
+        got = run_rule(tmp_path, self._rule(),
+                       {"mod.py": BAD_CROSS_THREAD_APPLIER})
+        assert [(f.rule, f.path, f.line) for f in got] == [
+            ("FT017", "mod.py", 12),
+        ]
+        assert "_queue" in got[0].message
+        assert "thread(_apply_loop)" in got[0].message
+
+    def test_real_commit_engine_clean(self, tmp_path):
+        # the REAL AsyncApplyEngine must scan clean under the extended
+        # role inference — its one-condition discipline is the fixture
+        # above with the lock present on both sides
+        import pathlib
+
+        src = (pathlib.Path(__file__).resolve().parent.parent
+               / "fabric_tpu" / "ledger" / "committer.py").read_text()
+        assert run_rule(tmp_path, self._rule(),
+                        {"committer.py": src}) == []
 
     def test_lock_held_paths_clean(self, tmp_path):
         assert run_rule(tmp_path, self._rule(),
