@@ -1859,6 +1859,277 @@ def _bench_host_stage_micro(B: int = 3072, n_keys: int = 2048,
     }
 
 
+def _bench_chain_replay(n_tx: int = 1000, n_blocks: int = 12):
+    """ISSUE 18 catch-up ceiling (the BENCH_r06 full-occupancy
+    workload): a staged chain replayed from a real ``BlockStore``
+    through ``peer/replay.py`` at the configured depth — zero
+    inter-block think time, block read + proto decode prefetched on
+    the driver's reader thread — vs the OPEN-LOOP feed (the
+    ``block_commit`` shape: the same store iterated on the submit
+    thread, so each block's read + decode sits on the critical path).
+
+    The delta between the two IS the driver's contribution; the
+    replay side's ``pipeline_overlap_coverage`` (extras) is the
+    ROADMAP acceptance — ≈ 1.0 means the window never drains and any
+    residual ``device_wait`` queue time is real pipeline headroom."""
+    import os
+    import shutil
+    import tempfile
+
+    from fabric_tpu import observe
+    from fabric_tpu.ledger.kvledger import KVLedger
+    from fabric_tpu.peer.pipeline import CommitPipeline
+    from fabric_tpu.peer.replay import replay_into
+    from fabric_tpu.protos import common_pb2
+
+    bk = _bench_knobs()
+    depth = bk["pipeline_depth"]
+    (blocks, fresh_state, fresh_validator, _mgr, _prov, _,
+     n_invalid) = _build_commit_network(
+        n_tx, n_blocks, hot_readonly=bool(bk["hot_readonly"]),
+    )
+    expected_valid = (n_tx - n_invalid) * n_blocks
+    tmp_root = tempfile.mkdtemp(prefix="benchreplay")
+
+    # stage the SOURCE chain once: a real block store holding the
+    # whole stream (this pass also warms every compile cache)
+    src_lg = KVLedger(os.path.join(tmp_root, "src"),
+                      state_db=fresh_state(), enable_history=True,
+                      async_commit=_bench_async_commit())
+    v0 = fresh_validator(src_lg.state)
+
+    def src_commit(res):
+        src_lg.commit_block(res.block, res.tx_filter, res.batch,
+                            res.history, None, res.txids,
+                            res.pend.hd_bytes)
+
+    with CommitPipeline(v0, src_commit, depth=depth) as pipe:
+        for blk in blocks:
+            b = common_pb2.Block()
+            b.CopyFrom(blk)
+            pipe.submit(b)
+        pipe.flush()
+    assert src_lg.height == n_blocks
+
+    def run_replay(i: int):
+        """One full catch-up into a fresh destination ledger."""
+        dest = os.path.join(tmp_root, f"replay{i}")
+        lg = KVLedger(dest, state_db=fresh_state(), enable_history=True,
+                      async_commit=_bench_async_commit())
+        v = fresh_validator(lg.state)
+        stats = replay_into(
+            lg, v, src_lg.blocks, depth=depth,
+            checkpoint=os.path.join(dest, "replay_checkpoint.json"),
+            coalesce_blocks=bk["coalesce_blocks"],
+            tracer=observe.global_tracer(),
+        )
+        lg.close()
+        return stats
+
+    def run_open_loop(i: int):
+        """The block_commit shape over the SAME store: read + decode
+        inline on the submit thread, no prefetch-ahead."""
+        dest = os.path.join(tmp_root, f"open{i}")
+        lg = KVLedger(dest, state_db=fresh_state(), enable_history=True,
+                      async_commit=_bench_async_commit())
+        v = fresh_validator(lg.state)
+        n_valid = [0]
+
+        def commit_fn(res):
+            lg.commit_block(res.block, res.tx_filter, res.batch,
+                            res.history, None, res.txids,
+                            res.pend.hd_bytes)
+            n_valid[0] += res.n_valid
+
+        t0 = time.perf_counter()
+        with CommitPipeline(v, commit_fn, depth=depth) as pipe:
+            for blk in src_lg.blocks.iter_blocks(0):
+                pipe.submit(blk)
+            pipe.flush()
+        dt = time.perf_counter() - t0
+        lg.close()
+        return dt, n_valid[0]
+
+    replay_runs = [run_replay(i) for i in range(3)]
+    best = min(replay_runs, key=lambda s: s["seconds"])
+    assert best["txs_valid"] == expected_valid, (
+        f"expected {expected_valid} valid, got {best['txs_valid']}"
+    )
+    assert best["height"] == n_blocks
+    open_runs = [run_open_loop(i) for i in range(2)]
+    open_s = min(dt for dt, _ in open_runs)
+    assert open_runs[0][1] == expected_valid
+
+    total = n_tx * n_blocks
+    replay_rate = total / best["seconds"]
+    open_rate = total / open_s
+    src_lg.close()
+    _close_validators(fresh_validator)
+    shutil.rmtree(tmp_root, ignore_errors=True)
+    return {
+        "metric": f"chain_replay_tx_per_sec_block{n_tx}",
+        "value": round(replay_rate, 1),
+        "unit": "tx/s",
+        # the driver's contribution over the open-loop feed at the
+        # SAME depth — >1.0 means prefetch-ahead decode paid
+        "vs_baseline": round(replay_rate / open_rate, 3),
+        "extras": {
+            "knobs": _bench_knobs(),
+            "replay": {
+                "blocks_per_s": best["blocks_per_s"],
+                "seconds": best["seconds"],
+                "depth": best["depth"],
+            },
+            "open_loop": {
+                "tx_per_s": round(open_rate, 1),
+                "blocks_per_s": round(n_blocks / open_s, 2),
+                "seconds": round(open_s, 4),
+            },
+            "pipeline_overlap_coverage": best.get(
+                "pipeline_overlap_coverage"
+            ),
+        },
+    }
+
+
+def _bench_snapshot_join(n_tx: int = 1000, n_blocks: int = 12,
+                         join_at: int = 6):
+    """ISSUE 18 snapshot-then-replay join: export Fabric-shaped state
+    at height ``join_at``, bootstrap a fresh peer from it (state DB +
+    resident-cache warm, no genesis→H replay), replay ``join_at``..end
+    from the serving store — vs the full replay-from-genesis oracle.
+    The joined ledger must be byte-identical to the oracle (state
+    digest + commit hash), and the headline number is the wall-clock
+    speedup of joining over full replay."""
+    import os
+    import shutil
+    import tempfile
+
+    from fabric_tpu import observe
+    from fabric_tpu.ledger import snapshot as snaplib
+    from fabric_tpu.ledger.kvledger import KVLedger
+    from fabric_tpu.ledger.statedb import MemVersionedDB
+    from fabric_tpu.peer.pipeline import CommitPipeline
+    from fabric_tpu.peer.replay import replay_into
+    from fabric_tpu.protos import common_pb2
+
+    bk = _bench_knobs()
+    depth = bk["pipeline_depth"]
+    (blocks, fresh_state, fresh_validator, _mgr, _prov, _,
+     _n_invalid) = _build_commit_network(
+        n_tx, n_blocks, hot_readonly=bool(bk["hot_readonly"]),
+    )
+    tmp_root = tempfile.mkdtemp(prefix="benchsnapjoin")
+
+    # stage the serving peer: commit to join_at, snapshot, commit on
+    src_lg = KVLedger(os.path.join(tmp_root, "src"),
+                      state_db=fresh_state(), enable_history=True,
+                      async_commit=_bench_async_commit())
+    v0 = fresh_validator(src_lg.state)
+
+    def src_commit(res):
+        src_lg.commit_block(res.block, res.tx_filter, res.batch,
+                            res.history, None, res.txids,
+                            res.pend.hd_bytes)
+
+    snap_dir = os.path.join(tmp_root, "snap")
+    with CommitPipeline(v0, src_commit, depth=depth) as pipe:
+        for blk in blocks[:join_at]:
+            b = common_pb2.Block()
+            b.CopyFrom(blk)
+            pipe.submit(b)
+        pipe.flush()
+        meta = snaplib.generate_snapshot(src_lg, snap_dir,
+                                         channel_id="bench")
+        for blk in blocks[join_at:]:
+            b = common_pb2.Block()
+            b.CopyFrom(blk)
+            pipe.submit(b)
+        pipe.flush()
+    assert meta["height"] == join_at and src_lg.height == n_blocks
+
+    def run_full(i: int) -> float:
+        dest = os.path.join(tmp_root, f"full{i}")
+        lg = KVLedger(dest, state_db=fresh_state(), enable_history=True,
+                      async_commit=_bench_async_commit())
+        v = fresh_validator(lg.state)
+        t0 = time.perf_counter()
+        replay_into(lg, v, src_lg.blocks, depth=depth,
+                    tracer=observe.global_tracer())
+        dt = time.perf_counter() - t0
+        digest = lg.state_digest()
+        chash = lg.commit_hash
+        lg.close()
+        if i == 0:
+            run_full.oracle = (digest, chash)
+        return dt
+
+    def run_join(i: int):
+        dest = os.path.join(tmp_root, f"join{i}")
+        t0 = time.perf_counter()
+        # the import applies snapshot state in bulk — no validation,
+        # no per-block commits, an EMPTY state DB to land in
+        lg, _meta = snaplib.create_from_snapshot(
+            snap_dir, dest, state_db=MemVersionedDB(),
+            async_commit=_bench_async_commit(),
+        )
+        import_s = time.perf_counter() - t0
+        v = fresh_validator(lg.state)
+        # resident warm straight from the snapshot's key ranges
+        # (FABTPU_BENCH_RESIDENT=1): the first replayed block starts
+        # with the working set already device-resident
+        t0 = time.perf_counter()
+        warmed = snaplib.warm_resident(
+            getattr(v, "resident", None), snap_dir
+        )
+        warm_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        stats = replay_into(lg, v, src_lg.blocks, depth=depth,
+                            tracer=observe.global_tracer())
+        replay_s = time.perf_counter() - t0
+        digest = lg.state_digest()
+        chash = lg.commit_hash
+        height = lg.height
+        lg.close()
+        return {
+            "total_s": import_s + warm_s + replay_s,
+            "import_s": import_s, "warm_s": warm_s,
+            "replay_s": replay_s, "warmed_keys": warmed,
+            "digest": digest, "commit_hash": chash,
+            "height": height, "replayed_blocks": stats["blocks"],
+        }
+
+    full_s = min(run_full(i) for i in range(2))
+    joins = [run_join(i) for i in range(2)]
+    best = min(joins, key=lambda j: j["total_s"])
+    oracle_digest, oracle_hash = run_full.oracle
+    # the acceptance pin: snapshot-then-replay ≡ replay-from-genesis
+    assert best["height"] == n_blocks
+    assert best["digest"] == oracle_digest, "joined state diverged"
+    assert best["commit_hash"] == oracle_hash, "commit chain diverged"
+    src_lg.close()
+    _close_validators(fresh_validator)
+    shutil.rmtree(tmp_root, ignore_errors=True)
+    return {
+        "metric": f"snapshot_join_speedup_block{n_tx}",
+        # join wall-clock vs full replay: > 1.0 means skipping
+        # genesis→H validation paid (grows with chain length — the
+        # replayed suffix is the only validated work)
+        "value": round(full_s / best["total_s"], 3),
+        "unit": "x",
+        "vs_baseline": round(full_s / best["total_s"], 3),
+        "extras": {
+            "knobs": _bench_knobs(),
+            "full_replay_s": round(full_s, 4),
+            "join": {k: (round(vv, 4) if isinstance(vv, float) else vv)
+                     for k, vv in best.items()
+                     if k not in ("digest", "commit_hash")},
+            "byte_identical": True,
+            "snapshot_height": join_at,
+        },
+    }
+
+
 _BENCHES = {
     "block_commit": _bench_block_commit,
     # VERDICT Missing #1: sustained ≥50-block stream with p50/p99
@@ -1892,6 +2163,13 @@ _BENCHES = {
     # feeders — FABTPU_BENCH_SIGN=0/1, occupancy in extras
     "endorse_sign": _bench_endorse_sign,
     "p256_verify": _bench_p256_verify,
+    # ISSUE 18 catch-up path: closed-loop chain replay through
+    # peer/replay.py at full depth vs the open-loop feed (ceiling
+    # tx/s + pipeline_overlap_coverage in extras), and the
+    # snapshot-then-replay join vs full replay-from-genesis with the
+    # byte-identity differential asserted inline
+    "chain_replay": _bench_chain_replay,
+    "snapshot_join": _bench_snapshot_join,
     "sha256": _bench_sha256,
 }
 
@@ -1911,6 +2189,7 @@ def main():
     if name in ("block_commit", "block_commit_mixed",
                 "block_commit_sustained", "block_commit_chaos",
                 "block_commit_sidecar", "block_commit_bursty",
+                "chain_replay", "snapshot_join",
                 "p256_verify", "endorse_sign"):
         # these benches need the `cryptography` package for the
         # OpenSSL CPU baseline and the cert-based test network — on

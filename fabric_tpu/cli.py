@@ -117,15 +117,16 @@ async def _run_orderer(cfg):
     await asyncio.Event().wait()
 
 
-async def _run_peer(cfg):
+def _build_peer(cfg):
+    """Construct the PeerNode from a validated PeerConfig — shared by
+    the serving ``peer`` command and the offline ``replay`` catch-up
+    (which never starts the server)."""
     from fabric_tpu.crypto import cryptogen as cg
     from fabric_tpu.crypto.msp import MSPManager
-    from fabric_tpu.discovery import PeerInfo
     from fabric_tpu.nodeconfig import PeerConfig
     from fabric_tpu.peer.ccaas import CCaaSProxy
     from fabric_tpu.peer.chaincode import ChaincodeRuntime
     from fabric_tpu.peer.node import PeerNode
-    from fabric_tpu.protos import common_pb2
 
     assert isinstance(cfg, PeerConfig)
     signer = cg.load_signing_identity(cfg.msp_dir, cfg.msp_id)
@@ -135,7 +136,7 @@ async def _run_peer(cfg):
     runtime = ChaincodeRuntime()
     for cc in cfg.chaincodes:
         runtime.register(cc.name, CCaaSProxy(cc.name, cc.host, cc.port))
-    node = PeerNode(
+    return PeerNode(
         cfg.id, cfg.data_dir, mgr, signer, runtime,
         host=cfg.host, port=cfg.port,
         tls=_node_tls(cfg),
@@ -179,24 +180,49 @@ async def _run_peer(cfg):
         async_commit=cfg.async_commit,
         apply_queue_blocks=cfg.apply_queue_blocks,
     )
+
+
+def _join_config_channel(node, cfg, ch):
+    """Join one configured channel (genesis / snapshot anchored) and
+    apply the per-channel ledger knobs."""
+    from fabric_tpu.protos import common_pb2
+
+    name = ch if isinstance(ch, str) else ch.name
+    genesis = None
+    if not isinstance(ch, str) and ch.genesis:
+        genesis = common_pb2.Block()
+        with open(ch.genesis, "rb") as f:
+            genesis.ParseFromString(f.read())
+    chan = node.join_channel(
+        name, genesis_block=genesis,
+        snapshot_dir=(None if isinstance(ch, str) or not ch.snapshot_dir
+                      else ch.snapshot_dir),
+    )
+    chan.ledger.blocks.group_commit = cfg.group_commit
+    chan.transient_retention = cfg.transient_retention
+    return chan
+
+
+async def _run_peer(cfg):
+    from fabric_tpu.discovery import PeerInfo
+
+    node = _build_peer(cfg)
     await node.start(operations_port=cfg.operations_port)
     print(f"peer {node.id} serving on :{node.port}", flush=True)
     for p in cfg.peers:
         node.registry.add(PeerInfo(p.msp_id, p.host, p.port))
     for ch in cfg.channels:
         name = ch if isinstance(ch, str) else ch.name
-        genesis = None
-        if not isinstance(ch, str) and ch.genesis:
-            genesis = common_pb2.Block()
-            with open(ch.genesis, "rb") as f:
-                genesis.ParseFromString(f.read())
-        chan = node.join_channel(
-            name, genesis_block=genesis,
-            snapshot_dir=(None if isinstance(ch, str) or not ch.snapshot_dir
-                          else ch.snapshot_dir),
-        )
-        chan.ledger.blocks.group_commit = cfg.group_commit
-        chan.transient_retention = cfg.transient_retention
+        chan = _join_config_channel(node, cfg, ch)
+        if not isinstance(ch, str) and ch.replay_from:
+            # local catch-up BEFORE the deliver loop attaches: replay
+            # the staged block store at full pipeline depth
+            # (peer/replay.py) — a killed start resumes from the
+            # committed height on the next boot
+            stats = await chan.replay_local(ch.replay_from)
+            print(f"channel {name} replayed {stats['blocks']} blocks "
+                  f"to height {chan.height} "
+                  f"({stats['blocks_per_s']} blocks/s)", flush=True)
         orderers = ([] if isinstance(ch, str)
                     else [tuple(o) for o in ch.orderers])
         if orderers:
@@ -480,6 +506,50 @@ def _cmd_ledgerutil(args):
     sys.exit(0 if res["identical"] else 1)
 
 
+def _cmd_replay(args):
+    """Offline catch-up (peer/replay.py): validate a staged block
+    store into one configured channel's ledger at full pipeline depth,
+    print the replay stats as JSON, and exit.  Composes with a
+    ``snapshot_dir`` channel config: the snapshot bootstraps state at
+    H, this replays H+1.. — and a killed run resumes from the
+    committed height."""
+    from fabric_tpu.nodeconfig import ConfigError, load_peer_config
+
+    try:
+        cfg = load_peer_config(args.config)
+    except ConfigError as e:
+        print(f"config error: {e}", file=sys.stderr)
+        sys.exit(2)
+
+    async def go():
+        node = _build_peer(cfg)
+        ref = None
+        for ch in cfg.channels:
+            if (ch if isinstance(ch, str) else ch.name) == args.channel:
+                ref = ch
+                break
+        if ref is None:
+            print(f"channel {args.channel} not in config",
+                  file=sys.stderr)
+            sys.exit(2)
+        src = args.source or (
+            "" if isinstance(ref, str) else ref.replay_from
+        )
+        if not src:
+            print("no replay source: pass --source or set the "
+                  "channel's replay_from", file=sys.stderr)
+            sys.exit(2)
+        chan = _join_config_channel(node, cfg, ref)
+        try:
+            stats = await chan.replay_local(src, depth=args.depth)
+            stats["height"] = chan.height
+            print(json.dumps(stats))
+        finally:
+            chan.stop()
+
+    asyncio.run(go())
+
+
 def _cmd_snapshot(args):
     from fabric_tpu.comm.rpc import RpcClient
 
@@ -631,6 +701,21 @@ def main(argv=None):
     c.add_argument("--channel", required=True)
     c.add_argument("--output", required=True)
 
+    c = sub.add_parser("replay",
+                       help="offline catch-up: validate a staged "
+                            "block store into a channel's ledger at "
+                            "full pipeline depth")
+    c.add_argument("--config", required=True,
+                   help="peer config (the channel's genesis/snapshot "
+                        "anchors and pipeline knobs come from here)")
+    c.add_argument("--channel", required=True)
+    c.add_argument("--source",
+                   help="block-store directory to replay from "
+                        "(default: the channel's replay_from)")
+    c.add_argument("--depth", type=int, default=None,
+                   help="pipeline depth override for the replay "
+                        "(default: the config's pipeline_depth)")
+
     c = sub.add_parser("discover", help="discovery queries")
     c.add_argument("--host", default="127.0.0.1")
     c.add_argument("--port", type=int, required=True)
@@ -695,6 +780,8 @@ def main(argv=None):
         _cmd_ledgerutil(args)
     elif args.cmd == "snapshot":
         _cmd_snapshot(args)
+    elif args.cmd == "replay":
+        _cmd_replay(args)
     elif args.cmd == "discover":
         _cmd_discover(args)
     elif args.cmd == "configtxlator":

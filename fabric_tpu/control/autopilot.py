@@ -529,6 +529,13 @@ class Autopilot:
         self._weights: dict[str, float] = {}
         self._shed: set[str] = set()
         self._last_act: dict[tuple, float] = {}
+        # throughput-mode holds (peer/replay.py): while any are live
+        # the overload knives (shed/BUSY, weight halving) stay
+        # sheathed — a closed-loop replay feed keeps every queue full
+        # by DESIGN, and those rules would misread full occupancy as
+        # an open-loop overload incident.  Refcounted: concurrent
+        # replays on different channels each take one hold.
+        self._throughput_hold = 0
         self.decisions: deque = deque(maxlen=DECISION_LOG)
         self._last_signals: Signals | None = None
         self._seq = 0
@@ -558,6 +565,28 @@ class Autopilot:
     def set_enabled(self, on: bool) -> None:
         self._enabled = bool(on)
         self._enabled_gauge.set(1 if self._enabled else 0)
+
+    # -- throughput mode (closed-loop replay) ------------------------------
+
+    def hold_throughput(self) -> None:
+        """Enter throughput mode: suppress the shed/BUSY and
+        weight-halving overload rules while a closed-loop feed
+        (chain replay) intentionally saturates the commit path.  The
+        efficiency ladder rules (coalesce, verify_chunk, depth, host
+        workers) keep actuating — they are exactly what tunes the
+        replay toward the ceiling."""
+        with self._lock:
+            self._throughput_hold += 1
+
+    def release_throughput(self) -> None:
+        with self._lock:
+            if self._throughput_hold > 0:
+                self._throughput_hold -= 1
+
+    @property
+    def throughput_mode(self) -> bool:
+        with self._lock:
+            return self._throughput_hold > 0
 
     # -- signal acquisition ------------------------------------------------
 
@@ -693,6 +722,13 @@ class Autopilot:
 
     def _decide(self, s: Signals, now: float) -> Decision | None:
         b = self.bands
+        # throughput mode (replay hold; caller holds self._lock so
+        # read the counter raw): the overload knives below (rules 1
+        # and 2) are suppressed — a closed-loop replay keeps queues
+        # full on purpose, and shedding/penalizing its tenant would
+        # throttle exactly the catch-up it is trying to finish.  The
+        # efficiency rules (3+) still run.
+        tput = self._throughput_hold > 0
         # 1) emergency shed: a tenant burning past the shed band gets
         #    BUSY + retry-after instead of queue space — but ONLY the
         #    tenant actually applying the pressure.  Under one shared
@@ -700,8 +736,8 @@ class Autopilot:
         #    wait behind the offender's), so the rule requires the
         #    candidate to hold the deepest admission queue: shedding
         #    the victim would bound nothing.
-        if (self.set_shed is not None and "shed" in self.specs
-                and not self._shed):
+        if (not tput and self.set_shed is not None
+                and "shed" in self.specs and not self._shed):
             # ONE knife at a time: while a shed is active the incident
             # is already being bounded, and every other tenant's burn
             # is contaminated by it (a victim's lingering bad window +
@@ -745,7 +781,8 @@ class Autopilot:
                         tenant=tenant,
                     )
         # 2) moderate burn: halve the tenant's scheduler weight
-        if self.set_weight is not None and "weight" in self.specs:
+        if (not tput and self.set_weight is not None
+                and "weight" in self.specs):
             spec = self.specs["weight"]
             for tenant in sorted(set(self._weights)
                                  | {c.split(":", 1)[1]
@@ -1073,6 +1110,7 @@ class Autopilot:
             sigs = self._last_signals
             out = {
                 "enabled": self._enabled,
+                "throughput_mode": self._throughput_hold > 0,
                 "tick_s": self.tick_s,
                 "knobs": {
                     name: {

@@ -275,6 +275,17 @@ class KVLedger:
         if self.engine is not None:
             self.engine.drain()
 
+    def state_digest(self) -> str:
+        """Content hash of the committed state (ledger/snapshot.py
+        ``state_digest``), behind the async-apply drain barrier — the
+        catch-up differential's equality oracle: snapshot-then-replay
+        vs replay-from-genesis compare equal iff their committed
+        records are byte-identical."""
+        from fabric_tpu.ledger.snapshot import state_digest
+
+        self.drain_state()
+        return state_digest(self.state)
+
     @property
     def height(self) -> int:
         return self.blocks.height

@@ -81,6 +81,12 @@ def generate_snapshot(ledger, out_dir: str, channel_id: str = "",
     channel commit lock — snapshot_mgmt.go's commitStart/commitDone
     interlock)."""
     os.makedirs(out_dir, exist_ok=True)
+    # async group-commit engine (PR 17): queued state applies may
+    # still trail the block append — drain them so the exported state
+    # is exactly the boundary state at ``height``
+    drain = getattr(ledger, "drain_state", None)
+    if drain is not None:
+        drain()
     height = ledger.blocks.height
     if height == 0:
         raise ValueError("cannot snapshot an empty ledger")
@@ -119,12 +125,19 @@ def generate_snapshot(ledger, out_dir: str, channel_id: str = "",
         tw.record(txid.encode(), bytes([code & 0xFF]))
     txids_hash = tw.close()
 
+    sp = ledger.state.savepoint()
     meta = {
         "channel_name": channel_id,
         "last_block_number": height - 1,
         "last_block_hash": last_hash,
         "previous_block_hash": prev_hash,
         "last_commit_hash": (ledger.commit_hash or b"").hex(),
+        # the catch-up contract (peer/replay.py): ``height`` is where
+        # replay takes over (blocks < height are inside the snapshot),
+        # ``state_savepoint`` pins the state DB's recovery anchor so
+        # the importer's reconcile-on-open sees a consistent pair
+        "height": height,
+        "state_savepoint": (list(sp) if sp is not None else None),
         "config": config_bytes.hex(),
         "files": {STATE_FILE: state_hash, TXIDS_FILE: txids_hash},
     }
@@ -167,6 +180,11 @@ def create_from_snapshot(snap_dir: str, ledger_dir: str, state_db=None,
     batch = UpdateBatch()
     n = 0
     last_block = meta["last_block_number"]
+    # exported savepoint (absent in pre-height snapshots): the
+    # importer reproduces the EXACT recovery anchor the exporter
+    # held, so savepoint/height reconciliation on reopen is the
+    # identity, under both the serial and async commit engines
+    sp = tuple(meta.get("state_savepoint") or (last_block, 0))
     for ns, key, value, ver, md in _iter_records(
         os.path.join(snap_dir, STATE_FILE), 5
     ):
@@ -174,9 +192,9 @@ def create_from_snapshot(snap_dir: str, ledger_dir: str, state_db=None,
         batch.put(ns.decode(), key.decode(), value, (blk, txn), md or None)
         n += 1
         if n % 10000 == 0:
-            lg.state.apply_updates(batch, (last_block, 0))
+            lg.state.apply_updates(batch, sp)
             batch = UpdateBatch()
-    lg.state.apply_updates(batch, (last_block, 0))
+    lg.state.apply_updates(batch, sp)
 
     lg.blocks.bootstrap_from_snapshot(
         last_block + 1,
@@ -188,3 +206,62 @@ def create_from_snapshot(snap_dir: str, ledger_dir: str, state_db=None,
     )
     lg.bootstrap_commit_hash(bytes.fromhex(meta["last_commit_hash"]) or None)
     return lg, meta
+
+
+def iter_state_records(snap_dir: str):
+    """Decoded ``(ns, key, value, (block, txnum), metadata)`` stream
+    off a snapshot's state file — the warm/inspection reader."""
+    for ns, key, value, ver, md in _iter_records(
+        os.path.join(snap_dir, STATE_FILE), 5
+    ):
+        yield (
+            ns.decode(), key.decode(), value,
+            (_LEN.unpack(ver[:4])[0], _LEN.unpack(ver[4:])[0]),
+            md or None,
+        )
+
+
+def warm_resident(res, snap_dir: str, limit: int | None = None) -> int:
+    """Warm the device-resident MVCC cache (state/residency.py)
+    straight from a snapshot's key ranges — the snapshot-join peer
+    skips the fault-in-miss-by-miss phase entirely: every key the
+    import just wrote to the state DB lands in the device table as a
+    committed (present, version) row before the first replayed block
+    launches.  Values stay host-side (the cache holds version rows);
+    pvt cleartext was never exported.  Returns keys admitted (0 when
+    the cache is absent/disabled or the warm stops at capacity)."""
+    if res is None or not res.enabled:
+        return 0
+    return res.warm(
+        ((ns, key, ver) for ns, key, _v, ver, _m in
+         iter_state_records(snap_dir)),
+        limit=limit,
+    )
+
+
+def state_digest(state) -> str:
+    """Order-insensitive content hash over a state DB's committed
+    ``(ns, key, value, version, metadata)`` records — the
+    byte-identity oracle the snapshot/replay differential tests pin:
+    a snapshot-then-replay join must produce EXACTLY the state a
+    replay from genesis produces.
+
+    Each record is hashed in the snapshot's own framing and the
+    per-record digests are XOR-combined, so backends that iterate in
+    different orders (and ledgers whose histories applied the same
+    writes through different batch boundaries) compare equal iff
+    their committed records are byte-identical."""
+    acc = bytearray(32)
+    for (ns, key), vv in state.iter_all():
+        h = hashlib.sha256()
+        for b in (
+            ns.encode(), key.encode(), vv.value or b"",
+            _LEN.pack(vv.version[0]) + _LEN.pack(vv.version[1]),
+            vv.metadata or b"",
+        ):
+            h.update(_LEN.pack(len(b)))
+            h.update(b)
+        d = h.digest()
+        for i in range(32):
+            acc[i] ^= d[i]
+    return bytes(acc).hex()

@@ -58,13 +58,12 @@ def parse_envelopes(envs: list[bytes]) -> ParsedBlock | None:
         return None
     n = len(envs)
     blob = b"".join(envs)
+    # offs/lens bookkeeping without a Python loop: lens via fromiter,
+    # offs as the exclusive prefix sum (replay runs this per block
+    # back-to-back, so the O(n) interpreter loop was measurable)
+    lens = np.fromiter((len(e) for e in envs), np.int64, count=n)
     offs = np.zeros(n, np.int64)
-    lens = np.zeros(n, np.int64)
-    pos = 0
-    for i, e in enumerate(envs):
-        offs[i] = pos
-        lens[i] = len(e)
-        pos += len(e)
+    np.cumsum(lens[:-1], out=offs[1:])
 
     cap = max(8, 8 * n)
     cap_ids = cap + n

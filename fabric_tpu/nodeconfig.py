@@ -52,6 +52,14 @@ class ChannelRef:
     name: str = ""
     genesis: str = ""            # path to the genesis block
     snapshot_dir: str = ""       # join-from-snapshot directory
+    # local catch-up replay source (peer/replay.py): a block-store
+    # directory holding the chain (a serving peer's copied store, an
+    # anti-entropy mirror, this peer's own pre-wipe store).  On start
+    # the channel replays it at full pipeline depth — resuming from
+    # the committed height — BEFORE the deliver loop attaches.
+    # Composes with snapshot_dir: snapshot bootstraps state at H,
+    # replay validates H+1.. from the store.
+    replay_from: str = ""
     orderers: list = field(default_factory=list)  # [[host, port], ...]
     anti_entropy: bool = False   # background gossip catch-up pulls
 

@@ -218,6 +218,20 @@ class PeerChannel:
                 msp_manager, policy_provider, self.ledger.state,
                 **validator_kw,
             )
+        if snapshot_dir is not None:
+            # snapshot join + resident cache (PR 14): warm the device
+            # table straight from the snapshot's key ranges instead of
+            # faulting the working set in miss-by-miss over the first
+            # replayed blocks (ledger/snapshot.py warm_resident; a
+            # no-op when the resident knob is off or capacity is hit)
+            res = getattr(self.validator, "resident", None)
+            if res is not None:
+                from fabric_tpu.ledger.snapshot import warm_resident
+
+                warmed = warm_resident(res, snapshot_dir)
+                if warmed:
+                    _log.info("%s: resident cache warmed with %d keys "
+                              "from snapshot", channel_id, warmed)
         from fabric_tpu.peer.coordinator import PvtDataCoordinator
         from fabric_tpu.peer.transient import TransientStore
 
@@ -1074,6 +1088,75 @@ class PeerChannel:
                     self.ledger, out_dir, channel_id=self.id, config_bytes=cfg
                 ),
             )
+
+    async def replay_local(self, src_dir: str,
+                           depth: int | None = None) -> dict:
+        """Catch this channel up from a LOCAL block store directory
+        (``peer ... replay_from`` — a serving peer's copied chain, an
+        anti-entropy mirror, or this peer's own pre-wipe store) at
+        full pipeline depth with zero inter-block think time
+        (peer/replay.py).  Resumes from the committed height — a
+        killed replay restarts exactly where it stopped — and holds
+        the autopilot in throughput mode for the duration.  Returns
+        the replay stats dict."""
+        from fabric_tpu.ledger.blockstore import BlockStore
+        from fabric_tpu.peer.replay import ReplayCheckpoint, ReplayDriver
+
+        loop = asyncio.get_event_loop()
+
+        def commit_fn(res):
+            # committer thread → event loop, exactly the deliver
+            # driver's bridge (commit lock + pvt coordinator are
+            # loop-affine); bounded poll per the FT009 discipline
+            import concurrent.futures as _cf
+
+            fut = asyncio.run_coroutine_threadsafe(
+                self._commit_from_pipeline(res), loop
+            )
+            while True:
+                try:
+                    return fut.result(timeout=5.0)
+                except _cf.TimeoutError:
+                    if fut.done():
+                        return fut.result(timeout=0)
+                    if loop.is_closed():
+                        fut.cancel()
+                        raise RuntimeError(
+                            f"{self.id}: event loop closed while "
+                            f"committing replayed block "
+                            f"{res.block.header.number}"
+                        ) from None
+
+        def hook(pipe):
+            self.pipe = pipe
+
+        src = BlockStore(src_dir)
+        drv = ReplayDriver(
+            self.validator, commit_fn,
+            depth=self.pipeline_depth if depth is None else depth,
+            checkpoint=ReplayCheckpoint(
+                f"{self.ledger.blocks.dir}/replay_checkpoint.json"
+            ),
+            pre_launch_fn=self.verify_block_signature, channel=self.id,
+            coalesce_blocks=self.coalesce_blocks, tracer=self.tracer,
+            pipe_hook=hook,
+        )
+        start = self.height
+        from concurrent.futures import ThreadPoolExecutor
+
+        # dedicated feeder thread, like the deliver driver: submit()
+        # blocks on device syncs and must not starve the shared pool
+        feeder = ThreadPoolExecutor(1, thread_name_prefix="fabtpu-replay")
+        try:
+            stats = await loop.run_in_executor(
+                feeder, lambda: drv.run(src.iter_blocks(start),
+                                        start=start)
+            )
+        finally:
+            feeder.shutdown(wait=False)
+            src.close()
+        stats["resumed_from"] = start
+        return stats
 
     async def wait_height(self, h: int, timeout: float = 30.0):
         loop = asyncio.get_event_loop()

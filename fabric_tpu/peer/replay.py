@@ -1,0 +1,316 @@
+"""Chain replay: the full-occupancy catch-up driver.
+
+Every workload the peer serves live is open-loop — blocks arrive with
+gaps, so the depth-N ``CommitPipeline`` (peer/pipeline.py) never shows
+its ceiling.  Catch-up is the closed-loop case: a joining or restarted
+peer holds (or can pull) the whole chain suffix and wants it validated
+back-to-back.  This module feeds the EXISTING commit machinery from a
+block source with zero inter-block think time:
+
+* **prefetch-ahead decode** — a dedicated reader thread pulls blocks
+  from the source iterator (a ``BlockStore.iter_blocks`` generator
+  reads + proto-decodes lazily, so the file read and unmarshal run on
+  the reader, never on the submit path) into a bounded queue;
+* **bounded in-flight window** — the caller thread drains the queue
+  into ``CommitPipeline.submit`` at the full configured depth; the
+  pipeline's own window bounds device + commit in-flight work, the
+  queue bounds decoded-but-unsubmitted blocks;
+* **progress checkpointing by height** — the committer-side wrapper
+  journals the last committed height (atomic tmp+rename JSON) every
+  ``checkpoint_every`` blocks, so a killed replay resumes exactly
+  where it stopped.  The DESTINATION ledger is the authority —
+  ``KVLedger.commit_block`` refuses out-of-order numbers, so a resume
+  can never double-apply; the checkpoint file is the cheap,
+  crash-readable progress record for operators and drivers that do
+  not hold the ledger open.
+
+Replay is throughput-mode traffic: the driver takes a hold on the
+traffic autopilot (``Autopilot.hold_throughput``) for its duration so
+the shed/BUSY and weight-halving overload rules — tuned for open-loop
+tenant arrivals — do not fire on a closed-loop feed whose queue is
+SUPPOSED to be full.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import threading
+import time
+
+_log = logging.getLogger("fabric_tpu.replay")
+
+#: reader → submit handoff bound: decoded blocks held ahead of the
+#: pipeline.  Small — the pipeline's depth window is the real
+#: in-flight bound; this only needs to hide one read+decode latency.
+DEFAULT_PREFETCH = 8
+
+#: checkpoint cadence (blocks).  Aligned with the blockstore's default
+#: group-commit window so a checkpoint never claims heights an fsync
+#: window could still lose.
+DEFAULT_CHECKPOINT_EVERY = 8
+
+_POLL_S = 5.0  # bounded-wait poll for queue handoffs (FT009)
+
+
+class ReplayCheckpoint:
+    """Crash-readable replay progress: ``{"height": H}`` meaning
+    blocks ``< H`` are committed.  Written atomically (tmp + rename)
+    from the committer side; read at resume."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self) -> int | None:
+        try:
+            with open(self.path) as f:
+                return int(json.load(f)["height"])
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def save(self, height: int) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"height": int(height)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+
+class ReplayDriver:
+    """Drive a ``CommitPipeline`` from a block iterator at full depth.
+
+    ``validator`` / ``commit_fn`` are exactly the pipeline's
+    contract (peer/pipeline.py) — the driver adds the reader thread,
+    the checkpoint journal, and the autopilot throughput hold.  One
+    driver instance runs one ``run()``; build a fresh one to resume.
+    """
+
+    def __init__(self, validator, commit_fn, *, depth: int = 4,
+                 prefetch: int = DEFAULT_PREFETCH,
+                 checkpoint: ReplayCheckpoint | str | None = None,
+                 checkpoint_every: int = DEFAULT_CHECKPOINT_EVERY,
+                 pre_launch_fn=None, channel: str = "",
+                 coalesce_blocks: int = 0, tracer=None, autopilot=None,
+                 pipe_hook=None):
+        self.validator = validator
+        self.depth = max(1, int(depth))
+        self.prefetch = max(1, int(prefetch))
+        if isinstance(checkpoint, str):
+            checkpoint = ReplayCheckpoint(checkpoint)
+        self.checkpoint = checkpoint
+        self.checkpoint_every = max(1, int(checkpoint_every))
+        self.pre_launch_fn = pre_launch_fn
+        self.channel = channel
+        self.coalesce_blocks = int(coalesce_blocks)
+        self.tracer = tracer
+        self._autopilot = autopilot
+        # optional pipe exposure hook: called with the live
+        # CommitPipeline at start and None at teardown, so a hosting
+        # PeerChannel can route autopilot runtime knobs (depth,
+        # coalesce) at it while the replay runs
+        self._pipe_hook = pipe_hook
+        self._inner_commit = commit_fn
+        # committed-progress state: mutated ONLY on the pipeline's
+        # committer thread (commit_fn is serialized there), read by
+        # the run() thread after close() joins it
+        self._committed_blocks = 0
+        self._committed_txs = 0
+        self._last_height: int | None = None
+        self._stop = threading.Event()
+
+    # -- committer-side wrapper ---------------------------------------------
+
+    def _commit(self, res):
+        self._inner_commit(res)
+        self._committed_blocks += 1
+        self._committed_txs += res.n_valid
+        h = res.block.header.number + 1
+        self._last_height = h
+        if (self.checkpoint is not None
+                and self._committed_blocks % self.checkpoint_every == 0):
+            self.checkpoint.save(h)
+
+    # -- the drive loop -----------------------------------------------------
+
+    def run(self, blocks, start: int | None = None) -> dict:
+        """Replay ``blocks`` (an iterator of decoded Block protos —
+        e.g. ``store.iter_blocks(h)``) through the pipeline.  Blocks
+        numbered below ``start`` are skipped without validation (the
+        resume path hands the full iterator and the committed
+        height).  Returns the replay stats dict."""
+        from fabric_tpu.peer.pipeline import CommitPipeline
+
+        ap = self._autopilot
+        if ap is None:
+            from fabric_tpu.control.autopilot import global_autopilot
+
+            ap = global_autopilot()
+        if ap is not None:
+            ap.hold_throughput()
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        reader_exc: list = []
+
+        def reader():
+            # the prefetch-ahead decode stage: the source iterator's
+            # file read + proto unmarshal run HERE, overlapped with
+            # the submit thread's device launches
+            try:
+                for blk in blocks:
+                    if start is not None and blk.header.number < start:
+                        continue
+                    while not self._stop.is_set():
+                        try:
+                            q.put(blk, timeout=_POLL_S)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
+            except BaseException as e:  # surfaced after the drain
+                reader_exc.append(e)
+            finally:
+                while not self._stop.is_set():
+                    try:
+                        q.put(None, timeout=_POLL_S)
+                        break
+                    except queue.Full:
+                        continue
+
+        rt = threading.Thread(target=reader, name="fabtpu-replay-read",
+                              daemon=True)
+        pipe = CommitPipeline(
+            self.validator, self._commit, depth=self.depth,
+            pre_launch_fn=self.pre_launch_fn, channel=self.channel,
+            coalesce_blocks=self.coalesce_blocks, tracer=self.tracer,
+        )
+        if self._pipe_hook is not None:
+            self._pipe_hook(pipe)
+        t0 = time.perf_counter()
+        submitted = 0
+        try:
+            rt.start()
+            while True:
+                try:
+                    blk = q.get(timeout=_POLL_S)
+                except queue.Empty:
+                    if not rt.is_alive():
+                        break  # reader died without its sentinel
+                    continue
+                if blk is None:
+                    break
+                if self.coalesce_blocks >= 2:
+                    # opportunistic launch coalescing over the decoded
+                    # backlog (no wait — only blocks already queued)
+                    group, ended = [blk], False
+                    while len(group) < self.coalesce_blocks:
+                        try:
+                            nxt = q.get_nowait()
+                        except queue.Empty:
+                            break
+                        if nxt is None:
+                            ended = True
+                            break
+                        group.append(nxt)
+                    if len(group) == 1:
+                        pipe.submit(blk)
+                    else:
+                        pipe.submit_many(group)
+                    submitted += len(group)
+                    if ended:
+                        break
+                else:
+                    pipe.submit(blk)
+                    submitted += 1
+        except BaseException:
+            # quarantine-and-stop, like the deliver driver: the
+            # checkpoint + destination height already record exactly
+            # where to resume
+            self._stop.set()
+            pipe.close(flush=False)
+            if pipe.last_failure is not None:
+                num, stage = pipe.last_failure
+                _log.warning(
+                    "%s: replay stopped at a %s-stage failure on "
+                    "block %s; committed height %s", self.channel,
+                    stage, num, self._last_height,
+                )
+            raise
+        else:
+            pipe.close()  # flush the verified tail
+            if reader_exc:
+                raise reader_exc[0]
+        finally:
+            if self._pipe_hook is not None:
+                self._pipe_hook(None)
+            self._stop.set()
+            rt.join(timeout=_POLL_S)
+            if rt.is_alive():
+                _log.warning("%s: replay reader did not stop",
+                             self.channel)
+            if (self.checkpoint is not None
+                    and self._last_height is not None):
+                self.checkpoint.save(self._last_height)
+            if ap is not None:
+                ap.release_throughput()
+        dt = time.perf_counter() - t0
+        stats = {
+            "blocks": self._committed_blocks,
+            "txs_valid": self._committed_txs,
+            "submitted": submitted,
+            "seconds": round(dt, 4),
+            "blocks_per_s": round(self._committed_blocks / dt, 2)
+            if dt > 0 else None,
+            "tx_per_s": round(self._committed_txs / dt, 1)
+            if dt > 0 else None,
+            "height": self._last_height,
+            "depth": self.depth,
+        }
+        if self.tracer is not None and self.depth > 1:
+            try:
+                from fabric_tpu import observe
+
+                cov = observe.coverage_from_roots(
+                    self.tracer.recent_roots(),
+                    window=max(1, self.depth - 1),
+                )
+                cov.pop("per_block", None)
+                stats["pipeline_overlap_coverage"] = cov
+            except Exception as e:
+                _log.debug("replay coverage unavailable: %s", e)
+        return stats
+
+
+def replay_into(ledger, validator, source_store, *, depth: int = 4,
+                prefetch: int = DEFAULT_PREFETCH,
+                checkpoint: ReplayCheckpoint | str | None = None,
+                checkpoint_every: int = DEFAULT_CHECKPOINT_EVERY,
+                coalesce_blocks: int = 0, tracer=None,
+                autopilot=None) -> dict:
+    """Catch ``ledger`` (KVLedger) up from ``source_store`` (a
+    BlockStore holding the chain) — the local-store replay shape the
+    bench, the smoke and ``peer --replay-from`` share.
+
+    Resume comes from the DESTINATION: ``ledger.blocks.height`` names
+    the next block to validate, and ``commit_block``'s in-order check
+    makes a double-apply structurally impossible.  The commit wiring
+    is the bench/peer standard: tx_filter + batch + history + txids +
+    hd_bytes through ``KVLedger.commit_block``."""
+
+    def commit_fn(res):
+        ledger.commit_block(res.block, res.tx_filter, res.batch,
+                            res.history, None, res.txids,
+                            res.pend.hd_bytes)
+
+    start = ledger.blocks.height
+    drv = ReplayDriver(
+        validator, commit_fn, depth=depth, prefetch=prefetch,
+        checkpoint=checkpoint, checkpoint_every=checkpoint_every,
+        coalesce_blocks=coalesce_blocks, tracer=tracer,
+        autopilot=autopilot,
+    )
+    stats = drv.run(source_store.iter_blocks(start), start=start)
+    stats["resumed_from"] = start
+    return stats

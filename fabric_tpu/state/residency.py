@@ -456,7 +456,7 @@ class ResidencyManager:
     # -- admission + eviction ----------------------------------------------
 
     def admit(self, pairs: list, present: np.ndarray,
-              vers: np.ndarray) -> int:
+              vers: np.ndarray, evict: bool = True) -> int:
         """Admit missed keys with their host-gathered committed
         (present, version) values — the miss path's partial range
         upload.  Absent keys are admitted too (``present`` False →
@@ -465,8 +465,10 @@ class ResidencyManager:
 
         Evicts LRU ranges (never ones being admitted by THIS call)
         when the free pool runs dry; keys that still cannot get a slot
-        are simply skipped — they stay misses.  Returns the bytes
-        scattered to device (h2d accounting)."""
+        are simply skipped — they stay misses.  ``evict=False`` admits
+        into free slots only (the bulk warm path must not thrash what
+        it just loaded).  Returns the bytes scattered to device (h2d
+        accounting)."""
         if not self._enabled or not pairs:
             return 0
         idx: list[int] = []
@@ -479,8 +481,8 @@ class ResidencyManager:
                 if pr in self._dir:
                     continue
                 rid = self.range_of(pr[0], pr[1])
-                if not self._free and not self._evict_locked(
-                        protect=admitting | {rid}):
+                if not self._free and not (evict and self._evict_locked(
+                        protect=admitting | {rid})):
                     break  # nothing evictable: the rest stay misses
                 if not self._free:
                     break
@@ -517,6 +519,49 @@ class ResidencyManager:
         self._enabled_gauge.set(1 if self._enabled else 0,
                                 channel=self.channel)
         return nbytes
+
+    def warm(self, items, limit: int | None = None) -> int:
+        """Bulk-admit committed ``(ns, key, (block, txnum))`` triples —
+        the snapshot-join warm path (ledger/snapshot.py
+        ``warm_resident``): instead of faulting the working set in
+        miss-by-miss over the first replayed blocks, the importer
+        streams the snapshot's key ranges straight into free slots.
+
+        Never evicts (``admit(evict=False)``) and stops at capacity —
+        warming must fill the cache, not churn it.  Returns the number
+        of keys admitted."""
+        if not self._enabled:
+            return 0
+        admitted = 0
+        pairs: list = []
+        vers: list = []
+        slab = 8192
+
+        def flush() -> bool:
+            nonlocal admitted
+            if not pairs:
+                return True
+            nb = self.admit(
+                pairs, np.ones(len(pairs), np.bool_),
+                np.asarray(vers, np.int64).reshape(-1, 2), evict=False,
+            )
+            got = nb // SLOT_BYTES
+            admitted += got
+            full = got < len(pairs)
+            pairs.clear()
+            vers.clear()
+            return not full
+
+        for ns, key, ver in items:
+            pairs.append((ns, key))
+            vers.append((int(ver[0]), int(ver[1])))
+            if len(pairs) >= slab:
+                if not flush():
+                    return admitted
+            if limit is not None and admitted + len(pairs) >= limit:
+                break
+        flush()
+        return admitted
 
     def _evict_locked(self, protect: set) -> bool:
         """Evict the least-recently-touched range not in ``protect``;

@@ -25,6 +25,13 @@ if [ "$lint_only" = "1" ]; then
     exit "$lint_rc"
 fi
 
+echo "== replay smoke =="
+# crypto-free catch-up smoke (scripts/replay_smoke.py): toy chain
+# through the REAL ReplayDriver + snapshot round-trip, pinning the
+# source ≡ full-replay ≡ snapshot-join identity in seconds
+timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/replay_smoke.py
+smoke_rc=$?
+
 echo "== tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
@@ -36,5 +43,6 @@ t1_rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
 
 [ "$lint_rc" -ne 0 ] && echo "analyzer battery FAILED (rc=$lint_rc)"
+[ "$smoke_rc" -ne 0 ] && echo "replay smoke FAILED (rc=$smoke_rc)"
 [ "$t1_rc" -ne 0 ] && echo "tier-1 tests FAILED (rc=$t1_rc)"
-[ "$lint_rc" -eq 0 ] && [ "$t1_rc" -eq 0 ]
+[ "$lint_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$t1_rc" -eq 0 ]

@@ -1,0 +1,234 @@
+"""Fast catch-up smoke for CI tier-1 (crypto-free, ~8 toy blocks).
+
+Drives the ISSUE 18 catch-up path end to end in seconds with no
+``cryptography`` and no device: a toy JSON validator (the
+tests/test_resident.py wire form) through the REAL ``ReplayDriver`` /
+``CommitPipeline`` / ``KVLedger`` / snapshot stack —
+
+1. stage a dependent 8-block chain into a source ledger via the
+   replay driver (checkpoint journal armed);
+2. export a Fabric-shaped snapshot at the mid-chain boundary, then
+   RESUME the driver for the tail (exercising the skip-below-height
+   path a restarted replay takes);
+3. bootstrap a joining ledger from the snapshot and replay the
+   suffix with ``replay_into`` (``resumed_from`` must equal the
+   snapshot height);
+4. replay a second ledger from genesis as the oracle, and pin the
+   byte-identity triangle: source ≡ full-replay ≡ snapshot-join on
+   state digest, commit hash and height.
+
+Exit 0 with a JSON summary on success; any divergence raises.
+
+Usage: python scripts/replay_smoke.py
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+from dataclasses import dataclass
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fabric_tpu import protoutil as pu
+from fabric_tpu.ledger import snapshot as snaplib
+from fabric_tpu.ledger.kvledger import KVLedger
+from fabric_tpu.ledger.statedb import MemVersionedDB, UpdateBatch
+from fabric_tpu.peer.replay import ReplayDriver, replay_into
+
+N_BLOCKS = 8
+N_TX = 6
+SNAP_AT = 4  # snapshot boundary: blocks [0, 4) in, [4, 8) replayed
+
+
+@dataclass
+class _Ptx:
+    txid: str
+    idx: int
+    is_config: bool = False
+
+
+@dataclass
+class _Pend:
+    block: object
+    txs: list
+    raw: list
+    overlay: object
+    extra: object
+    hd_bytes: bytes | None = None  # the ledger takes None: re-serialize
+
+    @property
+    def txids(self):
+        return {p.txid for p in self.txs if p.txid}
+
+
+class ToyValidator:
+    """Crypto-free pipeline validator (the test_resident.py host-oracle
+    shape): JSON txs {"id", "reads": {k: [b, t] | None}, "writes":
+    {k: v}, "deletes": [k]}, MVCC against the ledger state with the
+    in-flight overlay honored — the chain below has cross-block reads
+    inside the depth window, so replay correctness depends on it."""
+
+    VALID, DUP, MVCC = 0, 2, 11
+
+    def __init__(self, state):
+        self.state = state
+
+    def preprocess(self, block):
+        return [json.loads(bytes(d)) for d in block.data.data]
+
+    def validate_launch(self, block, pre=None, overlay=None,
+                        extra_txids=None):
+        raw = pre if pre is not None else self.preprocess(block)
+        txs = [_Ptx(t["id"], i) for i, t in enumerate(raw)]
+        return _Pend(block, txs, raw, overlay, extra_txids)
+
+    def _version(self, pr, over):
+        if pr in over:
+            return over[pr]
+        vv = self.state.get_state(*pr)
+        return None if vv is None else tuple(vv.version)
+
+    def validate_finish(self, pend):
+        over = {}
+        if pend.overlay is not None:
+            for pr, vv in pend.overlay.updates.items():
+                over[pr] = None if vv.value is None else tuple(vv.version)
+        codes = []
+        batch = UpdateBatch()
+        num = pend.block.header.number
+        seen = set(pend.extra or ())
+        for ptx, t in zip(pend.txs, pend.raw):
+            if ptx.txid in seen:
+                codes.append(self.DUP)
+                continue
+            seen.add(ptx.txid)
+            ok = all(
+                self._version(("cc", k), over)
+                == (None if want is None else tuple(want))
+                for k, want in t.get("reads", {}).items()
+            )
+            if not ok:
+                codes.append(self.MVCC)
+                continue
+            codes.append(self.VALID)
+            for k, val in t.get("writes", {}).items():
+                batch.put("cc", k, val.encode(), (num, ptx.idx))
+            for k in t.get("deletes", ()):
+                batch.delete("cc", k, (num, ptx.idx))
+        return bytes(codes), batch, []
+
+
+def build_chain(n_blocks=N_BLOCKS, n_tx=N_TX):
+    """Dependent stream: a hot key every block re-reads, k→k+1 fresh
+    reads that cross the pipeline window, one stale lane per block
+    (→ MVCC reject, so tx_filters are non-trivial) and deletes."""
+    blocks, prev = [], b""
+    for n in range(n_blocks):
+        txs = []
+        for i in range(n_tx):
+            t = {"id": f"t{n}_{i}", "writes": {f"k{n}_{i}": f"v{n}"}}
+            if i == 0:
+                t["reads"] = {"hot": [0, 0] if n else None}
+                if n == 0:
+                    t["writes"]["hot"] = "h"
+            if n > 0 and i == 1:
+                t["reads"] = {f"k{n-1}_1": [n - 1, 1]}
+            if n > 1 and i == 4:
+                t["reads"] = {f"k{n-2}_4": [0, 0]}  # stale → MVCC
+            if n > 0 and i == 5:
+                t["deletes"] = [f"k{n-1}_5"]
+                t["reads"] = {f"k{n-1}_5": [n - 1, 5]}
+            txs.append(t)
+        blk = pu.new_block(n, prev)
+        for t in txs:
+            blk.data.data.append(json.dumps(t).encode())
+        blk = pu.finalize_block(blk)
+        prev = pu.block_header_hash(blk.header)
+        blocks.append(blk)
+    return blocks
+
+
+def drive(ledger, blocks, ckpt, start=None):
+    """One ReplayDriver pass feeding ``ledger`` from an in-memory
+    iterator (the driver takes any decoded-Block iterable)."""
+    v = ToyValidator(ledger.state)
+
+    def commit_fn(res):
+        ledger.commit_block(res.block, res.tx_filter, res.batch,
+                            res.history, None, res.txids,
+                            res.pend.hd_bytes)
+
+    drv = ReplayDriver(v, commit_fn, depth=2, checkpoint=ckpt,
+                       checkpoint_every=2)
+    return drv.run(iter(blocks), start=start)
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="replaysmoke")
+    try:
+        blocks = build_chain()
+
+        # 1. stage the source in two driver passes around the snapshot
+        src = KVLedger(os.path.join(tmp, "src"), state_db=MemVersionedDB())
+        ckpt = os.path.join(tmp, "src_ckpt.json")
+        s1 = drive(src, blocks[:SNAP_AT], ckpt)
+        assert src.height == SNAP_AT and s1["blocks"] == SNAP_AT, s1
+
+        snap_dir = os.path.join(tmp, "snap")
+        meta = snaplib.generate_snapshot(src, snap_dir, channel_id="smoke")
+        assert meta["height"] == SNAP_AT, meta
+
+        # 2. resume: hand the driver the FULL chain + committed height —
+        # the below-start skip must land exactly on block SNAP_AT
+        s2 = drive(src, blocks, ckpt, start=src.height)
+        assert src.height == N_BLOCKS and s2["blocks"] == N_BLOCKS - SNAP_AT, s2
+        with open(ckpt) as f:
+            assert json.load(f)["height"] == N_BLOCKS
+
+        # 3. snapshot join: import + replay the suffix off the source store
+        join, jmeta = snaplib.create_from_snapshot(
+            os.path.join(tmp, "snap"), os.path.join(tmp, "join"),
+            state_db=MemVersionedDB(),
+        )
+        assert jmeta["height"] == SNAP_AT
+        js = replay_into(join, ToyValidator(join.state), src.blocks,
+                         depth=2,
+                         checkpoint=os.path.join(tmp, "join_ckpt.json"))
+        assert js["resumed_from"] == SNAP_AT, js
+        assert js["blocks"] == N_BLOCKS - SNAP_AT, js
+
+        # 4. oracle: full replay from genesis, then the identity triangle
+        full = KVLedger(os.path.join(tmp, "full"), state_db=MemVersionedDB())
+        fs = replay_into(full, ToyValidator(full.state), src.blocks, depth=2)
+        assert fs["resumed_from"] == 0 and fs["blocks"] == N_BLOCKS, fs
+
+        digests = {name: lg.state_digest()
+                   for name, lg in (("src", src), ("join", join),
+                                    ("full", full))}
+        assert len(set(digests.values())) == 1, f"state diverged: {digests}"
+        hashes = {n: lg.commit_hash.hex()
+                  for n, lg in (("src", src), ("join", join), ("full", full))}
+        assert len(set(hashes.values())) == 1, f"commit chain diverged: {hashes}"
+        assert src.height == join.height == full.height == N_BLOCKS
+
+        print(json.dumps({
+            "ok": True,
+            "height": src.height,
+            "state_digest": digests["src"][:16],
+            "commit_hash": hashes["src"][:16],
+            "stage": {"blocks_per_s": s1["blocks_per_s"]},
+            "resume": {"resumed": s2["blocks"]},
+            "snapshot_join": {"replayed": js["blocks"],
+                              "resumed_from": js["resumed_from"]},
+        }))
+        for lg in (src, join, full):
+            lg.close()
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

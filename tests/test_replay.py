@@ -1,0 +1,500 @@
+"""Chain replay + snapshot join battery (fabric_tpu/peer/replay.py,
+ledger/snapshot.py) — crypto-free.
+
+Layers:
+
+1. replay ≡ serial oracle differential: a dependent toy chain staged
+   into a real ``KVLedger``/``BlockStore``, replayed through
+   ``ReplayDriver`` at depths 1/2/4 — state digest, commit hash and
+   height identical to a no-pipeline serial validate+commit loop over
+   the same store;
+2. kill-mid-replay chaos: a commit-stage crash stops the driver with
+   the destination at the exact failed height; a fresh ``replay_into``
+   resumes from there and every block commits EXACTLY once (the
+   ledger's in-order check makes a double-apply structurally
+   impossible — pinned by tracking committed block numbers);
+3. snapshot-then-replay differential under the async committer ON and
+   OFF: export at a mid-chain boundary, bootstrap a fresh ledger,
+   replay the suffix — byte-identical (digest + commit hash) to the
+   replay-from-genesis oracle;
+4. resident-cache warm off snapshot key ranges: free-slot-only bulk
+   admission, zero evictions, warmed keys serve lookup hits;
+5. the autopilot throughput hold: shed/weight overload rules are
+   suppressed while a replay holds the pilot, re-arm on release.
+"""
+
+import json
+import os
+from dataclasses import dataclass
+
+import numpy as np
+import pytest
+
+from fabric_tpu import protoutil as pu
+from fabric_tpu.control import Autopilot, Signals
+from fabric_tpu.ledger import snapshot as snap
+from fabric_tpu.ledger.kvledger import KVLedger
+from fabric_tpu.ledger.statedb import MemVersionedDB, UpdateBatch
+from fabric_tpu.observe import Tracer
+from fabric_tpu.ops_metrics import Registry
+from fabric_tpu.peer.replay import (
+    ReplayCheckpoint,
+    ReplayDriver,
+    replay_into,
+)
+from fabric_tpu.state import ResidencyManager
+
+N_BLOCKS = 8
+N_TX = 5
+
+
+# ---------------------------------------------------------------------------
+# the toy validator (the test_resident.py host-oracle wire form)
+
+
+@dataclass
+class _Ptx:
+    txid: str
+    idx: int
+    is_config: bool = False
+
+
+@dataclass
+class _Pend:
+    block: object
+    txs: list
+    raw: list
+    overlay: object
+    extra: object
+    hd_bytes: bytes | None = None
+
+    @property
+    def txids(self):
+        return {p.txid for p in self.txs if p.txid}
+
+
+class ToyValidator:
+    """Crypto-free pipeline validator: JSON txs {"id", "reads",
+    "writes", "deletes"}, MVCC against the ledger state with the
+    in-flight overlay honored."""
+
+    VALID, DUP, MVCC = 0, 2, 11
+
+    def __init__(self, state):
+        self.state = state
+
+    def preprocess(self, block):
+        return [json.loads(bytes(d)) for d in block.data.data]
+
+    def validate_launch(self, block, pre=None, overlay=None,
+                        extra_txids=None):
+        raw = pre if pre is not None else self.preprocess(block)
+        txs = [_Ptx(t["id"], i) for i, t in enumerate(raw)]
+        return _Pend(block, txs, raw, overlay, extra_txids)
+
+    def _version(self, pr, over):
+        if pr in over:
+            return over[pr]
+        vv = self.state.get_state(*pr)
+        return None if vv is None else tuple(vv.version)
+
+    def validate_finish(self, pend):
+        over = {}
+        if pend.overlay is not None:
+            for pr, vv in pend.overlay.updates.items():
+                over[pr] = None if vv.value is None else tuple(vv.version)
+        codes = []
+        batch = UpdateBatch()
+        num = pend.block.header.number
+        seen = set(pend.extra or ())
+        for ptx, t in zip(pend.txs, pend.raw):
+            if ptx.txid in seen:
+                codes.append(self.DUP)
+                continue
+            seen.add(ptx.txid)
+            ok = all(
+                self._version(("cc", k), over)
+                == (None if want is None else tuple(want))
+                for k, want in t.get("reads", {}).items()
+            )
+            if not ok:
+                codes.append(self.MVCC)
+                continue
+            codes.append(self.VALID)
+            for k, val in t.get("writes", {}).items():
+                batch.put("cc", k, val.encode(), (num, ptx.idx))
+            for k in t.get("deletes", ()):
+                batch.delete("cc", k, (num, ptx.idx))
+        return bytes(codes), batch, []
+
+
+def _build_chain(n_blocks=N_BLOCKS, n_tx=N_TX):
+    """Dependent stream: hot re-reads, k→k+1 reads crossing the
+    pipeline window, a stale lane per block (non-trivial filters) and
+    deletes."""
+    blocks, prev = [], b""
+    for n in range(n_blocks):
+        txs = []
+        for i in range(n_tx):
+            t = {"id": f"t{n}_{i}", "writes": {f"k{n}_{i}": f"v{n}"}}
+            if i == 0:
+                t["reads"] = {"hot": [0, 0] if n else None}
+                if n == 0:
+                    t["writes"]["hot"] = "h"
+            if n > 0 and i == 1:
+                t["reads"] = {f"k{n-1}_1": [n - 1, 1]}
+            if n > 1 and i == 3:
+                t["reads"] = {f"k{n-2}_3": [0, 0]}  # stale → MVCC
+            if n > 0 and i == 4:
+                t["deletes"] = [f"k{n-1}_4"]
+                t["reads"] = {f"k{n-1}_4": [n - 1, 4]}
+            txs.append(t)
+        blk = pu.new_block(n, prev)
+        for t in txs:
+            blk.data.data.append(json.dumps(t).encode())
+        blk = pu.finalize_block(blk)
+        prev = pu.block_header_hash(blk.header)
+        blocks.append(blk)
+    return blocks
+
+
+def _commit_fn(ledger, log=None):
+    def commit(res):
+        ledger.commit_block(res.block, res.tx_filter, res.batch,
+                            res.history, None, res.txids,
+                            res.pend.hd_bytes)
+        if log is not None:
+            log.append(res.block.header.number)
+
+    return commit
+
+
+@pytest.fixture()
+def source(tmp_path):
+    """The staged source chain: a real KVLedger whose BlockStore every
+    replay below reads (fresh proto decodes per iteration — the
+    in-memory blocks are mutated by their one staging commit)."""
+    lg = KVLedger(str(tmp_path / "src"), state_db=MemVersionedDB())
+    drv = ReplayDriver(ToyValidator(lg.state), _commit_fn(lg), depth=2)
+    drv.run(iter(_build_chain()))
+    assert lg.height == N_BLOCKS
+    yield lg
+    lg.close()
+
+
+def _ident(lg):
+    return lg.state_digest(), lg.commit_hash, lg.height
+
+
+# ---------------------------------------------------------------------------
+# 1. replay ≡ serial oracle
+
+
+class TestReplayDifferential:
+    def _serial_oracle(self, source, tmp_path):
+        lg = KVLedger(str(tmp_path / "oracle"), state_db=MemVersionedDB())
+        v = ToyValidator(lg.state)
+        for blk in source.blocks.iter_blocks(0):
+            pend = v.validate_launch(blk)
+            codes, batch, hist = v.validate_finish(pend)
+            lg.commit_block(blk, codes, batch, hist, None,
+                            [(p.txid, p.idx) for p in pend.txs], None)
+        return lg
+
+    @pytest.mark.parametrize("depth", [1, 2, 4])
+    def test_depths_match_serial(self, source, tmp_path, depth):
+        oracle = self._serial_oracle(source, tmp_path)
+        lg = KVLedger(str(tmp_path / f"d{depth}"),
+                      state_db=MemVersionedDB())
+        stats = replay_into(lg, ToyValidator(lg.state), source.blocks,
+                            depth=depth)
+        assert stats["blocks"] == N_BLOCKS
+        assert stats["resumed_from"] == 0
+        assert stats["submitted"] == N_BLOCKS
+        # commit hash chains over every tx_filter: equality pins the
+        # per-block verdicts, not just the end state
+        assert _ident(lg) == _ident(oracle)
+        lg.close()
+        oracle.close()
+
+    def test_stats_and_checkpoint(self, source, tmp_path):
+        ck = str(tmp_path / "ck.json")
+        lg = KVLedger(str(tmp_path / "dest"), state_db=MemVersionedDB())
+        stats = replay_into(lg, ToyValidator(lg.state), source.blocks,
+                            depth=2, checkpoint=ck, checkpoint_every=3)
+        assert stats["txs_valid"] == sum(
+            1 for b in range(N_BLOCKS) for _ in range(N_TX)
+        ) - 6  # one MVCC-stale lane per block from #2 on
+        assert ReplayCheckpoint(ck).load() == N_BLOCKS
+        # replaying an up-to-date ledger is a no-op, not an error
+        again = replay_into(lg, ToyValidator(lg.state), source.blocks,
+                            depth=2)
+        assert again["blocks"] == 0 and again["resumed_from"] == N_BLOCKS
+        assert lg.height == N_BLOCKS
+        lg.close()
+
+    def test_checkpoint_corrupt_file_loads_none(self, tmp_path):
+        p = tmp_path / "ck.json"
+        p.write_text("{not json")
+        assert ReplayCheckpoint(str(p)).load() is None
+        ReplayCheckpoint(str(p)).save(7)
+        assert ReplayCheckpoint(str(p)).load() == 7
+
+
+# ---------------------------------------------------------------------------
+# 2. kill mid-replay, resume, no double-apply
+
+
+class TestKillResume:
+    @pytest.mark.parametrize("kill_at", [2, 5])
+    def test_crash_resume_exactly_once(self, source, tmp_path, kill_at):
+        lg = KVLedger(str(tmp_path / "dest"), state_db=MemVersionedDB())
+        committed: list[int] = []
+        inner = _commit_fn(lg, committed)
+
+        def crashing(res):
+            if res.block.header.number == kill_at:
+                raise RuntimeError("killed mid-replay")
+            inner(res)
+
+        ck = str(tmp_path / "ck.json")
+        drv = ReplayDriver(ToyValidator(lg.state), crashing, depth=2,
+                           checkpoint=ck, checkpoint_every=1)
+        with pytest.raises(RuntimeError, match="killed"):
+            drv.run(source.blocks.iter_blocks(0), start=0)
+        assert lg.height == kill_at
+        # the checkpoint never runs ahead of the committed height
+        saved = ReplayCheckpoint(ck).load()
+        assert saved is not None and saved <= kill_at
+
+        # resume with a fresh driver off the destination height, the
+        # SAME commit log spanning both passes
+        drv2 = ReplayDriver(ToyValidator(lg.state),
+                            _commit_fn(lg, committed), depth=2,
+                            checkpoint=ck)
+        stats = drv2.run(source.blocks.iter_blocks(lg.height),
+                         start=lg.height)
+        assert stats["blocks"] == N_BLOCKS - kill_at
+        assert ReplayCheckpoint(ck).load() == N_BLOCKS
+        # across crash + resume, every block committed EXACTLY once
+        assert committed == list(range(N_BLOCKS))
+
+        oracle = KVLedger(str(tmp_path / "oracle"),
+                          state_db=MemVersionedDB())
+        replay_into(oracle, ToyValidator(oracle.state), source.blocks,
+                    depth=2)
+        assert _ident(lg) == _ident(oracle)
+        lg.close()
+        oracle.close()
+
+    def test_double_apply_is_structurally_impossible(self, source,
+                                                     tmp_path):
+        lg = KVLedger(str(tmp_path / "dest"), state_db=MemVersionedDB())
+        replay_into(lg, ToyValidator(lg.state), source.blocks, depth=2)
+        blk = next(iter(source.blocks.iter_blocks(3)))
+        v = ToyValidator(lg.state)
+        pend = v.validate_launch(blk)
+        codes, batch, hist = v.validate_finish(pend)
+        with pytest.raises(ValueError, match="out of order"):
+            lg.commit_block(blk, codes, batch, hist, None, [], None)
+        lg.close()
+
+
+# ---------------------------------------------------------------------------
+# 3. snapshot-then-replay ≡ replay-from-genesis (async ON and OFF)
+
+
+class TestSnapshotJoinDifferential:
+    @pytest.mark.parametrize("async_commit", [False, True])
+    def test_join_byte_identical(self, tmp_path, async_commit):
+        join_at = 4
+        blocks = _build_chain()
+        src = KVLedger(str(tmp_path / "src"), state_db=MemVersionedDB(),
+                       async_commit=async_commit)
+        drv = ReplayDriver(ToyValidator(src.state), _commit_fn(src),
+                           depth=2)
+        drv.run(iter(blocks[:join_at]))
+        snap_dir = str(tmp_path / "snap")
+        meta = snap.generate_snapshot(src, snap_dir, channel_id="t")
+        # the export records the boundary height AND the exporter's
+        # recovery anchor (drained first under the async engine)
+        assert meta["height"] == join_at
+        assert meta["state_savepoint"] is not None
+        ReplayDriver(ToyValidator(src.state), _commit_fn(src),
+                     depth=2).run(iter(blocks), start=src.height)
+        assert src.height == N_BLOCKS
+
+        join, jmeta = snap.create_from_snapshot(
+            snap_dir, str(tmp_path / "join"), state_db=MemVersionedDB(),
+            async_commit=async_commit,
+        )
+        assert jmeta["height"] == join_at
+        js = replay_into(join, ToyValidator(join.state), src.blocks,
+                         depth=2)
+        assert js["resumed_from"] == join_at
+        assert js["blocks"] == N_BLOCKS - join_at
+
+        full = KVLedger(str(tmp_path / "full"),
+                        state_db=MemVersionedDB(),
+                        async_commit=async_commit)
+        replay_into(full, ToyValidator(full.state), src.blocks, depth=2)
+
+        assert _ident(join) == _ident(full) == _ident(src)
+        for lg in (src, join, full):
+            lg.close()
+
+    def test_state_digest_order_insensitive(self):
+        a, b = MemVersionedDB(), MemVersionedDB()
+        for db, order in ((a, (0, 1, 2)), (b, (2, 0, 1))):
+            for i in order:
+                batch = UpdateBatch()
+                batch.put("cc", f"k{i}", b"v%d" % i, (1, i))
+                db.apply_updates(batch, (1, i))
+        assert snap.state_digest(a) == snap.state_digest(b)
+        extra = UpdateBatch()
+        extra.put("cc", "k9", b"v9", (2, 0))
+        b.apply_updates(extra, (2, 0))
+        assert snap.state_digest(a) != snap.state_digest(b)
+
+
+# ---------------------------------------------------------------------------
+# 4. resident warm off snapshot key ranges
+
+
+class TestResidentWarm:
+    def _triples(self, n, ns="cc"):
+        return [(ns, f"w{i:04d}", (1, i)) for i in range(n)]
+
+    def test_warm_fills_free_slots_and_serves_hits(self):
+        res = ResidencyManager(slots=32, range_bits=4)
+        n = res.warm(self._triples(8))
+        assert n == 8
+        st = res.stats()
+        assert st["resident_keys"] == 8 and st["evictions_total"] == 0
+        slots, table = res.lookup([("cc", "w0003"), ("cc", "w0007"),
+                                   ("cc", "nope")])
+        assert slots[0] >= 0 and slots[1] >= 0 and slots[2] == -1
+        row = np.asarray(table)[slots[0]]
+        assert row[0] == 1  # present
+        assert tuple(int(x) for x in row[1:3].view(np.uint32)) == (1, 3)
+
+    def test_warm_stops_at_capacity_without_evicting(self):
+        res = ResidencyManager(slots=8, range_bits=4)
+        n = res.warm(self._triples(64))
+        assert 0 < n <= 8
+        st = res.stats()
+        assert st["evictions_total"] == 0
+        assert st["resident_keys"] == n
+        # a later warm of already-resident keys admits nothing new
+        assert res.warm(self._triples(4)) == 0
+
+    def test_warm_respects_limit_and_disabled(self):
+        res = ResidencyManager(slots=32, range_bits=4)
+        assert res.warm(self._triples(16), limit=5) == 5
+        res.disable("test latch")
+        assert res.warm(self._triples(16)) == 0
+
+    def test_warm_resident_reads_snapshot(self, tmp_path):
+        src = KVLedger(str(tmp_path / "src"), state_db=MemVersionedDB())
+        ReplayDriver(ToyValidator(src.state), _commit_fn(src),
+                     depth=2).run(iter(_build_chain(4)))
+        snap_dir = str(tmp_path / "snap")
+        snap.generate_snapshot(src, snap_dir, channel_id="t")
+        res = ResidencyManager(slots=256, range_bits=4)
+        n = snap.warm_resident(res, snap_dir)
+        assert n == res.stats()["resident_keys"] > 0
+        # every exported record is a lookup hit now
+        recs = list(snap.iter_state_records(snap_dir))
+        slots, _tbl = res.lookup([(ns, k) for ns, k, *_ in recs])
+        assert all(s >= 0 for s in slots)
+        assert snap.warm_resident(None, snap_dir) == 0
+        src.close()
+
+
+# ---------------------------------------------------------------------------
+# 5. autopilot throughput hold
+
+
+class _Clk:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _pilot(clk, sheds):
+    return Autopilot(
+        None, lambda k, v: None,
+        set_shed=lambda t, on: sheds.append((t, on)),
+        tracer=Tracer(ring_blocks=16, slow_factor=0, clock=clk),
+        clock=clk, registry=Registry(),
+        initial={"coalesce_blocks": 0, "verify_chunk": 0,
+                 "pipeline_depth": 2},
+    )
+
+
+class TestThroughputHold:
+    BURN = {("lat", "sidecar:noisy"): 9.0}
+
+    def test_hold_suppresses_shed_release_rearms(self):
+        clk, sheds = _Clk(), []
+        ap = _pilot(clk, sheds)
+        ap.hold_throughput()
+        assert ap.throughput_mode
+        assert ap.report()["throughput_mode"] is True
+        # a closed-loop replay keeps queues full by design: the
+        # overload rules must not fire while the hold is up
+        clk.t = 20.0
+        assert ap.tick(Signals(burn=self.BURN, clock_s=20.0)) is None
+        assert sheds == []
+        ap.release_throughput()
+        assert not ap.throughput_mode
+        clk.t = 40.0
+        d = ap.tick(Signals(burn=self.BURN, clock_s=40.0))
+        assert d is not None and d.knob == "shed"
+        assert sheds == [("noisy", True)]
+
+    def test_hold_is_refcounted(self):
+        clk, sheds = _Clk(), []
+        ap = _pilot(clk, sheds)
+        ap.hold_throughput()
+        ap.hold_throughput()
+        ap.release_throughput()
+        assert ap.throughput_mode  # one replay still running
+        clk.t = 20.0
+        assert ap.tick(Signals(burn=self.BURN, clock_s=20.0)) is None
+        ap.release_throughput()
+        assert not ap.throughput_mode
+
+    def test_driver_takes_and_releases_hold(self, source, tmp_path):
+        clk, sheds = _Clk(), []
+        ap = _pilot(clk, sheds)
+        lg = KVLedger(str(tmp_path / "dest"), state_db=MemVersionedDB())
+        seen = []
+
+        def probe(res):
+            seen.append(ap.throughput_mode)
+            _commit_fn(lg)(res)
+
+        ReplayDriver(ToyValidator(lg.state), probe, depth=2,
+                     autopilot=ap).run(source.blocks.iter_blocks(0))
+        assert seen and all(seen)  # held for every commit...
+        assert not ap.throughput_mode  # ...released at the end
+        lg.close()
+
+    def test_hold_released_even_when_replay_crashes(self, source,
+                                                    tmp_path):
+        clk, sheds = _Clk(), []
+        ap = _pilot(clk, sheds)
+        lg = KVLedger(str(tmp_path / "dest"), state_db=MemVersionedDB())
+
+        def boom(res):
+            raise RuntimeError("commit exploded")
+
+        drv = ReplayDriver(ToyValidator(lg.state), boom, depth=2,
+                           autopilot=ap)
+        with pytest.raises(RuntimeError, match="exploded"):
+            drv.run(source.blocks.iter_blocks(0))
+        assert not ap.throughput_mode
+        lg.close()

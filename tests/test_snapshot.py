@@ -122,3 +122,63 @@ def test_snapshot_roundtrip_and_join(material, tmp_path):
     assert list(flt_dup) == [C.DUPLICATE_TXID]
     src.stop()
     dst.stop()
+
+
+def test_snapshot_metadata_height_and_savepoint(material, tmp_path):
+    """ISSUE 18: the export records the boundary height and the
+    exporter's state savepoint, and the import reproduces both — the
+    replay driver resumes from ``meta['height']`` with savepoint/height
+    reconciliation the identity on reopen."""
+    src = PeerChannel(
+        CHANNEL, str(tmp_path / "src"), genesis_block=material["genesis"]
+    )
+    cd = lc.ChaincodeDefinition(name=CC, sequence=1)
+    env_lc, _ = _tx(material, [(lc.definition_key(CC), cd.to_bytes())],
+                    ns=lc.LIFECYCLE_NS)
+    _commit(src, [env_lc])
+    env1, _ = _tx(material, [("alpha", b"1")])
+    _commit(src, [env1])
+
+    meta = asyncio.run(src.snapshot(str(tmp_path / "snap")))
+    assert meta["height"] == src.height == 3
+    assert meta["height"] == meta["last_block_number"] + 1
+    sp = meta["state_savepoint"]
+    assert sp is not None and tuple(sp)[0] == meta["last_block_number"]
+
+    dst = PeerChannel(
+        CHANNEL, str(tmp_path / "dst"), snapshot_dir=str(tmp_path / "snap")
+    )
+    assert tuple(dst.ledger.state.savepoint()) == tuple(sp)
+    src.stop()
+    dst.stop()
+
+
+def test_snapshot_join_state_digest_matches_source(material, tmp_path):
+    """The order-insensitive state digest (ledger/snapshot.py) is the
+    byte-identity oracle: a joined peer's digest equals the serving
+    peer's at the boundary AND after both commit the next block."""
+    src = PeerChannel(
+        CHANNEL, str(tmp_path / "src"), genesis_block=material["genesis"]
+    )
+    cd = lc.ChaincodeDefinition(name=CC, sequence=1)
+    env_lc, _ = _tx(material, [(lc.definition_key(CC), cd.to_bytes())],
+                    ns=lc.LIFECYCLE_NS)
+    _commit(src, [env_lc])
+    env1, _ = _tx(material, [("alpha", b"1"), ("beta", b"2")])
+    _commit(src, [env1])
+
+    asyncio.run(src.snapshot(str(tmp_path / "snap")))
+    dst = PeerChannel(
+        CHANNEL, str(tmp_path / "dst"), snapshot_dir=str(tmp_path / "snap")
+    )
+    assert (dst.ledger.state_digest() == src.ledger.state_digest())
+
+    env2, _ = _tx(material, [("gamma", b"3")])
+    _flt, blk = _commit(src, [env2])
+    blk2 = type(blk)()
+    blk2.CopyFrom(src.ledger.blocks.get_block(3))
+    asyncio.run(dst.commit_block(blk2))
+    assert dst.ledger.state_digest() == src.ledger.state_digest()
+    assert dst.ledger.commit_hash == src.ledger.commit_hash
+    src.stop()
+    dst.stop()
