@@ -373,7 +373,7 @@ def _build_commit_network(n_tx: int, n_blocks: int = 1,
         k = _bench_knobs()
         v = BlockValidator(
             mgr, prov, state, verify_chunk=k["verify_chunk"],
-            mesh_devices=k["mesh_devices"],
+            mesh_devices=k["shards"] or k["mesh_devices"],
             host_stage_workers=k["host_stage_workers"],
             recode_device=bool(k["recode_device"]),
             state_resident=bool(k["state_resident"]),
@@ -398,6 +398,11 @@ def _bench_knobs() -> dict:
     return {
         "verify_chunk": int(os.environ.get("FABTPU_BENCH_VERIFY_CHUNK", "0")),
         "mesh_devices": int(os.environ.get("FABTPU_BENCH_MESH", "0")),
+        # shard-count A/B (parallel/mesh partition rules): overrides
+        # FABTPU_BENCH_MESH when set, so `FABTPU_BENCH_SHARDS=4` vs
+        # `=8` sweeps the data-axis width with one knob; the JSON's
+        # extras.shard_balance attributes the skew either way
+        "shards": int(os.environ.get("FABTPU_BENCH_SHARDS", "0")),
         "coalesce_blocks": int(os.environ.get("FABTPU_BENCH_COALESCE", "0")),
         # host staging pool workers (0 = serial staging, so CPU-only
         # containers measure the unpooled path unregressed; -1 = cores)
@@ -543,6 +548,31 @@ def _resident_extras(fresh_validator) -> dict | None:
     if res is None:
         return None
     return res.stats()
+
+
+def _shard_balance_extras(fresh_validator) -> dict | None:
+    """extras.shard_balance: per-shard occupancy skew of the key-range
+    resident table plus the mesh data-axis width and the silent
+    single-device fallback counts (parallel/mesh
+    ``mesh_shard_fallback_total``) — read off the last validator the
+    run built; None when no mesh resolved (the CPU-only default)."""
+    created = getattr(fresh_validator, "created", None)
+    if not created:
+        return None
+    v = created[-1]
+    mesh = getattr(v, "mesh", None)
+    if mesh is None:
+        return None
+    from fabric_tpu.parallel import mesh as pmesh
+
+    out = {"data_axis": pmesh.data_axis_size(mesh)}
+    fb = pmesh.fallback_stats()
+    if fb:
+        out["fallbacks"] = fb
+    res = getattr(v, "resident", None)
+    if res is not None:
+        out.update(res.shard_balance())
+    return out
 
 
 def _close_validators(fresh_validator) -> None:
@@ -827,6 +857,7 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5,
     cpu_rate = total / cpu_s
     host_stage = _host_stage_extras(fresh_validator)
     resident = _resident_extras(fresh_validator)
+    shard_balance = _shard_balance_extras(fresh_validator)
     _close_validators(fresh_validator)
     return {
         "metric": f"validated_tx_per_sec_block{n_tx}" + ("_mixed" if invalid_frac else ""),
@@ -838,6 +869,9 @@ def _bench_block_commit(n_tx: int = 1000, n_blocks: int = 5,
         # the resident A/B record: hit rate / evictions / uploaded
         # state bytes next to the state_fill ms in per_block_ms
         "resident_state": resident,
+        # per-shard lane counts / key-range occupancy skew when a mesh
+        # resolved (FABTPU_BENCH_SHARDS or FABTPU_BENCH_MESH)
+        "shard_balance": shard_balance,
         # apply-queue telemetry of the final timed run (None when the
         # serial engine ran, i.e. FABTPU_BENCH_ASYNC_COMMIT=0)
         "commit_engine": engine_stats,
@@ -935,6 +969,7 @@ def _bench_block_commit_sustained(n_tx: int = 1000, n_blocks: int = 50):
 
     host_stage = _host_stage_extras(fresh_validator)
     resident = _resident_extras(fresh_validator)
+    shard_balance = _shard_balance_extras(fresh_validator)
     _close_validators(fresh_validator)
     # per-block commit latency; the first 3 blocks eat the compiles
     # and cache warms — excluded from the percentiles, stated as such
@@ -961,6 +996,7 @@ def _bench_block_commit_sustained(n_tx: int = 1000, n_blocks: int = 50):
             "knobs": knobs,
             "host_stage": host_stage,
             "resident_state": resident,
+            "shard_balance": shard_balance,
             "group_commit": group_commit,
             # submit→commit critical-path decomposition (ms/block):
             # under async the state_apply row is the queue submit cost
@@ -1298,7 +1334,7 @@ def _bench_block_commit_sidecar(n_tx: int = 200, n_blocks: int = 12):
     knobs = _bench_knobs()
 
     host = _SidecarHost(
-        mesh_devices=knobs["mesh_devices"],
+        mesh_devices=knobs["shards"] or knobs["mesh_devices"],
         verify_chunk=knobs["verify_chunk"],
         recode_device=bool(knobs["recode_device"]),
         queue_blocks=8, coalesce=4,

@@ -25,6 +25,8 @@ them); slugs are the human-facing names:
                                  with no common lock
     FT018 lost-update            unlocked read-modify-write of an attr
                                  the class guards elsewhere
+    FT019 unruled-sharding       raw jax.sharding constructors outside
+                                 the partition-rule layer
 """
 
 from fabric_tpu.analysis.rules import (  # noqa: F401
@@ -46,4 +48,5 @@ from fabric_tpu.analysis.rules import (  # noqa: F401
     unattributed_sync,
     unfinished_span,
     union_env,
+    unruled_sharding,
 )

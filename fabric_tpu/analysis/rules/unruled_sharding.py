@@ -1,0 +1,110 @@
+"""FT019 unruled-sharding: raw sharding construction outside the
+partition-rule layer.
+
+The declarative partition-rule registry
+(``fabric_tpu/parallel/mesh.py``) is the ONE place that decides how an
+operand family splits over the device mesh: every ``NamedSharding`` /
+``PartitionSpec`` a dispatch site needs comes from
+``sharding_for(mesh, family, ndim)`` (or the ``shard``/``shard_batch``
+wrappers), so the rules table stays the single source of truth — a
+mesh resize, a replica axis, or a key-range re-partition is one
+registry edit, not a hunt through every launch site.  A module that
+builds ``jax.sharding.NamedSharding(...)`` by hand re-introduces the
+ad-hoc layout the registry replaced: its operands silently diverge
+from the table (wrong axis name, wrong replication) the first time the
+mesh shape changes, and nothing fails until verdicts fork on a
+multi-chip host.
+
+Mechanics (strictly under-approximating, per the FT003..FT018
+contract — a finding is always real), on the shared provenance
+engine (:mod:`fabric_tpu.analysis.provenance`):
+
+1. **Scope**: only modules under ``fabric_tpu/`` and NOT under
+   ``fabric_tpu/parallel/`` are policed — the partition-rule layer is
+   exactly where raw constructors belong, and out-of-package drivers
+   (bench, scripts) are not part of the dispatch surface.
+2. **The constructors**: any Call whose canonical dotted name
+   (``ImportMap.resolve_call`` — import-aware, so a same-named local
+   helper never matches) is ``jax.sharding.NamedSharding``,
+   ``jax.sharding.PositionalSharding``,
+   ``jax.sharding.PartitionSpec`` (including the conventional ``P``
+   alias — alias resolution is the import map's job), or
+   ``jax.experimental.shard_map.shard_map``.
+3. No data-flow guessing: a sharding object that arrives as an
+   argument, or a ``device_put`` whose sharding came from the
+   registry, never flags — only the raw constructor call does.
+
+Test code is exempt engine-wide — differentials pin layouts by hand
+on purpose.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import (
+    Finding,
+    ModuleCtx,
+    Rule,
+    register,
+)
+from fabric_tpu.analysis.provenance import module_index, walk_scope
+
+#: canonical dotted names of the raw sharding constructors
+_RAW_CANON = {
+    "jax.sharding.NamedSharding",
+    "jax.sharding.PositionalSharding",
+    "jax.sharding.PartitionSpec",
+    "jax.experimental.shard_map.shard_map",
+}
+_RULED_PREFIX = "fabric_tpu/parallel/"
+_SCOPE_PREFIX = "fabric_tpu/"
+
+
+@register
+class UnruledShardingRule(Rule):
+    id = "FT019"
+    name = "unruled-sharding"
+    severity = "error"
+    description = (
+        "flags raw jax.sharding constructor calls (NamedSharding / "
+        "PositionalSharding / PartitionSpec / shard_map) in "
+        "fabric_tpu modules outside the partition-rule layer "
+        "(fabric_tpu/parallel/) — hand-built layouts silently diverge "
+        "from the declarative rules table on mesh resize; route the "
+        "operand through sharding_for(mesh, family, ndim)"
+    )
+
+    def check_module(self, ctx: ModuleCtx) -> list[Finding]:
+        rel = ctx.relpath.replace("\\", "/")
+        if not rel.startswith(_SCOPE_PREFIX):
+            return []
+        if rel.startswith(_RULED_PREFIX):
+            return []
+        idx = module_index(ctx)
+        imports = idx.imports
+        if not imports.any_binding(lambda c: c.startswith("jax")):
+            return []  # the module never imports jax at all
+        out: list[Finding] = []
+        # tree body + every function (methods included) + class bodies
+        # — walk_scope never re-enters nested scopes, so each node is
+        # visited exactly once
+        for scope in [ctx.tree] + idx.functions + idx.classes:
+            for node in walk_scope(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                canon = imports.resolve_call(node)
+                if canon not in _RAW_CANON:
+                    continue
+                short = canon.rsplit(".", 1)[-1]
+                out.append(self.finding(
+                    ctx, node.lineno, node.col_offset,
+                    f"raw {short} construction ({canon}) outside the "
+                    "partition-rule layer — this layout is invisible "
+                    "to the fabric_tpu/parallel rules table and "
+                    "diverges from it on mesh resize; use "
+                    "sharding_for(mesh, family, ndim) / "
+                    "shard(mesh, family, arr) so the operand family's "
+                    "PartitionSpec stays declared in ONE place",
+                ))
+        return out

@@ -124,6 +124,7 @@ def _build_peer(cfg):
     from fabric_tpu.crypto import cryptogen as cg
     from fabric_tpu.crypto.msp import MSPManager
     from fabric_tpu.nodeconfig import PeerConfig
+    from fabric_tpu.parallel.topology import MeshTopology
     from fabric_tpu.peer.ccaas import CCaaSProxy
     from fabric_tpu.peer.chaincode import ChaincodeRuntime
     from fabric_tpu.peer.node import PeerNode
@@ -145,6 +146,7 @@ def _build_peer(cfg):
         pipeline_depth=cfg.pipeline_depth,
         verify_chunk=cfg.verify_chunk,
         mesh_devices=cfg.mesh_devices,
+        mesh_topology=MeshTopology.from_config(cfg),
         coalesce_blocks=cfg.coalesce_blocks,
         host_stage_workers=cfg.host_stage_workers,
         recode_device=cfg.recode_device,
@@ -298,8 +300,18 @@ async def _run_sidecar(args):
                 ca = f.read()
         ssl_ctx = make_server_tls(cert, key, ca)
     host, port = parse_endpoint(args.listen)
+    from fabric_tpu.parallel.topology import MeshTopology
+
+    topo = MeshTopology(
+        devices=args.mesh_devices, shape=args.mesh_shape,
+        distributed=args.mesh_distributed,
+        coordinator=args.mesh_coordinator,
+        process_id=args.mesh_process_id,
+        num_processes=args.mesh_num_processes,
+    )
     srv = SidecarServer(
         host, port, mesh_devices=args.mesh_devices,
+        mesh_topology=topo if topo.configured else None,
         verify_chunk=args.verify_chunk,
         recode_device=args.recode_device,
         queue_blocks=args.queue_blocks, coalesce=args.coalesce,
@@ -612,6 +624,18 @@ def main(argv=None):
                    help="host:port to serve the validate stream on")
     c.add_argument("--mesh-devices", type=int, default=0,
                    help="device-mesh sharding (-1 = all local devices)")
+    c.add_argument("--mesh-shape", default="",
+                   help="device grid, 'N' or 'NxM' (data x replica); "
+                        "overrides --mesh-devices")
+    c.add_argument("--mesh-distributed", action="store_true",
+                   help="span the mesh across jax.distributed "
+                        "processes (requires --mesh-coordinator)")
+    c.add_argument("--mesh-coordinator", default="",
+                   help="host:port rendezvous for the distributed mesh")
+    c.add_argument("--mesh-process-id", type=int, default=0,
+                   help="this process's rank in the distributed mesh")
+    c.add_argument("--mesh-num-processes", type=int, default=1,
+                   help="total process count in the distributed mesh")
     c.add_argument("--verify-chunk", type=int, default=0)
     c.add_argument("--recode-device", action="store_true")
     c.add_argument("--queue-blocks", type=int, default=8,

@@ -137,6 +137,24 @@ class PeerConfig:
     # default: sharding engages whenever n_devices > 1), 0 = off.
     # A 1-device resolution is a no-op, so CPU-only hosts pay nothing.
     mesh_devices: int = 0
+    # declarative mesh topology (parallel/topology.py): "" = off (the
+    # bare mesh_devices count above rules), "8" = 1-D data mesh over 8
+    # devices, "2x4" = data x replica grid.  When the shape doesn't fit
+    # the visible device count the node degrades to the local auto mesh
+    # with a warning rather than refusing to start.
+    mesh_shape: str = ""
+    # span the mesh across jax.distributed processes (pod slices):
+    # every participating process runs the same config with its own
+    # mesh_process_id; requires mesh_coordinator on all of them.  A
+    # failed coordinator handshake degrades to the local mesh.
+    mesh_distributed: bool = False
+    # coordinator "host:port" for jax.distributed.initialize (process 0
+    # listens there); required when mesh_distributed is on
+    mesh_coordinator: str = ""
+    # this process's rank in the distributed mesh, in [0, n_processes)
+    mesh_process_id: int = 0
+    # total process count in the distributed mesh
+    mesh_num_processes: int = 1
     # multi-block launch coalescing (CommitPipeline.submit_many): when
     # the deliver backlog holds ≥ 2 blocks, concatenate up to N blocks'
     # signature batches into one padded verify dispatch.  0/1 = off.
@@ -599,6 +617,31 @@ def _load(cls, source, environ=None):
             f"key 'state_resident_range_bits': must be in [1, 24] "
             f"(keys hash into 2^bits LRU ranges), "
             f"got {cfg.state_resident_range_bits}"
+        )
+    if isinstance(cfg, PeerConfig) and cfg.mesh_shape:
+        from fabric_tpu.parallel.topology import parse_mesh_shape
+
+        try:
+            parse_mesh_shape(cfg.mesh_shape)
+        except ValueError as e:
+            raise ConfigError(f"key 'mesh_shape': {e}") from None
+    if isinstance(cfg, PeerConfig) and cfg.mesh_distributed \
+            and not cfg.mesh_coordinator:
+        raise ConfigError(
+            "key 'mesh_distributed': requires 'mesh_coordinator' "
+            "(host:port of the jax.distributed rendezvous)"
+        )
+    if isinstance(cfg, PeerConfig) and cfg.mesh_num_processes < 1:
+        raise ConfigError(
+            f"key 'mesh_num_processes': must be >= 1 process, "
+            f"got {cfg.mesh_num_processes}"
+        )
+    if isinstance(cfg, PeerConfig) and not (
+            0 <= cfg.mesh_process_id < cfg.mesh_num_processes):
+        raise ConfigError(
+            f"key 'mesh_process_id': must be in [0, "
+            f"mesh_num_processes={cfg.mesh_num_processes}), "
+            f"got {cfg.mesh_process_id}"
         )
     if isinstance(cfg, PeerConfig) and cfg.autopilot_tick_s <= 0:
         raise ConfigError(

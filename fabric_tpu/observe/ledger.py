@@ -101,17 +101,22 @@ class LaunchRecord:
     Every method is idempotent-safe: a double fetch completes once."""
 
     __slots__ = ("ledger", "kernel", "lane", "key", "lanes", "compiled",
-                 "t0", "t1", "t_sync0", "h2d_bytes", "h2d_s",
+                 "sharded", "t0", "t1", "t_sync0", "h2d_bytes", "h2d_s",
                  "d2h_bytes", "_parent", "_ref", "_done",
                  "_dispatch_marked", "_pins")
 
     def __init__(self, ledger: "LaunchLedger", kernel: str, lane: str,
-                 compiled: bool, lanes: int, parent, ref):
+                 compiled: bool, lanes: int, parent, ref,
+                 sharded: bool | None = None):
         self.ledger = ledger
         self.kernel = kernel
         self.lane = lane
         self.lanes = int(lanes)
         self.compiled = bool(compiled)
+        #: None = no mesh configured (untagged row); True/False = a
+        #: mesh WAS configured and the dispatch did / did not shard —
+        #: False is the silent-unparallel signal /launches surfaces
+        self.sharded = sharded
         self.t0 = ledger.clock()
         self.t1: float | None = None
         self.t_sync0: float | None = None
@@ -264,12 +269,17 @@ class LaunchLedger:
 
     def launch(self, kernel: str, *, key=None, lane: str = "dev",
                lanes: int = 0, compiled: bool | None = None,
-               h2d_bytes: int = 0) -> LaunchRecord:
+               h2d_bytes: int = 0,
+               sharded: bool | None = None) -> LaunchRecord:
         """Open a record for one device dispatch.  ``compiled`` is the
         caller's exact program-cache verdict where it owns the cache;
-        None infers miss-on-first-sight of ``(kernel, key)``.  The
-        tracer's thread-current span is captured as the parent the
-        device child spans land under (None off traced paths)."""
+        None infers miss-on-first-sight of ``(kernel, key)``.
+        ``sharded`` tags the row when a device mesh is configured:
+        False marks a dispatch whose operands fell back to unsharded
+        (ragged axis 0 — see parallel.mesh ``shard``), the
+        silent-unparallel case /launches must surface.  The tracer's
+        thread-current span is captured as the parent the device
+        child spans land under (None off traced paths)."""
         if compiled is None:
             k = (kernel, key)
             with self._lock:
@@ -284,7 +294,7 @@ class LaunchLedger:
                 ns = a.get("ns", "")
                 ref = f"{ns}:{blk}" if ns else str(blk)
         rec = LaunchRecord(self, kernel, lane, compiled, lanes,
-                           parent, ref)
+                           parent, ref, sharded=sharded)
         if h2d_bytes:
             rec.note_h2d(h2d_bytes)
         return rec
@@ -326,6 +336,8 @@ class LaunchLedger:
                 "wall_ms": (None if f is None else
                             round((rec.h2d_s + f - t0) * 1000.0, 4)),
             }
+            if rec.sharded is not None:
+                row["sharded"] = rec.sharded
             if rec._ref is not None:
                 row["block"] = rec._ref
             self._rows.append(row)
@@ -428,11 +440,13 @@ class LaunchLedger:
         kernels: dict[str, dict] = {}
         for r in rows:
             k = kernels.setdefault(r["kernel"], {
-                "launches": 0, "cache_misses": 0,
+                "launches": 0, "cache_misses": 0, "unsharded": 0,
                 "compile_ms": [], "queue_ms": [], "execute_ms": [],
                 "h2d_bytes": 0, "d2h_bytes": 0,
             })
             k["launches"] += 1
+            if r.get("sharded") is False:
+                k["unsharded"] += 1
             if r["cache"] == "miss":
                 k["cache_misses"] += 1
                 k["compile_ms"].append(r["compile_ms"])
@@ -449,6 +463,11 @@ class LaunchLedger:
                 "launches": n,
                 "cache_misses": k["cache_misses"],
                 "cache_hit_rate": round((n - k["cache_misses"]) / n, 4),
+                # mesh-configured dispatches that silently ran
+                # unparallel (parallel.mesh shard fallback) — nonzero
+                # here explains mystery device_wait before anyone
+                # reads per-row tags
+                "unsharded_launches": k["unsharded"],
                 "compile_ms": self._pcts(k["compile_ms"]),
                 "queue_ms": self._pcts(k["queue_ms"]),
                 "execute_ms": self._pcts(k["execute_ms"]),

@@ -136,6 +136,30 @@ def mvcc_validate_hostver(
     return valid, conflict, phantom
 
 
+def mvcc_in_shardings(mesh, arrays, *, trailing: int = 1):
+    """Partition-rule shardings for a ``jax.jit(mvcc_validate, ...)``
+    dispatch: one ``"mvcc_frame"`` NamedSharding per operand (axis 0 —
+    the tx lane — split over the mesh data axis, trailing dims
+    replicated), plus ``trailing`` extra 1-D frames for ``pre_ok``-style
+    tail operands.
+
+    This is the declarative replacement for hand-built
+    ``batch_sharding`` tuples: every MVCC launch frame routes through
+    the same PartitionRules family, so the rules table (and the FT019
+    unruled-sharding check) see one canonical construction site.
+    Returns ``None`` when ``mesh`` is None (unsharded dispatch).
+    """
+    if mesh is None:
+        return None
+    from fabric_tpu.parallel.mesh import sharding_for
+
+    specs = tuple(sharding_for(mesh, "mvcc_frame", a.ndim) for a in arrays)
+    specs += tuple(
+        sharding_for(mesh, "mvcc_frame", 1) for _ in range(trailing)
+    )
+    return specs
+
+
 # ---------------------------------------------------------------------------
 # Host-side block preparation
 

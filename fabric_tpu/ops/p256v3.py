@@ -1090,13 +1090,15 @@ def _coalesce_metric():
 
 
 def _shard(mesh, arr):
-    """Axis-0 shard one dispatch input over the data mesh (no-op when
-    mesh is None or the shape is ragged vs the mesh)."""
+    """Axis-0 shard one verify dispatch input over the data mesh via the
+    ``"verify_lanes"`` partition rule (no-op when mesh is None; ragged
+    shapes fall back to single-device and are counted in
+    ``mesh_shard_fallback_total``)."""
     if mesh is None:
         return arr
-    from fabric_tpu.parallel.mesh import shard_batch
+    from fabric_tpu.parallel.mesh import shard
 
-    return shard_batch(mesh, arr)
+    return shard(mesh, "verify_lanes", arr)
 
 
 def _chunk_bounds(n_real: int, chunk: int) -> list[tuple[int, int, int]]:

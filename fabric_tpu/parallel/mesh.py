@@ -1,26 +1,219 @@
-"""Mesh sharding of the block-validation data plane.
+"""Declarative partition rules over a process-spanning device mesh.
 
 The reference parallelizes block validation with a goroutine-per-tx
 worker pool on one host (core/committer/txvalidator/v20/validator.go:
-193-208, pool size peer.validatorPoolSize).  The TPU-native analog
-shards the *batch* dimension of the data-plane kernels (signature
-verify, hashing, MVCC) across a device mesh: every chip verifies a
-slice of the block's signatures, and the validity bits are gathered by
-XLA collectives over ICI — the "N-of-M policy parallelism" row of the
-reference's parallelism inventory (SURVEY.md §2.10).
+193-208, pool size peer.validatorPoolSize) and scales further only by
+replicating whole peers.  The TPU-native analog shards the data plane
+of the validation kernels across a device mesh — and this module is
+the ONE place that knows how: a **partition-rule registry** maps every
+stage-2 operand family (verify launch frames, packed read planes,
+policy tables, the MVCC version frame, the device-resident state
+table) to a ``PartitionSpec``, and every dispatch site asks the
+registry instead of hand-rolling ``NamedSharding`` (the FT019
+``unruled-sharding`` rule polices the boundary).
 
-One axis ("data") suffices for the commit path: block batches are
-embarrassingly parallel and the reduction (per-tx policy evaluation)
-is a tiny boolean tree evaluated after an all-gather.  Multi-host
-deployments replicate the whole pipeline per peer (the reference's
-distributed-replication model), so the mesh spans one peer's chips.
+Mesh anatomy: axis 0 of the mesh is ``"data"`` — the batch/tx/lane
+dimension every data-plane family shards — and an optional second
+axis ``"replica"`` replicates the whole pipeline across device groups
+(a 2x4 grid runs 2-way data sharding replicated on 4 groups).  The
+mesh can span ``jax.distributed`` processes: ``resolve_fabric`` with
+a distributed topology initializes the coordinator once, after which
+``jax.devices()`` enumerates every process's chips and the SAME rule
+table shards over all of them — the classic per-host mesh
+(``resolve_mesh``) is the 1-process special case.
+
+Key-range state partitioning: the device-resident MVCC version table
+(``fabric_tpu/state/residency.py``) is NOT sharded by raw axis 0 of
+whatever happens to be in it — the residency manager lays slots out
+range-major (key range ids from ``blake2b`` top bits, contiguous
+range blocks per shard), so the ``state_table`` rule's axis-0
+partition physically places each key range on its owning device and
+admission/eviction/commit scatters route to the owner's slot block.
+
+Degrade story: every shard helper falls back to the unsharded array
+when the mesh is off or axis 0 is ragged vs the data axis — always
+correct, just not parallel.  The fallback is COUNTED
+(``mesh_shard_fallback_total{reason=}``) and the launch ledger tags
+the dispatch row ``sharded=false``, so a block silently running
+unparallel shows up on /launches instead of reading as mystery
+``device_wait``.
 """
 
 from __future__ import annotations
 
+import logging
+import threading
+from dataclasses import dataclass
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fabric_tpu.parallel.topology import MeshTopology, parse_mesh_shape
+
+_log = logging.getLogger("fabric_tpu.parallel.mesh")
+
+#: mesh axis every data-plane family shards its axis 0 over
+DATA_AXIS = "data"
+#: optional second mesh axis: whole-pipeline replication groups
+REPLICA_AXIS = "replica"
+
+
+# ---------------------------------------------------------------------------
+# the partition-rule registry
+
+
+@dataclass(frozen=True)
+class PartitionRule:
+    """One operand family's partition law: which mesh axes its leading
+    array dimensions map to (``()`` = fully replicated).  ``spec(ndim)``
+    pads the tail with None — trailing dims always replicate (they are
+    per-lane payload, never batch)."""
+
+    family: str
+    axes: tuple
+    description: str
+
+    def spec(self, ndim: int) -> P:
+        names = list(self.axes[:ndim])
+        names += [None] * (ndim - len(names))
+        return P(*names)
+
+    @property
+    def replicated(self) -> bool:
+        return not self.axes
+
+
+_RULES: dict[str, PartitionRule] = {}
+
+
+def register_rule(family: str, axes: tuple, description: str) -> PartitionRule:
+    rule = PartitionRule(family, tuple(axes), description)
+    _RULES[family] = rule
+    return rule
+
+
+# The rule table — every stage-2 operand family the fused dispatch
+# uploads, plus the stage-1 verify frames and the sign lane.  Axis 0
+# over "data" throughout is not an accident: every family's leading
+# dim is the per-tx / per-endorsement / per-lane batch dim, and the
+# reductions that cross it (policy scatter-min, the MVCC fixpoint
+# matvec) are integer/boolean — exact in any collective order, which
+# is what makes sharded ≡ unsharded bit-equality provable.
+register_rule(
+    "verify_lanes", (DATA_AXIS,),
+    "packed ECDSA verify wire frames (ops/p256v3) — one row per "
+    "signature lane",
+)
+register_rule(
+    "sign_rows", (DATA_AXIS,),
+    "sign-kernel limb rows (ops/p256sign) — one row per digest",
+)
+register_rule(
+    "launch_frame", (DATA_AXIS,),
+    "per-tx launch vector [T, 3] (creator | structural | ver_ok)",
+)
+register_rule(
+    "policy_table", (DATA_AXIS,),
+    "packed endorsement/policy planes [Eb, S*P + S + 1] (match | "
+    "endo_idx | tx_of)",
+)
+register_rule(
+    "static_pack", (DATA_AXIS,),
+    "packed MVCC static block [T, R + W + 2Q] (read/write keys, "
+    "range-query bounds)",
+)
+register_rule(
+    "mvcc_frame", (DATA_AXIS,),
+    "standalone MVCC version-frame operands (ops/mvcc prepared "
+    "planes; per-tx rows)",
+)
+register_rule(
+    "read_versions", (DATA_AXIS,),
+    "expected per-read committed versions [T, R, 3] for the resident "
+    "on-device compare",
+)
+register_rule(
+    "state_table", (DATA_AXIS,),
+    "device-resident MVCC version table [cap, 3] — KEY-RANGE "
+    "partitioned: the residency manager lays slots out range-major, "
+    "so this axis-0 split places each key range on its owning shard",
+)
+register_rule(
+    "unique_read_pack", (),
+    "per-unique-key slot/host-lane frame [Ub, 4] — tiny and gathered "
+    "from every shard, so it replicates",
+)
+
+
+def rule_for(family: str) -> PartitionRule:
+    """Registry probe — an unknown family is a programming error, not
+    a silent replication."""
+    try:
+        return _RULES[family]
+    except KeyError:
+        raise KeyError(
+            f"no partition rule for operand family {family!r} — "
+            f"register it in fabric_tpu/parallel/mesh.py "
+            f"(known: {sorted(_RULES)})"
+        ) from None
+
+
+def rules_table() -> list[dict]:
+    """The rule table as rows (the dryrun/ops printout)."""
+    return [
+        {
+            "family": r.family,
+            "spec": "replicated" if r.replicated
+            else " × ".join(r.axes) + " × …",
+            "description": r.description,
+        }
+        for r in _RULES.values()
+    ]
+
+
+def spec_for(family: str, ndim: int) -> P:
+    return rule_for(family).spec(ndim)
+
+
+def sharding_for(mesh: Mesh, family: str, ndim: int) -> NamedSharding:
+    """Family rule + mesh → the NamedSharding a jit ``in_shardings``
+    slot or ``device_put`` wants."""
+    return NamedSharding(mesh, spec_for(family, ndim))
+
+
+# ---------------------------------------------------------------------------
+# fallback accounting (the silent-unparallel counter)
+
+_fb_lock = threading.Lock()
+_fb_counts: dict[str, int] = {}
+_fb_ctr = None  # lazy metrics counter
+
+
+def _note_fallback(reason: str, family: str) -> None:
+    global _fb_ctr
+    with _fb_lock:
+        _fb_counts[reason] = _fb_counts.get(reason, 0) + 1
+        if _fb_ctr is None:
+            from fabric_tpu.ops_metrics import global_registry
+
+            _fb_ctr = global_registry().counter(
+                "mesh_shard_fallback_total",
+                "sharded device_puts that silently degraded to a "
+                "single-device array (the dispatch stays correct but "
+                "runs unparallel), by reason",
+            )
+    _fb_ctr.add(1, reason=reason, family=family)
+
+
+def fallback_stats() -> dict:
+    """Cumulative fallback counts by reason (bench extras / tests)."""
+    with _fb_lock:
+        return dict(_fb_counts)
+
+
+# ---------------------------------------------------------------------------
+# mesh construction
 
 
 def data_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -29,23 +222,22 @@ def data_mesh(n_devices: int | None = None, devices=None) -> Mesh:
         devices = jax.devices()
         if n_devices is not None:
             devices = devices[:n_devices]
-    return Mesh(np.asarray(devices), axis_names=("data",))
+    return Mesh(np.asarray(devices), axis_names=(DATA_AXIS,))
 
 
-def batch_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
-    """Shard axis 0 (the batch/tx dim) over "data"; replicate the rest."""
-    return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))
-
-
-def shard_args(mesh: Mesh, *arrays):
-    """Device-put arrays with axis-0 sharded over the mesh."""
-    return tuple(
-        jax.device_put(a, batch_sharding(mesh, a.ndim)) for a in arrays
-    )
+def data_axis_size(mesh: Mesh | None) -> int:
+    """Shards along the batch axis (1 = unsharded/no mesh)."""
+    if mesh is None:
+        return 1
+    try:
+        return int(dict(mesh.shape).get(DATA_AXIS, mesh.size))
+    except Exception:
+        return int(getattr(mesh, "size", 1) or 1)
 
 
 def resolve_mesh(mesh_devices: int) -> Mesh | None:
-    """Production knob → mesh (the nodeconfig ``mesh_devices`` knob).
+    """Production knob → mesh (the nodeconfig ``mesh_devices`` knob) —
+    the 1-process special case of :func:`resolve_fabric`.
 
     0  = sharding off (single-device dispatch — the safe default on
          CPU-only hosts, where a virtual mesh only adds partition
@@ -60,33 +252,172 @@ def resolve_mesh(mesh_devices: int) -> Mesh | None:
     n = len(devices) if mesh_devices < 0 else min(mesh_devices, len(devices))
     if n < 2:
         return None
-    return Mesh(np.asarray(devices[:n]), axis_names=("data",))
+    return Mesh(np.asarray(devices[:n]), axis_names=(DATA_AXIS,))
 
 
-def shard_state_table(mesh: Mesh | None, table):
-    """Axis-0 shard the device-resident MVCC version table
-    (fabric_tpu/state/residency.py) — the resident cache is a stage-2
-    operand like every other, so it lives under the SAME data-mesh
-    sharding the fused program's launch/static lanes use.  The table's
-    slot count is a power of two (ResidencyManager rounds its capacity
-    down), so 2/4/8-chip meshes always divide it exactly; functional
-    scatter updates (``table.at[idx].set``) preserve the layout, and
-    an unmeshed host gets the plain single-device array."""
-    return shard_batch(mesh, table)
+_distributed_lock = threading.Lock()
+_distributed_up = False
+
+
+def _init_distributed(topo: MeshTopology) -> bool:
+    """One-shot ``jax.distributed.initialize`` (idempotent per
+    process).  Failure degrades to the local mesh with a warning —
+    a fabric that cannot form must not take the validator down."""
+    global _distributed_up
+    with _distributed_lock:
+        if _distributed_up:
+            return True
+        try:
+            jax.distributed.initialize(
+                coordinator_address=topo.coordinator,
+                num_processes=int(topo.num_processes),
+                process_id=int(topo.process_id),
+            )
+            _distributed_up = True
+            _log.info(
+                "joined distributed fabric: coordinator=%s process "
+                "%d/%d", topo.coordinator, topo.process_id,
+                topo.num_processes,
+            )
+            return True
+        except Exception as e:
+            _log.warning(
+                "jax.distributed.initialize failed (%s) — degrading "
+                "to the local per-process mesh", e,
+            )
+            return False
+
+
+def resolve_fabric(topo: MeshTopology | int,
+                   mesh_shape: str = "",
+                   distributed: bool = False,
+                   coordinator: str = "",
+                   process_id: int = 0,
+                   num_processes: int = 1) -> Mesh | None:
+    """Mesh topology → the fabric mesh every partition rule applies
+    over, or None (sharding off).
+
+    Accepts a :class:`MeshTopology` or the bare ``mesh_devices`` int
+    plus keyword knobs.  Resolution order:
+
+    1. ``distributed`` arms ``jax.distributed.initialize`` (once);
+       after that ``jax.devices()`` spans every process.
+    2. ``mesh_shape`` ("8", "2x4") builds the data×replica grid over
+       the first ``prod(shape)`` devices; a grid that does not fit the
+       available devices degrades to the local auto mesh (warned, and
+       visible as a smaller ``data`` axis on /launches rows).
+    3. Otherwise the classic ``mesh_devices`` count — the 1-process
+       special case (:func:`resolve_mesh`).
+
+    A resolution whose data axis is < 2 returns None: a 1-wide data
+    axis is partition overhead with no parallelism.
+    """
+    if isinstance(topo, MeshTopology):
+        t = topo
+    else:
+        t = MeshTopology(devices=int(topo), shape=mesh_shape,
+                         distributed=distributed,
+                         coordinator=coordinator,
+                         process_id=process_id,
+                         num_processes=num_processes)
+    if not t.configured:
+        return None
+    if t.distributed:
+        _init_distributed(t)
+    if t.shape:
+        dims = parse_mesh_shape(t.shape)
+        want = 1
+        for d in dims:
+            want *= d
+        devices = jax.devices()
+        if want > len(devices):
+            _log.warning(
+                "mesh_shape %s wants %d devices, %d available — "
+                "degrading to the local auto mesh",
+                t.shape, want, len(devices),
+            )
+            return resolve_mesh(-1 if t.devices == 0 else t.devices)
+        if dims[0] < 2:
+            return None
+        grid = np.asarray(devices[:want]).reshape(dims)
+        names = (DATA_AXIS,) if len(dims) == 1 else (DATA_AXIS,
+                                                     REPLICA_AXIS)
+        return Mesh(grid, axis_names=names)
+    return resolve_mesh(t.devices)
+
+
+# ---------------------------------------------------------------------------
+# applying rules to arrays
+
+
+def batch_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Shard axis 0 (the batch/tx dim) over "data"; replicate the rest
+    (including any replica axis)."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def shard_args(mesh: Mesh, *arrays):
+    """Device-put arrays with axis-0 sharded over the mesh."""
+    return tuple(
+        jax.device_put(a, batch_sharding(mesh, a.ndim)) for a in arrays
+    )
+
+
+def will_shard(mesh: Mesh | None, arr) -> bool:
+    """Whether :func:`shard` will actually partition ``arr`` (False =
+    the unsharded fallback; the caller's ledger row should say so)."""
+    if mesh is None:
+        return False
+    n = arr.shape[0] if getattr(arr, "ndim", 0) else 0
+    d = data_axis_size(mesh)
+    return n > 0 and d > 1 and n % d == 0
+
+
+def shard(mesh: Mesh | None, family: str, arr):
+    """Device-put ONE array under its family's partition rule.
+
+    Replicated families pass through untouched (jit commits them to
+    every device; an explicit broadcast put would only add a copy).
+    Data-sharded families fall back to the unsharded array when the
+    mesh is off, axis 0 is empty, or axis 0 does not divide the data
+    axis (ragged microbatch tails, sub-minimum buckets) — the
+    dispatch then runs single-device for that array, which is always
+    correct, just not parallel.  Fallbacks on a LIVE mesh are counted
+    (``mesh_shard_fallback_total{reason=}``) — all production batch
+    shapes are bucketed to powers of two ≥ 16 or multiples of 512, so
+    2/4/8-way data axes divide them exactly and a nonzero counter
+    means a shape regression, not noise."""
+    rule = rule_for(family)
+    if mesh is None or rule.replicated:
+        return arr
+    n = arr.shape[0] if getattr(arr, "ndim", 0) else 0
+    d = data_axis_size(mesh)
+    if d < 2:
+        return arr
+    if n == 0:
+        _note_fallback("empty_axis0", family)
+        return arr
+    if n % d != 0:
+        _note_fallback("ragged_axis0", family)
+        return arr
+    return jax.device_put(arr, NamedSharding(mesh, rule.spec(arr.ndim)))
 
 
 def shard_batch(mesh: Mesh | None, arr):
-    """Device-put ONE array with axis 0 sharded over the mesh.
+    """Back-compat alias: axis-0 shard one array under the generic
+    verify-lane rule (the pre-registry call sites all meant "shard the
+    batch dim"; new call sites should name their family via
+    :func:`shard`)."""
+    return shard(mesh, "verify_lanes", arr)
 
-    Falls back to the unsharded array when the mesh is None or axis 0
-    does not divide evenly (ragged microbatch tails, sub-minimum
-    buckets) — the caller's dispatch then runs single-device for that
-    array, which is always correct, just not parallel.  All production
-    batch shapes are bucketed to powers of two ≥ 16 or multiples of
-    512, so 2/4/8-chip meshes divide them exactly."""
-    if mesh is None:
-        return arr
-    n = arr.shape[0] if arr.ndim else 0
-    if n == 0 or n % mesh.size != 0:
-        return arr
-    return jax.device_put(arr, batch_sharding(mesh, arr.ndim))
+
+def shard_state_table(mesh: Mesh | None, table):
+    """Shard the device-resident MVCC version table under the
+    ``state_table`` rule.  The residency manager lays slots out
+    range-major in per-shard blocks (capacity is a power of two, so
+    2/4/8-way data axes divide it exactly), which makes this axis-0
+    partition a KEY-RANGE partition: each range's slots land on its
+    owning device, and functional scatter updates
+    (``table.at[idx].set``) preserve the layout.  An unmeshed host
+    gets the plain single-device array."""
+    return shard(mesh, "state_table", table)

@@ -1,0 +1,78 @@
+"""Mesh topology description: the nodeconfig mesh knobs as one value.
+
+A :class:`MeshTopology` carries everything the partition-rule layer
+(:mod:`fabric_tpu.parallel.mesh`) needs to build the device mesh a
+validator or sidecar dispatches over — the classic per-host
+``mesh_devices`` count, the pod-scale ``mesh_shape`` grid, and the
+``jax.distributed`` process-spanning knobs (coordinator address,
+process id/count).  It deliberately imports NO jax: nodeconfig, the
+CLI and the peer node pass topologies around on jax-free import
+paths, and only :meth:`resolve` (called once, behind the knob check)
+touches the device stack.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+def parse_mesh_shape(shape: str) -> tuple[int, ...]:
+    """``"8"`` → ``(8,)``; ``"2x4"`` → ``(2, 4)``.  Axis 0 is the
+    batch ("data") axis; a second axis replicates ("replica").
+    Raises ``ValueError`` on anything else — nodeconfig surfaces it
+    as a ConfigError naming the key."""
+    try:
+        dims = tuple(int(d) for d in shape.lower().split("x"))
+    except ValueError:
+        dims = ()
+    if not (1 <= len(dims) <= 2) or any(d < 1 for d in dims):
+        raise ValueError(
+            f"mesh_shape {shape!r}: want 'N' or 'NxM' with N, M >= 1"
+        )
+    return dims
+
+
+@dataclass(frozen=True)
+class MeshTopology:
+    """One validator/sidecar's mesh configuration (see module doc).
+
+    ``devices`` is the classic ``mesh_devices`` knob (0 = off, -1 =
+    all local, n = first n local) and stays the 1-process special
+    case: a topology with only ``devices`` set resolves exactly like
+    ``resolve_mesh(devices)`` always has.  ``shape`` names a device
+    grid ("8", "2x4" — data×replica); ``distributed`` arms
+    ``jax.distributed.initialize`` against ``coordinator`` so the
+    grid can span processes, at which point jax.devices() enumerates
+    every process's chips and the SAME rule table shards over all of
+    them."""
+
+    devices: int = 0
+    shape: str = ""
+    distributed: bool = False
+    coordinator: str = ""
+    process_id: int = 0
+    num_processes: int = 1
+
+    @property
+    def configured(self) -> bool:
+        return bool(self.devices or self.shape or self.distributed)
+
+    @classmethod
+    def from_config(cls, cfg) -> "MeshTopology":
+        """PeerConfig (nodeconfig) → topology."""
+        return cls(
+            devices=int(getattr(cfg, "mesh_devices", 0)),
+            shape=str(getattr(cfg, "mesh_shape", "")),
+            distributed=bool(getattr(cfg, "mesh_distributed", False)),
+            coordinator=str(getattr(cfg, "mesh_coordinator", "")),
+            process_id=int(getattr(cfg, "mesh_process_id", 0)),
+            num_processes=int(getattr(cfg, "mesh_num_processes", 1)),
+        )
+
+    def resolve(self):
+        """→ jax Mesh | None.  The only jax-importing path here."""
+        if not self.configured:
+            return None
+        from fabric_tpu.parallel.mesh import resolve_fabric
+
+        return resolve_fabric(self)

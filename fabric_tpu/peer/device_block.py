@@ -281,8 +281,22 @@ class DeviceBlockPipeline:
         h2d = launch_vec.nbytes
         if resident is not None:
             h2d += resident[1].nbytes
+        from fabric_tpu.parallel import mesh as pmesh
+
+        # partition-rule verdict BEFORE the puts: a mesh-configured
+        # dispatch whose per-tx planes cannot shard (ragged axis 0)
+        # runs single-device — tag the ledger row so /launches shows
+        # it instead of mystery device_wait (untagged when no mesh)
+        sharded = None
+        if mesh is not None:
+            data_planes = [launch_vec, static_packed]
+            data_planes += [gp for _, gp, _, _ in groups]
+            if resident is not None:
+                data_planes.append(resident[2])
+            sharded = all(pmesh.will_shard(mesh, a) for a in data_planes)
         rec = _ledger.launch("stage2", compiled=compiled,
-                             lanes=t_bucket, h2d_bytes=h2d)
+                             lanes=t_bucket, h2d_bytes=h2d,
+                             sharded=sharded)
         # the fused path never calls the verify handle's fetch (the
         # signature vector stays on device as a stage-2 operand), so
         # its ledger record would never close: complete it
@@ -294,18 +308,23 @@ class DeviceBlockPipeline:
         if vrec is not None:
             vrec.complete()
         t0 = time.perf_counter()
-        from fabric_tpu.parallel.mesh import shard_batch
-
-        self._shards_hist.observe(mesh.size if mesh is not None else 1)
+        self._shards_hist.observe(pmesh.data_axis_size(mesh))
+        # every operand goes up under its family's partition rule
+        # (fabric_tpu/parallel/mesh.py) — the declarative table is the
+        # single sharding authority (FT019 polices the boundary)
         args = [handle.device_out,
-                shard_batch(mesh, jnp.asarray(launch_vec))]
-        args += [shard_batch(mesh, gp) for _, gp, _, _ in groups]
-        args += [shard_batch(mesh, static_packed)]
+                pmesh.shard(mesh, "launch_frame",
+                            jnp.asarray(launch_vec))]
+        args += [pmesh.shard(mesh, "policy_table", gp)
+                 for _, gp, _, _ in groups]
+        args += [pmesh.shard(mesh, "static_pack", static_packed)]
         if resident is not None:
-            # table keeps the manager's sharding; u_pack is per-key
-            # (not per-tx) so it rides unsharded — it is tiny
-            args += [table_dev, jnp.asarray(u_pack),
-                     shard_batch(mesh, read_pv_dev)]
+            # table keeps the manager's key-range sharding; u_pack is
+            # per-key (not per-tx) so it rides replicated — it is tiny
+            args += [table_dev,
+                     pmesh.shard(mesh, "unique_read_pack",
+                                 jnp.asarray(u_pack)),
+                     pmesh.shard(mesh, "read_versions", read_pv_dev)]
         from fabric_tpu.observe import device_annotation
 
         if rec is not None:

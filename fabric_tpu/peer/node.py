@@ -58,6 +58,7 @@ class PeerChannel:
                  config_processor=None, genesis_block=None,
                  snapshot_dir: str | None = None, pipeline_depth: int = 2,
                  verify_chunk: int = 0, mesh_devices: int = 0,
+                 mesh_topology=None,
                  coalesce_blocks: int = 0, host_stage_workers: int = 0,
                  recode_device: bool = False,
                  host_stage_mode: str = "thread",
@@ -185,6 +186,7 @@ class PeerChannel:
         validator_kw = dict(
             block_store=self.ledger.blocks, config_processor=config_processor,
             verify_chunk=verify_chunk, mesh_devices=mesh_devices,
+            mesh_topology=mesh_topology,
             host_stage_workers=host_stage_workers,
             recode_device=recode_device, host_stage_mode=host_stage_mode,
             device_fail_threshold=device_fail_threshold,
@@ -1188,7 +1190,8 @@ class PeerNode:
                  max_package_size: int = DEFAULT_MAX_PACKAGE_SIZE,
                  install_require_admin: bool = False,
                  pipeline_depth: int = 2, verify_chunk: int = 0,
-                 mesh_devices: int = 0, coalesce_blocks: int = 0,
+                 mesh_devices: int = 0, mesh_topology=None,
+                 coalesce_blocks: int = 0,
                  host_stage_workers: int = 0, recode_device: bool = False,
                  host_stage_mode: str = "thread",
                  trace_ring_blocks: int | None = None,
@@ -1235,6 +1238,11 @@ class PeerNode:
         self.apply_queue_blocks = int(apply_queue_blocks)
         self.verify_chunk = int(verify_chunk)
         self.mesh_devices = int(mesh_devices)
+        # declarative mesh topology (parallel.topology.MeshTopology,
+        # nodeconfig mesh_shape / mesh_distributed / mesh_coordinator):
+        # when configured it wins over the bare mesh_devices count;
+        # every joined channel's validator shares the resolved fabric
+        self.mesh_topology = mesh_topology
         self.coalesce_blocks = int(coalesce_blocks)
         self.host_stage_workers = int(host_stage_workers)
         self.recode_device = bool(recode_device)
@@ -1480,6 +1488,7 @@ class PeerNode:
             pipeline_depth=self.pipeline_depth,
             verify_chunk=self.verify_chunk,
             mesh_devices=self.mesh_devices,
+            mesh_topology=self.mesh_topology,
             coalesce_blocks=self.coalesce_blocks,
             host_stage_workers=self.host_stage_workers,
             recode_device=self.recode_device,
@@ -1547,6 +1556,7 @@ class PeerNode:
             self.sidecar_server = await SidecarServer(
                 sc_host, sc_port,
                 mesh_devices=self.mesh_devices,
+                mesh_topology=self.mesh_topology,
                 verify_chunk=self.verify_chunk,
                 recode_device=self.recode_device,
                 queue_blocks=self.sidecar_queue_blocks,

@@ -328,6 +328,7 @@ class BlockValidator:
         state_resident_mb: int = 64,
         state_resident_range_bits: int = 12,
         channel: str = "",
+        mesh_topology=None,
     ):
         self.msp = msp_manager
         self.policies = policy_provider
@@ -350,14 +351,21 @@ class BlockValidator:
         self._knob_lock = threading.Lock()
         self._pending_verify_chunk: int | None = None
         # device-mesh sharding of the production dispatch (nodeconfig
-        # ``mesh_devices``): batch lanes of the verify kernel AND the
-        # fused stage-2 program shard axis 0 over a parallel.mesh data
-        # mesh; 0 = off (single device), -1 = all local devices, n =
-        # first n.  Bit-equal to single-device
-        # (tests/test_multidevice.py); a 1-device resolution degrades
+        # ``mesh_devices`` + the pod-scale topology knobs): batch
+        # lanes of the verify kernel AND the fused stage-2 program
+        # shard under the declarative partition rules
+        # (fabric_tpu/parallel/mesh.py) over the resolved mesh —
+        # mesh_devices 0 = off, -1 = all local, n = first n (the
+        # 1-process special case); a ``mesh_topology``
+        # (parallel.topology.MeshTopology) layers ``mesh_shape`` grids
+        # and jax.distributed process-spanning fabrics on top.
+        # Bit-equal to single-device (tests/test_multidevice.py,
+        # tests/test_partition_rules.py); a 1-wide data axis degrades
         # to None so CPU-only hosts pay nothing.
         self.mesh_devices = int(mesh_devices)
-        if self.mesh_devices:
+        if mesh_topology is not None and mesh_topology.configured:
+            self.mesh = mesh_topology.resolve()
+        elif self.mesh_devices:
             from fabric_tpu.parallel.mesh import resolve_mesh
 
             self.mesh = resolve_mesh(self.mesh_devices)
@@ -1759,8 +1767,8 @@ class BlockValidator:
     # -- fused single-sync device path ------------------------------------
 
     def _put_group(self, gp):
-        """Upload one policy-group pack (prefetch thread), axis-0
-        sharded over the validator's mesh when one is configured.
+        """Upload one policy-group pack (prefetch thread) under the
+        ``policy_table`` partition rule when a mesh is configured.
         The bytes count on the launch ledger's ``stage2_prefetch``
         h2d lane — prefetch-thread uploads are device transfer time
         the launch-time accounting would otherwise miss."""
@@ -1771,9 +1779,9 @@ class BlockValidator:
         _ledger.note_h2d("stage2_prefetch", gp.nbytes)
         if self.mesh is None:
             return jnp.asarray(gp)
-        from fabric_tpu.parallel.mesh import shard_batch
+        from fabric_tpu.parallel.mesh import shard
 
-        return shard_batch(self.mesh, jnp.asarray(gp))
+        return shard(self.mesh, "policy_table", jnp.asarray(gp))
 
     def _device_preprocess(self, txs, rwp=None, fb=None):
         """State-INDEPENDENT device-path inputs: policy match matrices

@@ -92,9 +92,13 @@ class SidecarServer:
                  recode_device: bool = False, queue_blocks: int = 8,
                  coalesce: int = 4, quantum: int | None = None,
                  ssl_ctx=None, verify_fn=None, registry=None,
-                 tracer=None, autopilot=None):
+                 tracer=None, autopilot=None, mesh_topology=None):
         self.host, self.port = host, port
         self.mesh_devices = int(mesh_devices)
+        # declarative mesh topology (parallel.topology.MeshTopology):
+        # when configured it wins over the bare mesh_devices count and
+        # may span jax.distributed processes
+        self.mesh_topology = mesh_topology
         self.verify_chunk = int(verify_chunk)
         self.recode_device = bool(recode_device)
         self.coalesce = max(1, int(coalesce))
@@ -195,10 +199,14 @@ class SidecarServer:
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self) -> "SidecarServer":
-        if self.mesh_devices and self._verify_fn is None:
-            from fabric_tpu.parallel.mesh import resolve_mesh
+        if self._verify_fn is None:
+            topo = self.mesh_topology
+            if topo is not None and topo.configured:
+                self.mesh = topo.resolve()
+            elif self.mesh_devices:
+                from fabric_tpu.parallel.mesh import resolve_mesh
 
-            self.mesh = resolve_mesh(self.mesh_devices)
+                self.mesh = resolve_mesh(self.mesh_devices)
         self._rpc.register("validate", self._on_validate)
         await self._rpc.start()
         self.port = self._rpc.port

@@ -69,6 +69,7 @@ class SidecarValidator(BlockValidator):
         # overridden, so a tenant peer grabbing the accelerator its
         # co-located sidecar owns would be pure contention
         kw["mesh_devices"] = 0
+        kw["mesh_topology"] = None
         super().__init__(msp_manager, policy_provider, state_db, **kw)
         if link is None:
             host, port = parse_endpoint(sidecar_endpoint)

@@ -45,6 +45,20 @@ overlay are NOT admitted at launch time (their committed read races
 the in-flight apply) — the commit scatter lands them with the
 authoritative value instead.
 
+Key-range mesh sharding (fabric_tpu/parallel partition rules): on a
+device mesh, the table's pow2 slot space splits into one contiguous
+slot block per data-axis shard, and every key range is OWNED by the
+shard its range id's top bits select (``_shard_of``).  Admission,
+eviction and commit scatters allocate/free slots only inside the
+owning shard's block, so the ``state_table`` rule's axis-0 partition
+physically places each key range on its owner device — a multi-host
+fabric partitions the committed-version table without replication.
+Eviction pressure is per shard (a hot shard evicts its own LRU
+ranges, never a neighbor's), which is what the bench
+``extras.shard_balance`` skew numbers watch.  Mesh resize goes
+through :meth:`reshard` — disable-latch → cold rebuild, the safe
+fallback: verdicts never change, the working set re-faults in.
+
 Failure containment: any device error inside the manager latches it
 DISABLED (:meth:`disable`) — every lookup then misses and blocks ride
 the host oracle path; verdicts never change, only time does.  Nothing
@@ -79,6 +93,18 @@ _MIN_SCATTER = 16
 
 #: trailing lookups the hit-rate gauge aggregates over
 _HIT_WINDOW = 256
+
+
+def _mesh_shards(mesh) -> int:
+    """Data-axis shard count of a mesh WITHOUT importing jax (the
+    manager must stay constructible on jax-free hosts): the Mesh
+    object carries its own axis sizes."""
+    if mesh is None:
+        return 1
+    try:
+        return int(dict(mesh.shape).get("data", mesh.size))
+    except Exception:
+        return int(getattr(mesh, "size", 1) or 1)
 
 
 def _ver_i32(block: int, txnum: int) -> tuple[int, int]:
@@ -232,14 +258,23 @@ class ResidencyManager:
         self.channel = channel
         self._lock = threading.Lock()
         self._table = None  # lazy [capacity, 3] int32 on device
+        # key-range mesh sharding (module docstring): one contiguous
+        # slot block per data-axis shard, ranges owned by the shard
+        # their id's top bits select.  A mesh whose data axis does not
+        # divide the pow2 capacity (or exceeds it) degrades to one
+        # logical shard — the table still shards on device, only the
+        # range→shard routing is off.
+        self._n_shards = self._resolve_shards(mesh)
+        self._slots_per_shard = self.capacity // self._n_shards
         # (ns, key) → (slot, range_id): the range id is immutable per
         # key, so caching it here keeps every post-admission path — the
         # launch-critical lookup especially — a pure dict probe (no
         # per-hit blake2b under the lock)
         self._dir: dict[tuple, tuple] = {}
         self._ranges: OrderedDict[int, list] = OrderedDict()  # LRU
-        self._free: list[int] = list(range(self.capacity - 1, -1, -1))
+        self._free: list[list[int]] = self._fresh_free()
         self._enabled = True
+        self._reshards_total = 0
         self._scatter_fns: dict[int, object] = {}
         self._recent: deque[tuple[int, int]] = deque(maxlen=_HIT_WINDOW)
         self._hits_total = 0
@@ -291,6 +326,30 @@ class ResidencyManager:
         )
         self._enabled_gauge.set(1, channel=self.channel)
 
+    # -- key-range shard geometry ------------------------------------------
+
+    def _resolve_shards(self, mesh) -> int:
+        n = _mesh_shards(mesh)
+        if n < 2 or n > self.capacity or self.capacity % n:
+            return 1
+        return n
+
+    def _fresh_free(self) -> list:
+        """Per-shard free-slot pools, each descending so ``pop()``
+        hands out the lowest slot in the shard's block first."""
+        sps = self._slots_per_shard
+        return [
+            list(range((s + 1) * sps - 1, s * sps - 1, -1))
+            for s in range(self._n_shards)
+        ]
+
+    def _shard_of(self, rid: int) -> int:
+        """Owning shard of a key range: the top bits of the range id
+        (``floor(rid * n / 2^range_bits)``) — contiguous range blocks
+        map to contiguous shards, matching the table's contiguous
+        slot blocks under the axis-0 ``state_table`` partition."""
+        return (rid * self._n_shards) >> self.range_bits
+
     # -- state -------------------------------------------------------------
 
     @property
@@ -309,7 +368,7 @@ class ResidencyManager:
             self._table = None
             self._dir.clear()
             self._ranges.clear()
-            self._free = list(range(self.capacity - 1, -1, -1))
+            self._free = self._fresh_free()
         if not already:
             self._enabled_gauge.set(0, channel=self.channel)
             _log.warning(
@@ -477,16 +536,26 @@ class ResidencyManager:
             if not self._enabled:
                 return 0
             admitting: set[int] = set()
+            # shards whose pool ran dry AND had nothing evictable this
+            # call — later keys routed there stay misses without
+            # rescanning the LRU per key
+            dead: set[int] = set()
             for i, pr in enumerate(pairs):
                 if pr in self._dir:
                     continue
                 rid = self.range_of(pr[0], pr[1])
-                if not self._free and not (evict and self._evict_locked(
-                        protect=admitting | {rid})):
-                    break  # nothing evictable: the rest stay misses
-                if not self._free:
-                    break
-                slot = self._free.pop()
+                sh = self._shard_of(rid)
+                if sh in dead:
+                    continue
+                if not self._free[sh] and not (
+                        evict and self._evict_locked(
+                            protect=admitting | {rid}, shard=sh)):
+                    dead.add(sh)  # nothing evictable on the owner
+                    continue
+                if not self._free[sh]:
+                    dead.add(sh)
+                    continue
+                slot = self._free[sh].pop()
                 self._dir[pr] = (slot, rid)
                 admitting.add(rid)
                 if rid in self._ranges:
@@ -563,20 +632,25 @@ class ResidencyManager:
         flush()
         return admitted
 
-    def _evict_locked(self, protect: set) -> bool:
-        """Evict the least-recently-touched range not in ``protect``;
-        caller holds the lock.  Returns True when slots were freed.
-        Evicted rows need no device clear — the directory is
-        authoritative, and slot reuse always scatters the new value
-        before any launch frame can reference it."""
+    def _evict_locked(self, protect: set, shard: int | None = None) -> bool:
+        """Evict the least-recently-touched range not in ``protect``
+        (owned by ``shard`` when given — eviction pressure is routed
+        to the shard that needs the slots, never a neighbor); caller
+        holds the lock.  Returns True when slots were freed.  Evicted
+        rows need no device clear — the directory is authoritative,
+        and slot reuse always scatters the new value before any
+        launch frame can reference it."""
         for rid in self._ranges:
             if rid in protect:
+                continue
+            sh = self._shard_of(rid)
+            if shard is not None and sh != shard:
                 continue
             keys = self._ranges.pop(rid)
             for pr in keys:
                 e = self._dir.pop(pr, None)
                 if e is not None:
-                    self._free.append(e[0])
+                    self._free[sh].append(e[0])
             self._evictions_total += 1
             self._evict_ctr.add(1, channel=self.channel)
             return True
@@ -619,8 +693,9 @@ class ResidencyManager:
                 e = self._dir.get(pr)
                 if e is None:
                     rid = self.range_of(ns, key)
-                    if not self._free:
-                        continue
+                    sh = self._shard_of(rid)
+                    if not self._free[sh]:
+                        continue  # owner's pool dry: stays a miss
                     if rid not in self._ranges:
                         # brand-new range discovered by a write:
                         # admit within this call's budget only
@@ -628,7 +703,7 @@ class ResidencyManager:
                             continue
                         new_rids.add(rid)
                         self._ranges[rid] = []
-                    slot = self._free.pop()
+                    slot = self._free[sh].pop()
                     self._dir[pr] = (slot, rid)
                     self._ranges[rid].append(pr)
                 else:
@@ -679,15 +754,48 @@ class ResidencyManager:
                         pass
                     if not keys:
                         self._ranges.pop(rid, None)
-                self._free.append(slot)
+                self._free[self._shard_of(rid)].append(slot)
 
     def _disable_locked(self) -> None:
         self._enabled = False
         self._table = None
         self._dir.clear()
         self._ranges.clear()
-        self._free = list(range(self.capacity - 1, -1, -1))
+        self._free = self._fresh_free()
         self._enabled_gauge.set(0, channel=self.channel)
+
+    # -- mesh resize -------------------------------------------------------
+
+    def reshard(self, mesh) -> dict:
+        """Mesh-resize resharding: disable-latch → cold rebuild, the
+        safe fallback path.  The directory and device table drop
+        atomically under the lock, the shard geometry recomputes for
+        the new mesh, and the manager re-arms — the next launch
+        rebuilds the table lazily under the new ``state_table``
+        sharding and the working set re-faults in miss-by-miss (or
+        via :meth:`warm`).  Verdicts never change across a reshard:
+        every key simply rides the host oracle until readmitted.
+        Counters survive (the A/B attribution spans the resize);
+        ``reshards_total`` records the event.  Returns a stats
+        snapshot of the fresh geometry."""
+        with self._lock:
+            self.mesh = mesh
+            self._n_shards = self._resolve_shards(mesh)
+            self._slots_per_shard = self.capacity // self._n_shards
+            self._dir.clear()
+            self._ranges.clear()
+            self._table = None
+            self._free = self._fresh_free()
+            self._enabled = True
+            self._reshards_total += 1
+        self._enabled_gauge.set(1, channel=self.channel)
+        _log.info(
+            "%s: resident table resharded to %d shard(s) "
+            "(%d slots each) — cold rebuild",
+            self.channel or "validator", self._n_shards,
+            self._slots_per_shard,
+        )
+        return self.stats()
 
     # -- accounting --------------------------------------------------------
 
@@ -708,6 +816,38 @@ class ResidencyManager:
 
         _ledger.note_h2d("state", nbytes)
 
+    def shard_balance(self) -> dict:
+        """Key-range occupancy per shard — the bench
+        ``extras.shard_balance`` payload and the dryrun balance
+        assertion: per-shard resident key/range counts, free slots,
+        and the max/mean occupancy imbalance (1.0 = perfectly even;
+        blake2b range hashing keeps it close at realistic working-set
+        sizes)."""
+        with self._lock:
+            n = self._n_shards
+            keys = [0] * n
+            ranges = [0] * n
+            for rid, ks in self._ranges.items():
+                sh = self._shard_of(rid)
+                ranges[sh] += 1
+                keys[sh] += len(ks)
+            free = [len(f) for f in self._free]
+            sps = self._slots_per_shard
+        mean = sum(keys) / n if n else 0.0
+        mx = max(keys) if keys else 0
+        return {
+            "shards": n,
+            "slots_per_shard": sps,
+            "per_shard_keys": keys,
+            "per_shard_ranges": ranges,
+            "per_shard_free_slots": free,
+            "occupancy_max": mx,
+            "occupancy_mean": round(mean, 2),
+            "imbalance_max_over_mean": (
+                round(mx / mean, 4) if mean else None
+            ),
+        }
+
     def stats(self) -> dict:
         """Snapshot for bench extras and tests."""
         with self._lock:
@@ -717,6 +857,9 @@ class ResidencyManager:
                 "enabled": self._enabled,
                 "capacity_slots": self.capacity,
                 "range_bits": self.range_bits,
+                "shards": self._n_shards,
+                "slots_per_shard": self._slots_per_shard,
+                "reshards_total": self._reshards_total,
                 "resident_keys": len(self._dir),
                 "resident_ranges": len(self._ranges),
                 "hits_total": self._hits_total,

@@ -25,6 +25,13 @@ if [ "$lint_only" = "1" ]; then
     exit "$lint_rc"
 fi
 
+echo "== multichip dryrun =="
+# 8-virtual-device partition-rule dryrun (scripts/dryrun_multichip.py):
+# rule table, per-family placement, key-range balance, reshard
+# identity, and the sharded == unsharded kernel differential
+timeout -k 10 300 python scripts/dryrun_multichip.py
+mc_rc=$?
+
 echo "== replay smoke =="
 # crypto-free catch-up smoke (scripts/replay_smoke.py): toy chain
 # through the REAL ReplayDriver + snapshot round-trip, pinning the
@@ -43,6 +50,8 @@ t1_rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
 
 [ "$lint_rc" -ne 0 ] && echo "analyzer battery FAILED (rc=$lint_rc)"
+[ "$mc_rc" -ne 0 ] && echo "multichip dryrun FAILED (rc=$mc_rc)"
 [ "$smoke_rc" -ne 0 ] && echo "replay smoke FAILED (rc=$smoke_rc)"
 [ "$t1_rc" -ne 0 ] && echo "tier-1 tests FAILED (rc=$t1_rc)"
-[ "$lint_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] && [ "$t1_rc" -eq 0 ]
+[ "$lint_rc" -eq 0 ] && [ "$mc_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] \
+    && [ "$t1_rc" -eq 0 ]

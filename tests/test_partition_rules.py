@@ -1,0 +1,369 @@
+"""Declarative partition rules (fabric_tpu/parallel/mesh.py): the
+sharded ≡ unsharded differential battery.
+
+All crypto-free, all on the virtual 8-device CPU mesh (conftest forces
+``xla_force_host_platform_device_count=8``):
+
+1. registry sanity — every stage-2 operand family has a rule, unknown
+   families are loud, the table renders;
+2. the fused stage-2 program through the named partition families is
+   bit-equal to the unsharded host-oracle run on EVERY output lane at
+   2/4/8 devices;
+3. key-range residency — slot-block ownership (slot // slots_per_shard
+   == owning shard of the key's range id), hit/commit/evict behaviour
+   identical to the 1-shard host oracle;
+4. mesh-resize resharding (disable-latch → cold rebuild) reaches a
+   state identical to a fresh manager at the new size;
+5. the silent single-device fallback counter fires on ragged axis-0;
+6. the launch ledger's ``sharded`` row tag + per-kernel
+   ``unsharded_launches``.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from fabric_tpu.ledger.statedb import MemVersionedDB, UpdateBatch
+from fabric_tpu.parallel import mesh as pmesh
+from fabric_tpu.parallel.topology import MeshTopology, parse_mesh_shape
+from fabric_tpu.state import ResidencyManager, build_launch_pack
+
+from tests.test_resident import (
+    _KEYS,
+    _run_host,
+    _run_resident,
+    _seed_state,
+    _stage2_fixture,
+)
+
+
+# ---------------------------------------------------------------------------
+# 1. registry sanity
+
+
+#: every family the stage-2 fused dispatch + stage-1 verify upload
+_STAGE2_FAMILIES = (
+    "verify_lanes", "sign_rows", "launch_frame", "policy_table",
+    "static_pack", "mvcc_frame", "read_versions", "state_table",
+    "unique_read_pack",
+)
+
+
+def test_every_operand_family_has_a_rule():
+    for fam in _STAGE2_FAMILIES:
+        rule = pmesh.rule_for(fam)
+        assert rule.family == fam
+        assert rule.description.strip()
+    # batch families split axis 0 over "data"; the unique-read pack
+    # replicates (gathered from every shard)
+    assert pmesh.rule_for("launch_frame").axes == (pmesh.DATA_AXIS,)
+    assert pmesh.rule_for("unique_read_pack").replicated
+
+
+def test_unknown_family_is_loud():
+    with pytest.raises(KeyError, match="no partition rule"):
+        pmesh.rule_for("mystery_operand")
+
+
+def test_rules_table_renders():
+    table = pmesh.rules_table()
+    assert {r["family"] for r in table} >= set(_STAGE2_FAMILIES)
+    for row in table:
+        assert row["spec"] and row["description"]
+
+
+def test_spec_pads_trailing_dims():
+    # trailing dims are per-lane payload — always replicated
+    assert pmesh.spec_for("launch_frame", 1) == pmesh.P("data")
+    assert pmesh.spec_for("launch_frame", 3) == \
+        pmesh.P("data", None, None)
+    assert pmesh.spec_for("unique_read_pack", 2) == pmesh.P(None, None)
+
+
+def test_topology_parse_and_resolution():
+    assert parse_mesh_shape("8") == (8,)
+    assert parse_mesh_shape("2x4") == (2, 4)
+    for bad in ("", "0", "2x0", "ax4", "2x2x2"):
+        with pytest.raises(ValueError):
+            parse_mesh_shape(bad)
+    # the unconfigured topology is a no-mesh no-op
+    assert not MeshTopology().configured
+    assert MeshTopology().resolve() is None
+    # the classic count is the 1-process special case
+    t = MeshTopology(devices=4)
+    m = t.resolve()
+    assert m is not None and pmesh.data_axis_size(m) == 4
+    # a 1-D shape over the virtual devices
+    m8 = MeshTopology(shape="8").resolve()
+    assert m8 is not None and pmesh.data_axis_size(m8) == 8
+    # data x replica grid: the data axis is dim 0
+    m24 = MeshTopology(shape="2x4").resolve()
+    assert m24 is not None and pmesh.data_axis_size(m24) == 2
+    assert dict(m24.shape)[pmesh.REPLICA_AXIS] == 4
+    # an unfit grid degrades to the local auto mesh, never refuses
+    big = MeshTopology(shape="64x2").resolve()
+    assert big is None or pmesh.data_axis_size(big) >= 1
+
+
+# ---------------------------------------------------------------------------
+# 2. sharded stage-2 ≡ unsharded, every output lane, 2/4/8 devices
+
+
+def test_stage2_sharded_bit_equal_across_device_counts():
+    from fabric_tpu.peer.device_block import DeviceBlockPipeline
+
+    rng = np.random.default_rng(20260807)
+    fx = _stage2_fixture(rng)
+    pipe = DeviceBlockPipeline()
+    base = _run_host(pipe, fx)
+    assert base["valid"][:12].any() and not base["valid"][:12].all()
+    for nd in (2, 4, 8):
+        mesh = pmesh.resolve_mesh(nd)
+        assert pmesh.data_axis_size(mesh) == nd
+        res = ResidencyManager(slots=64, range_bits=5, mesh=mesh)
+        _run_resident(pipe, fx, res, mesh=mesh)   # warm (admit)
+        got = _run_resident(pipe, fx, res, mesh=mesh)
+        for k in _KEYS:
+            assert np.array_equal(base[k], got[k]), (nd, k)
+        assert res.stats()["shards"] == nd
+
+
+# ---------------------------------------------------------------------------
+# 3. key-range sharded residency ≡ host oracle
+
+
+def _shard_of(res, rid):
+    """The ownership law, restated independently of the manager: top
+    ``log2(n_shards)`` bits of the range id pick the shard."""
+    return (rid * res.stats()["shards"]) >> res.range_bits
+
+
+def test_key_range_slot_block_ownership():
+    """Every admitted key lands in its owning shard's contiguous slot
+    block — the invariant that makes the plain axis-0 NamedSharding
+    over the table BE the key-range partition."""
+    state = _seed_state(32, stale_every=0, absent_every=0)
+    mesh = pmesh.resolve_mesh(4)
+    res = ResidencyManager(slots=64, range_bits=6, mesh=mesh)
+    st = res.stats()
+    assert st["shards"] == 4 and st["slots_per_shard"] == 16
+    pairs = [("ns", f"k{u}") for u in range(32)]
+    build_launch_pack(res, pairs, state)
+    slots, _t = res.lookup(pairs)
+    assert (slots >= 0).all()
+    for pr, slot in zip(pairs, slots):
+        rid = res.range_of(*pr)
+        assert slot // 16 == _shard_of(res, rid), (pr, int(slot))
+    bal = res.shard_balance()
+    assert sum(bal["per_shard_keys"]) == 32
+    assert bal["occupancy_max"] <= 16
+
+
+def test_key_range_sharded_hit_commit_evict_matches_oracle():
+    """The 4-shard manager and the 1-shard oracle answer every lookup
+    identically through admission, a commit delta scatter, and
+    per-shard eviction churn."""
+    state = _seed_state(24, stale_every=3, absent_every=4)
+    oracle = ResidencyManager(slots=64, range_bits=6)
+    mesh = pmesh.resolve_mesh(4)
+    sharded = ResidencyManager(slots=64, range_bits=6, mesh=mesh)
+    pairs = [("ns", f"k{u}") for u in range(24)]
+
+    def versions(res):
+        out = []
+        slots, table = res.lookup(pairs)
+        arr = np.asarray(table) if table is not None else None
+        for s in slots:
+            if s < 0:
+                out.append("miss")
+            else:
+                row = arr[s]
+                out.append(
+                    tuple(int(x) for x in row[1:3]) if row[0] else None
+                )
+        return out
+
+    for res in (oracle, sharded):
+        build_launch_pack(res, pairs, state)
+    assert versions(oracle) == versions(sharded)
+
+    # commit delta: update, delete, and a write into a resident range
+    cb = UpdateBatch()
+    cb.put("ns", "k0", b"n", (7, 0))
+    cb.delete("ns", "k1", (7, 1))
+    for res in (oracle, sharded):
+        res.apply_batch(cb)
+    assert versions(oracle) == versions(sharded)
+
+    # eviction churn: a small sharded cache over a large key stream
+    # still answers exactly like the small unsharded one would for
+    # keys both hold; per-shard eviction must fire
+    small = ResidencyManager(slots=16, range_bits=4, mesh=mesh)
+    ones = np.ones(1, bool)
+    ver = np.asarray([[1, 0]], np.uint32)
+    for i in range(200):
+        small.admit([("ns", "c%d" % i)], ones, ver)
+    st = small.stats()
+    assert st["evictions_total"] > 0
+    assert st["resident_keys"] <= small.capacity
+    # ownership never broke under churn
+    bal = small.shard_balance()
+    assert sum(bal["per_shard_keys"]) == st["resident_keys"]
+    occupied = sum(
+        small.capacity // st["shards"] - f
+        for f in bal["per_shard_free_slots"]
+    )
+    assert occupied == st["resident_keys"]
+
+
+def test_non_dividing_mesh_degrades_to_one_shard():
+    # capacity not divisible by the data axis → 1 logical shard (the
+    # safe degrade), never a broken layout
+    mesh3 = pmesh.resolve_mesh(3)
+    assert pmesh.data_axis_size(mesh3) == 3
+    res = ResidencyManager(slots=8, range_bits=3, mesh=mesh3)
+    assert res.stats()["shards"] == 1
+    # a mesh wider than capacity degrades too
+    wide = ResidencyManager(slots=4, range_bits=3,
+                            mesh=pmesh.resolve_mesh(8))
+    assert wide.stats()["shards"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 4. mesh-resize resharding
+
+
+def test_reshard_reaches_identical_post_rebuild_state():
+    """Resize 2 → 4 shards: the reshard path (disable-latch → cold
+    rebuild) re-arms the manager, and after re-warming it is
+    indistinguishable — same lookups, same slot-block ownership — from
+    a manager BORN at 4 shards."""
+    state = _seed_state(32, stale_every=0, absent_every=0)
+    pairs = [("ns", f"k{u}") for u in range(32)]
+
+    grown = ResidencyManager(slots=64, range_bits=6,
+                             mesh=pmesh.resolve_mesh(2))
+    build_launch_pack(grown, pairs, state)        # warm at 2 shards
+    assert grown.stats()["shards"] == 2
+    mesh4 = pmesh.resolve_mesh(4)
+    st = grown.reshard(mesh4)
+    assert st["shards"] == 4
+    assert st["resident_keys"] == 0               # cold rebuild
+    assert st["enabled"] is True                  # re-armed
+    assert st["reshards_total"] == 1
+
+    fresh = ResidencyManager(slots=64, range_bits=6, mesh=mesh4)
+    for res in (grown, fresh):
+        build_launch_pack(res, pairs, state)      # warm both at 4
+
+    g_slots, g_table = grown.lookup(pairs)
+    f_slots, f_table = fresh.lookup(pairs)
+    assert np.array_equal(g_slots, f_slots)
+    assert np.array_equal(
+        np.asarray(g_table)[g_slots], np.asarray(f_table)[f_slots]
+    )
+    assert grown.shard_balance() == fresh.shard_balance()
+
+    # reshard re-arms even a latched-off manager (operator resize)
+    grown.disable("test latch")
+    assert not grown.enabled
+    st2 = grown.reshard(pmesh.resolve_mesh(2))
+    assert st2["enabled"] is True and st2["reshards_total"] == 2
+
+
+def test_reshard_verdicts_bit_equal_through_stage2():
+    """The full loop: stage-2 verdicts through a 2-shard manager, a
+    reshard to 4, and the re-warmed 4-shard run — all bit-equal to the
+    host oracle."""
+    from fabric_tpu.peer.device_block import DeviceBlockPipeline
+
+    rng = np.random.default_rng(20260808)
+    fx = _stage2_fixture(rng)
+    pipe = DeviceBlockPipeline()
+    base = _run_host(pipe, fx)
+    mesh2, mesh4 = pmesh.resolve_mesh(2), pmesh.resolve_mesh(4)
+    res = ResidencyManager(slots=64, range_bits=5, mesh=mesh2)
+    got2 = _run_resident(pipe, fx, res, mesh=mesh2)
+    for k in _KEYS:
+        assert np.array_equal(base[k], got2[k]), ("pre-reshard", k)
+    res.reshard(mesh4)
+    _run_resident(pipe, fx, res, mesh=mesh4)      # re-warm cold
+    got4 = _run_resident(pipe, fx, res, mesh=mesh4)
+    for k in _KEYS:
+        assert np.array_equal(base[k], got4[k]), ("post-reshard", k)
+    assert res.stats()["hits_total"] > 0
+
+
+# ---------------------------------------------------------------------------
+# 5. the silent-fallback counter
+
+
+def test_ragged_axis0_counts_fallback():
+    mesh = pmesh.resolve_mesh(8)
+    before = pmesh.fallback_stats().get("ragged_axis0", 0)
+    arr = pmesh.shard(mesh, "launch_frame", jnp.zeros((12, 3)))
+    assert arr.shape == (12, 3)                   # correct, unparallel
+    after = pmesh.fallback_stats().get("ragged_axis0", 0)
+    assert after == before + 1
+    # empty axis 0 is its own reason
+    b0 = pmesh.fallback_stats().get("empty_axis0", 0)
+    pmesh.shard(mesh, "launch_frame", jnp.zeros((0, 3)))
+    assert pmesh.fallback_stats().get("empty_axis0", 0) == b0 + 1
+    # a dividing shape does NOT count
+    b1 = pmesh.fallback_stats().get("ragged_axis0", 0)
+    out = pmesh.shard(mesh, "launch_frame", jnp.zeros((16, 3)))
+    assert pmesh.fallback_stats().get("ragged_axis0", 0) == b1
+    assert len(out.sharding.device_set) == 8
+    # replicated families never count
+    b2 = dict(pmesh.fallback_stats())
+    pmesh.shard(mesh, "unique_read_pack", jnp.zeros((13, 4)))
+    assert pmesh.fallback_stats() == b2
+    # no mesh → plain passthrough, not a "fallback"
+    b3 = dict(pmesh.fallback_stats())
+    pmesh.shard(None, "launch_frame", jnp.zeros((12, 3)))
+    assert pmesh.fallback_stats() == b3
+
+
+# ---------------------------------------------------------------------------
+# 6. the launch ledger's sharded tag
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_ledger_sharded_tag_and_stats():
+    from fabric_tpu.observe.ledger import LaunchLedger
+    from fabric_tpu.observe.tracer import Tracer
+    from fabric_tpu.ops_metrics import Registry
+
+    clk = _Clock()
+    led = LaunchLedger(
+        registry=Registry(),
+        tracer=Tracer(ring_blocks=8, slow_factor=0, clock=clk),
+        clock=clk,
+    )
+
+    def run(sharded):
+        rec = led.launch("stage2", compiled=False, lanes=16,
+                         sharded=sharded)
+        clk.t += 0.001
+        rec.dispatched()
+        rec.sync_begin()
+        clk.t += 0.002
+        rec.sync_end()
+
+    run(True)      # sharded dispatch
+    run(False)     # the ragged fallback the tag exists for
+    run(None)      # no mesh configured: untagged
+    rows = led.rows()
+    assert rows[0]["sharded"] is True
+    assert rows[1]["sharded"] is False
+    assert "sharded" not in rows[2]
+    st = led.stats()["kernels"]["stage2"]
+    assert st["launches"] == 3
+    assert st["unsharded_launches"] == 1

@@ -2298,6 +2298,7 @@ def test_rule_battery_registered():
         "FT016": "unattributed-device-sync",
         "FT017": "cross-thread-state",
         "FT018": "lost-update",
+        "FT019": "unruled-sharding",
     }
 
 
@@ -2746,6 +2747,106 @@ class TestLostUpdate:
         assert [(f.line,) for f in got] == [(19,), (23,)]
 
 
+# -- FT019 unruled-sharding -------------------------------------------------
+
+# hand-built layouts at a dispatch site: the exact ad-hoc shape the
+# partition-rule registry (parallel/mesh.py) replaced
+BAD_UNRULED = """\
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def put(mesh, arr):
+    spec = P("data")
+    s = NamedSharding(mesh, spec)
+    return jax.device_put(arr, s)
+
+
+def raw(mesh):
+    return jax.sharding.PositionalSharding(mesh.devices)
+"""
+
+# the ruled path: layouts come from the registry, plain device_put
+# (no sharding construction) stays untouched
+CLEAN_UNRULED = """\
+import jax
+
+
+def put(mesh, arr):
+    from fabric_tpu.parallel.mesh import shard
+
+    return shard(mesh, "verify_lanes", arr)
+
+
+def replicate(arr):
+    return jax.device_put(arr)
+
+
+def local_helper(mesh, spec):
+    def NamedSharding(m, s):
+        return (m, s)
+
+    return NamedSharding(mesh, spec)
+"""
+
+
+class TestUnruledSharding:
+    def _rule(self):
+        from fabric_tpu.analysis.rules.unruled_sharding import (
+            UnruledShardingRule,
+        )
+
+        return UnruledShardingRule()
+
+    def test_flags_raw_constructors(self, tmp_path):
+        got = run_rule(tmp_path, self._rule(),
+                       {"fabric_tpu/peer/launcher.py": BAD_UNRULED})
+        assert [(f.rule, f.line) for f in got] == [
+            ("FT019", 6),    # P("data") — the PartitionSpec alias
+            ("FT019", 7),    # NamedSharding(...)
+            ("FT019", 12),   # jax.sharding.PositionalSharding(...)
+        ]
+        assert "sharding_for" in got[0].message
+
+    def test_ruled_path_never_flags(self, tmp_path):
+        # registry calls, bare device_put, and a same-named LOCAL
+        # helper (import-aware resolution must not match it)
+        assert run_rule(
+            tmp_path, self._rule(),
+            {"fabric_tpu/peer/launcher.py": CLEAN_UNRULED},
+        ) == []
+
+    def test_partition_layer_exempt(self, tmp_path):
+        # fabric_tpu/parallel/ IS the layer raw constructors belong in
+        assert run_rule(
+            tmp_path, self._rule(),
+            {"fabric_tpu/parallel/mesh.py": BAD_UNRULED},
+        ) == []
+
+    def test_out_of_package_exempt(self, tmp_path):
+        # bench/scripts drivers are not part of the dispatch surface
+        assert run_rule(
+            tmp_path, self._rule(),
+            {"scripts/driver.py": BAD_UNRULED,
+             "bench.py": BAD_UNRULED},
+        ) == []
+
+    def test_test_code_exempt(self, tmp_path):
+        assert run_rule(
+            tmp_path, self._rule(),
+            {"tests/test_launcher.py": BAD_UNRULED},
+        ) == []
+
+    def test_noqa_suppresses_one_site(self, tmp_path):
+        src = BAD_UNRULED.replace(
+            "    s = NamedSharding(mesh, spec)",
+            "    s = NamedSharding(mesh, spec)  # fabtpu: noqa(FT019)",
+        )
+        got = run_rule(tmp_path, self._rule(),
+                       {"fabric_tpu/peer/launcher.py": src})
+        assert [f.line for f in got] == [6, 12]
+
+
 # -- the ported-rule differential pin ---------------------------------------
 
 
@@ -2941,6 +3042,7 @@ def _meta_fixtures():
         "FT016": {"mod.py": BAD_UNATTRIBUTED},
         "FT017": {"mod.py": BAD_CROSS_THREAD},
         "FT018": {"mod.py": BAD_LOST_UPDATE},
+        "FT019": {"fabric_tpu/peer/launcher.py": BAD_UNRULED},
     }
     clean = {
         "FT001": {"mod.py": _META_JIT_CLEAN},
@@ -2962,6 +3064,8 @@ def _meta_fixtures():
         "FT016": {"mod.py": CLEAN_UNATTRIBUTED},
         "FT017": {"mod.py": CLEAN_CROSS_THREAD},
         "FT018": {"mod.py": CLEAN_LOST_UPDATE},
+        "FT019": {"fabric_tpu/peer/launcher.py": CLEAN_UNRULED,
+                  "scripts/driver.py": BAD_UNRULED},
     }
     return bad, clean
 
@@ -2990,7 +3094,7 @@ def test_registry_meta_battery(tmp_path):
     from fabric_tpu.analysis import all_rules
 
     rules = all_rules()
-    assert len(rules) == 18
+    assert len(rules) == 19
     bad_fixtures, clean_fixtures = _meta_fixtures()
     for rule in rules:
         assert rule.description.strip(), f"{rule.id}: empty description"
