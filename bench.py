@@ -160,6 +160,12 @@ def _bench_endorse_sign():
         batch_max=int(os.environ.get("FABTPU_BENCH_SIGN_BATCH", "256")),
         wait_ms=2.0,
     ).start()
+    from fabric_tpu.observe import txflow as txflow_mod
+
+    if txflow_mod.enabled():
+        # the journal's sign_wait stage trail rides the lane's
+        # observer hook, exactly as a sign_device peer wires it
+        batcher.observer = txflow_mod.sign_observer()
     feeders = 8
     per = B // feeders
 
@@ -502,6 +508,29 @@ def _ledger_capture():
     from fabric_tpu.observe import ledger as ledger_mod
 
     return ledger_mod.configure()
+
+
+def _txflow_capture():
+    """Arm the process-global tx-flow journal for the scenario —
+    block-commit benches then ship ``extras.tx_flow`` (per-stage and
+    e2e percentiles, visibility lag, last completed flows) and
+    endorse_sign ships its sign-wait trail.  Default ON;
+    ``FABTPU_BENCH_TXFLOW=0`` keeps the journal-less hot path — the
+    overhead A/B for the <2% tx/s acceptance gate."""
+    import os
+
+    if os.environ.get("FABTPU_BENCH_TXFLOW", "1") != "1":
+        return None
+    from fabric_tpu.observe import txflow as txflow_mod
+
+    return txflow_mod.configure()
+
+
+def _txflow_extras(j) -> dict | None:
+    """Snapshot the tx-flow journal for the BENCH_*.json extras."""
+    if j is None:
+        return None
+    return j.report(rows=8)
 
 
 def _ledger_extras(led) -> dict | None:
@@ -2250,6 +2279,10 @@ def main():
     # FABTPU_BENCH_LEDGER=0 disarms): extras.device_ledger decomposes
     # the run's device_wait into compile/queue/execute/transfer
     led = _ledger_capture()
+    # the per-tx flow journal is ON for every scenario (default;
+    # FABTPU_BENCH_TXFLOW=0 disarms — the armed-overhead A/B):
+    # extras.tx_flow carries stage/e2e percentiles + visibility lag
+    txj = _txflow_capture()
     result = _BENCHES[name]()
     if name == "block_commit":
         # self-contained round artifact: the headline clean number
@@ -2287,6 +2320,9 @@ def main():
     ledger_rep = _ledger_extras(led)
     if ledger_rep is not None:
         result.setdefault("extras", {})["device_ledger"] = ledger_rep
+    txflow_rep = _txflow_extras(txj)
+    if txflow_rep is not None:
+        result.setdefault("extras", {})["tx_flow"] = txflow_rep
     print(json.dumps(result))
 
 

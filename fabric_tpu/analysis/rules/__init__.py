@@ -27,11 +27,14 @@ them); slugs are the human-facing names:
                                  the class guards elsewhere
     FT019 unruled-sharding       raw jax.sharding constructors outside
                                  the partition-rule layer
+    FT020 clock-mixing           subtractions mixing time.time() with
+                                 monotonic/perf_counter readings
 """
 
 from fabric_tpu.analysis.rules import (  # noqa: F401
     asyncio_task_leak,
     blocking_wait,
+    clock_mixing,
     cross_thread_state,
     device_buffer_lifetime,
     host_sync,

@@ -1,0 +1,135 @@
+"""FT020 clock-mixing: latency arithmetic across clock domains.
+
+Every latency instrument in this repo — the span tracer, the launch
+ledger, the commit pipeline stage timers, the tx-flow journal's
+milestone deltas (``observe/txflow.py``) — lives on ONE monotonic
+clock (``time.perf_counter``/``time.monotonic``), because a duration
+is only meaningful as the difference of two readings of the SAME
+clock.  ``time.time()`` is a different domain: it has a different
+epoch, and NTP slews and steps it at any moment, so
+``time.time() - time.perf_counter()`` (or any cross-domain
+subtraction) is not a duration — it is an arbitrary number that
+silently drifts.  This is exactly the bug class that would corrupt
+every milestone delta the tx-flow journal publishes while all the
+arithmetic looks plausible, so the battery pins it mechanically.
+
+Mechanics (strictly under-approximating, per the FT003..FT019
+contract — a finding is always real), on the shared provenance
+engine (:mod:`fabric_tpu.analysis.provenance`):
+
+1. **Scope**: only modules under ``fabric_tpu/`` — out-of-package
+   drivers (bench, scripts) may legitimately stamp wall-clock
+   metadata; test code is exempt engine-wide.
+2. **The subtraction**: any ``a - b`` where one operand PROVABLY
+   canonicalizes to the monotonic family (``time.monotonic``,
+   ``time.perf_counter``, their ``_ns`` variants) and the other to
+   the wall family (``time.time``, ``time.time_ns``) — either
+   direction.  Canonicalization is import-aware
+   (``ImportMap.resolve_call`` — aliases and from-import renames
+   tracked, a same-named local helper never matches) and follows
+   ``int()``/``float()``/``round()``/``abs()`` wrappers plus at most
+   one same-scope single-assignment local hop per side
+   (``SingleAssignScope`` — every other binding form poisons).
+3. Anything unprovable — parameters, attributes, cross-function
+   flow, a local bound twice — stays silent: it may still be wrong,
+   but the rule cannot prove it (the under-approximation contract).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.analysis.core import Finding, ModuleCtx, Rule, register
+from fabric_tpu.analysis.provenance import module_index, walk_scope
+
+#: canonical dotted names per clock domain
+_MONO = {
+    "time.monotonic", "time.perf_counter",
+    "time.monotonic_ns", "time.perf_counter_ns",
+}
+_WALL = {"time.time", "time.time_ns"}
+
+#: value-preserving wrappers the provenance walk sees through
+_WRAPPERS = {"int", "float", "round", "abs"}
+
+_SCOPE_PREFIX = "fabric_tpu/"
+
+
+@register
+class ClockMixingRule(Rule):
+    id = "FT020"
+    name = "clock-mixing"
+    severity = "error"
+    description = (
+        "flags subtractions mixing a time.time()-derived value with "
+        "a time.monotonic()/perf_counter()-derived one — the clocks "
+        "have different epochs and wall time is NTP-stepped, so the "
+        "difference is not a duration; read both ends from the same "
+        "monotonic clock"
+    )
+
+    def check_module(self, ctx: ModuleCtx) -> list[Finding]:
+        rel = ctx.relpath.replace("\\", "/")
+        if not rel.startswith(_SCOPE_PREFIX):
+            return []
+        idx = module_index(ctx)
+        imports = idx.imports
+        if not imports.any_binding(
+            lambda c: c.split(".")[0] == "time"
+        ):
+            return []  # the module never imports time at all
+        out: list[Finding] = []
+        # tree body + every function (methods included) + class
+        # bodies — walk_scope never re-enters nested scopes, so each
+        # Sub node is visited exactly once; scope-local provenance
+        # comes from the enclosing function's tracker (module/class
+        # bodies get their own)
+        for scope in [ctx.tree] + idx.functions + idx.classes:
+            tracker = idx.scope(scope)
+            for node in walk_scope(scope):
+                if not (isinstance(node, ast.BinOp)
+                        and isinstance(node.op, ast.Sub)):
+                    continue
+                left = self._clock_of(node.left, tracker, imports, 0)
+                right = self._clock_of(node.right, tracker, imports, 0)
+                if left is None or right is None:
+                    continue
+                if left[0] == right[0]:
+                    continue  # same domain: a real duration
+                out.append(self.finding(
+                    ctx, node.lineno, node.col_offset,
+                    f"subtraction mixes clock domains: {left[1]} "
+                    f"({left[0]}) vs {right[1]} ({right[0]}) — "
+                    f"different epochs, and wall time is NTP-slewed "
+                    f"mid-measurement, so this difference is not a "
+                    f"duration; take both readings from the same "
+                    f"monotonic clock (time.perf_counter)",
+                ))
+        out.sort(key=lambda f: (f.line, f.col))
+        return out
+
+    # -- provenance --------------------------------------------------------
+
+    def _clock_of(self, node, tracker, imports, depth: int):
+        """(domain, source) when ``node`` provably reads one clock
+        family — "mono" or "wall" — else None."""
+        if depth > 4:
+            return None
+        if isinstance(node, ast.Call):
+            canon = imports.resolve_call(node)
+            if canon in _MONO:
+                return ("mono", canon)
+            if canon in _WALL:
+                return ("wall", canon)
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in _WRAPPERS
+                    and node.func.id not in imports.local_defs
+                    and node.args):
+                return self._clock_of(node.args[0], tracker, imports,
+                                      depth + 1)
+            return None
+        if isinstance(node, ast.Name):
+            v = tracker.value_of(node.id)
+            if v is not None:
+                return self._clock_of(v, tracker, imports, depth + 1)
+        return None

@@ -181,6 +181,7 @@ def _build_peer(cfg):
         sidecar_coalesce=cfg.sidecar_coalesce,
         async_commit=cfg.async_commit,
         apply_queue_blocks=cfg.apply_queue_blocks,
+        tx_flow=cfg.tx_flow,
     )
 
 

@@ -52,6 +52,7 @@ from collections import deque
 
 from fabric_tpu import faults as _faults
 from fabric_tpu.ledger.statedb import VersionedDB
+from fabric_tpu.observe import txflow as _txflow
 
 _log = logging.getLogger("fabric_tpu.ledger.committer")
 
@@ -203,11 +204,15 @@ class AsyncApplyEngine(VersionedDB):
             # a DURABLE savepoint must never get ahead of the block
             # files (see module docstring) — fence before the apply
             self._blocks.ensure_synced(entry.num)
+            _txflow.block_durable(entry.num)
         t0 = time.perf_counter()
         self._inner.apply_updates(entry.batch, entry.sp)
         if entry.post_apply is not None:
             entry.post_apply()
         dur = time.perf_counter() - t0
+        # the decoupled path's visibility edge: the block's writes
+        # (and history) became readable HERE, on the applier thread
+        _txflow.block_applied(entry.num)
         _faults.fire("ledger.apply.after", block=entry.num)
         return dur
 

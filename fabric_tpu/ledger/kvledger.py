@@ -32,6 +32,7 @@ from fabric_tpu.ledger.blockstore import BlockStore
 from fabric_tpu.ledger.history import HistoryDB
 from fabric_tpu.ledger.pvtdata import PvtDataStore
 from fabric_tpu.ledger.statedb import SqliteVersionedDB, UpdateBatch, VersionedDB
+from fabric_tpu.observe import txflow as _txflow
 from fabric_tpu.protos import common_pb2
 
 _log = logging.getLogger("fabric_tpu.ledger")
@@ -178,9 +179,13 @@ class KVLedger:
                 # backends (mem) recover by full replay, so they keep
                 # the amortized-fsync fast path.
                 self.blocks.sync()
+                _txflow.block_durable(num)
             self.state.apply_updates(batch, (num, 0))
             if self.history is not None and history_writes:
                 self.history.commit_block(num, history_writes)
+            # serial path: writes are readable the moment apply (+
+            # history) returns on the committer's own thread
+            _txflow.block_applied(num)
         self._purge_expired_pvt(num)
         t2 = _time.perf_counter()
         self._commit_hash = commit_hash

@@ -234,6 +234,17 @@ class PeerConfig:
     # launch (no thread); OFF makes every dispatch hook one global
     # read + None check and registers no instruments.
     device_ledger: bool = True
+    # per-transaction flow journal (fabric_tpu/observe/txflow.py):
+    # endorse → sign flush → submit → order → durable append → state
+    # visibility milestones on one monotonic clock, keyed by tx_id —
+    # served at /txflow, recorded as tx_flow_* histograms with trace
+    # exemplars, frozen into blackbox bundles, and (with ``slos``)
+    # feeding the default commit_e2e / commit_valid objectives one
+    # event per completed flow.  Default ON: an armed journal is a
+    # few perf_counter reads + one small dict per tx; OFF makes every
+    # milestone hook one global read + None check and registers no
+    # instruments.
+    tx_flow: bool = True
     # device-lane degradation (peer/degrade.py DeviceLaneGuard): after
     # device_fail_threshold CONSECUTIVE device-verify failures the
     # validator latches a degraded CPU mode (ops/p256.verify_host +

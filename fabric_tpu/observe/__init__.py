@@ -4,7 +4,10 @@ overlap-coverage analyzer (overlap.py), the flight-data recorder:
 metrics time-series trails (timeseries.py) + black-box incident
 bundles (blackbox.py), served at ``/vitals`` — and the per-launch
 device-time ledger (ledger.py) decomposing device_wait into
-compile / queue / execute / transfer, served at ``/launches``."""
+compile / queue / execute / transfer, served at ``/launches`` — and
+the per-transaction flow journal (txflow.py) attributing each tx's
+end-to-end latency across endorse / submit / order / durable / apply
+milestones on one monotonic clock, served at ``/txflow``."""
 
 from fabric_tpu.observe.overlap import (  # noqa: F401
     coverage_from_roots,

@@ -72,7 +72,7 @@ TRACE_TREES_PER_NS = 4
 #: points of each metric series frozen into a bundle
 SERIES_POINTS = 64
 
-_DROP_ORDER = ("traces", "vitals", "launches", "exemplars", "slo",
+_DROP_ORDER = ("traces", "vitals", "launches", "tx_flow", "exemplars", "slo",
                "scheduler", "autopilot")
 
 #: launch-ledger rows frozen into a bundle
@@ -170,8 +170,10 @@ class BlackBox:
 
             slo = global_engine()
         from fabric_tpu.observe import ledger as _ledger
+        from fabric_tpu.observe import txflow as _txflow
 
-        return sampler, tracer, autopilot, slo, _ledger.global_ledger()
+        return (sampler, tracer, autopilot, slo,
+                _ledger.global_ledger(), _txflow.global_journal())
 
     # -- recording ---------------------------------------------------------
 
@@ -206,7 +208,7 @@ class BlackBox:
 
     def _build(self, kind: str, detail: dict, now: float,
                seq: int) -> dict:
-        sampler, tracer, autopilot, slo, launches = self._sources()
+        sampler, tracer, autopilot, slo, launches, txflow = self._sources()
         bundle: dict = {
             "seq": seq,
             "kind": kind,
@@ -237,6 +239,11 @@ class BlackBox:
             # last few raw rows — the "was device_wait a compile?"
             # question answered inside the postmortem itself
             grab("launches", lambda: launches.report(rows=LEDGER_ROWS))
+        if txflow is not None:
+            # the per-tx flow journal: stage decomposition + the last
+            # few completed flows — "where did the p99 tx spend its
+            # second?" answered inside the postmortem itself
+            grab("tx_flow", lambda: txflow.report(rows=LEDGER_ROWS))
         if sampler is not None or launches is not None:
             from fabric_tpu.ops_metrics import exemplars_report
 
@@ -329,7 +336,7 @@ class BlackBox:
                     k for k in b
                     if k in ("vitals", "traces", "autopilot",
                              "scheduler", "slo", "faults", "launches",
-                             "exemplars", "commit_engine")
+                             "tx_flow", "exemplars", "commit_engine")
                 ),
                 "truncated": b.get("truncated", []),
             })

@@ -489,6 +489,49 @@ def endorse_observer(engine: SloEngine):
     return observer
 
 
+# -- commit-path objectives (the tx-flow journal's SLO feed) -----------------
+
+#: the default commit objective pair a peer arms when it runs BOTH an
+#: SLO spec and the tx-flow journal (peer/node.py): ``commit_e2e:
+#: latency`` — good = a completed flow's end-to-end wall (first
+#: milestone → state-apply visibility) came in under ms — and
+#: ``commit_valid:busy`` — good = the tx validated VALID (the "bad
+#: event" is an invalidated tx, exactly like a bounced sign request).
+#: Unlike the per-block tracer feed, these are CLIENT-VISIBLE
+#: latencies: one event per transaction, measured to the instant the
+#: write became readable, so the autopilot's burn-rate signals track
+#: what a user experiences rather than a per-block proxy.  They ride
+#: the dedicated ``commit`` channel next to ``endorse``.
+DEFAULT_COMMIT_SLOS = (
+    "commit_e2e:latency:ms=1000:channel=commit;"
+    "commit_valid:busy:pct=5:channel=commit"
+)
+
+COMMIT_CHANNEL = "commit"
+
+
+def commit_feed(engine: SloEngine):
+    """→ the ``FlowJournal.slo_feed`` callable that classifies each
+    completed tx flow into the engine's commit objectives.  Contract:
+    ``feed(e2e_s: float, valid: bool, n: int = 1)`` — called outside
+    the journal lock; ``n`` > 1 batches the journal's per-block cohort
+    publish (every orderer-side tx of a block shares one e2e/verdict)
+    into n identical events.  Objectives are resolved at CALL time, so
+    a ``set_objectives`` rotation never strands a stale closure (same
+    discipline as :func:`endorse_observer`)."""
+
+    def feed(e2e_s, valid, n=1):
+        e2e_ms = float(e2e_s) * 1000.0
+        for o in engine.objectives:
+            if o.channel != COMMIT_CHANNEL:
+                continue
+            good = bool(valid) if o.kind == "busy" else e2e_ms <= o.ms
+            for _ in range(int(n)):
+                engine.record(o, COMMIT_CHANNEL, good)
+
+    return feed
+
+
 _global = SloEngine()
 _attached = False
 

@@ -17,8 +17,10 @@ from __future__ import annotations
 import math
 import threading
 import time
+from bisect import bisect_left
 from collections import deque
 from dataclasses import dataclass, field
+from itertools import accumulate
 
 
 def _label_key(labels: dict) -> tuple:
@@ -35,6 +37,13 @@ class Counter:
         k = _label_key(labels)
         with self._lock:
             self._values[k] = self._values.get(k, 0.0) + delta
+
+    def add_locked(self, delta: float, key: tuple) -> None:
+        """``add`` with the registry lock HELD by the caller and the
+        label key precomputed — every instrument of one registry
+        shares the lock, so a multi-instrument batch (the tx-flow
+        cohort publish) pays ONE acquisition."""
+        self._values[key] = self._values.get(key, 0.0) + delta
 
     def value(self, **labels) -> float:
         # under the registry lock: an unlocked read can observe a dict
@@ -80,6 +89,9 @@ _DEFAULT_BUCKETS = (
 
 @dataclass
 class _Hist:
+    #: RAW per-bucket counts (first bucket the value fits) — one
+    #: bisect + one increment per observe instead of walking every
+    #: bucket; the read accessors cumulate (Prometheus ``le`` form)
     counts: list = field(default_factory=lambda: [0] * len(_DEFAULT_BUCKETS))
     total: float = 0.0
     n: int = 0
@@ -108,9 +120,12 @@ class Histogram:
                 h = self._values[k] = _Hist(counts=[0] * len(self.buckets))
             h.total += value
             h.n += 1
-            for i, b in enumerate(self.buckets):
-                if value <= b:
-                    h.counts[i] += 1
+            # first bucket that fits; a value past every bucket (no
+            # +Inf tail) counts toward sum/count but no bucket, same
+            # as the Prometheus cumulative form
+            i = bisect_left(self.buckets, value)
+            if i < len(h.counts):
+                h.counts[i] += 1
             if self.exemplar_k and exemplar is not None:
                 ring = self._exemplars.get(k)
                 if ring is None:
@@ -118,6 +133,44 @@ class Histogram:
                         maxlen=self.exemplar_k
                     )
                 ring.append((value, str(exemplar)))
+
+    def observe_repeat(self, value: float, n: int, *, exemplar=None,
+                       **labels) -> None:
+        """``n`` identical observations in O(buckets) under ONE lock
+        acquisition — the tx-flow journal's per-block cohort publish
+        (every tx of a block shares the included→applied interval, so
+        a 1000-tx block costs the same as a 1-tx one).  Bit-equal to
+        calling ``observe(value)`` n times; at most one exemplar is
+        recorded for the whole batch."""
+        n = int(n)
+        if n <= 0:
+            return
+        k = _label_key(labels)
+        with self._lock:
+            self.observe_repeat_locked(value, n, k, exemplar=exemplar)
+
+    def observe_repeat_locked(self, value: float, n: int, key: tuple,
+                              exemplar=None) -> None:
+        """Body of :meth:`observe_repeat` with the registry lock HELD
+        by the caller and the label key precomputed — every instrument
+        of one registry shares the lock, so a multi-instrument batch
+        (the tx-flow cohort publish: stages + e2e + lag + counter)
+        pays ONE acquisition for the whole block."""
+        h = self._values.get(key)
+        if h is None:
+            h = self._values[key] = _Hist(counts=[0] * len(self.buckets))
+        h.total += value * n
+        h.n += n
+        i = bisect_left(self.buckets, value)
+        if i < len(h.counts):
+            h.counts[i] += n
+        if self.exemplar_k and exemplar is not None:
+            ring = self._exemplars.get(key)
+            if ring is None:
+                ring = self._exemplars[key] = deque(
+                    maxlen=self.exemplar_k
+                )
+            ring.append((value, str(exemplar)))
 
     def value(self, **labels) -> dict | None:
         """Locked read of ONE label variant: {"counts" (cumulative per
@@ -128,13 +181,14 @@ class Histogram:
             h = self._values.get(_label_key(labels))
             if h is None:
                 return None
-            return {"counts": list(h.counts), "sum": h.total, "count": h.n}
+            return {"counts": list(accumulate(h.counts)),
+                    "sum": h.total, "count": h.n}
 
     def snapshot(self) -> dict[tuple, dict]:
         """Consistent copy of every label variant (render//trace)."""
         with self._lock:
             return {
-                k: {"counts": list(h.counts), "sum": h.total,
+                k: {"counts": list(accumulate(h.counts)), "sum": h.total,
                     "count": h.n}
                 for k, h in self._values.items()
             }

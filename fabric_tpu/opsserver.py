@@ -1,5 +1,5 @@
 """Operations HTTP server: /metrics, /healthz, /logspec, /version,
-/trace, /slo, /autopilot, /vitals, /launches, /debug.
+/trace, /slo, /autopilot, /vitals, /launches, /txflow, /debug.
 
 Reference: core/operations/system.go:89-209 — every peer and orderer
 process runs one (internal/peer/node/start.go:232-241,
@@ -49,7 +49,8 @@ class OperationsServer:
                  registry: Registry | None = None,
                  health: HealthRegistry | None = None,
                  tracer=None, slo=None, autopilot=None,
-                 vitals=None, blackbox=None, launches=None):
+                 vitals=None, blackbox=None, launches=None,
+                 txflow=None):
         self.host, self.port = host, port
         self.registry = registry or global_registry()
         self.health = health or HealthRegistry()
@@ -75,6 +76,9 @@ class OperationsServer:
         # /launches: the device-time launch ledger (None = lazy
         # process-global resolution, like /autopilot and /vitals)
         self.launches = launches
+        # /txflow: the per-tx flow journal (None = lazy process-global
+        # resolution, like /launches)
+        self.txflow = txflow
         self._server: asyncio.AbstractServer | None = None
 
     async def start(self):
@@ -188,6 +192,8 @@ class OperationsServer:
             return self._route_vitals(path)
         if path == "/launches" or path.startswith("/launches?"):
             return self._route_launches(path)
+        if path == "/txflow" or path.startswith("/txflow?"):
+            return self._route_txflow(path)
         if path.startswith("/debug/"):
             return self._route_debug(path)
         return 404, "application/json", b'{"error": "not found"}'
@@ -380,6 +386,45 @@ class OperationsServer:
         live = live_device_bytes()
         if live is not None:
             payload["live_device_bytes"] = live
+        return 200, "application/json", json.dumps(payload).encode()
+
+    def _route_txflow(self, path: str):
+        """Per-transaction flow attribution surface
+        (fabric_tpu.observe.txflow): stage p50/p99/max, e2e by
+        validation outcome, visibility lag (apply-visible minus
+        durable-append) and the last-N completed flows.  ``?n=K``
+        bounds the rows, ``?tx=TXID`` returns ONE flow's full
+        milestone record (completed or still in flight).  Unarmed
+        answers honestly: enabled false, no rows."""
+        from urllib.parse import parse_qs, urlparse
+
+        j = self.txflow
+        if j is None:
+            from fabric_tpu.observe import txflow as _txflow
+
+            j = _txflow.global_journal()
+        if j is None:
+            return 200, "application/json", json.dumps(
+                {"enabled": False}
+            ).encode()
+        q = parse_qs(urlparse(path).query)
+        tx = q.get("tx", [None])[0]
+        if tx is not None:
+            flow = j.lookup(tx)
+            if flow is None:
+                return 404, "application/json", json.dumps(
+                    {"enabled": True, "error": f"no flow for {tx}"}
+                ).encode()
+            return 200, "application/json", json.dumps(
+                {"enabled": True, "flow": flow}
+            ).encode()
+        try:
+            # <= 0 means no raw rows (rows() pins this — a raw slice
+            # would invert the bound via rows[-0:])
+            n = int(q.get("n", ["16"])[0])
+        except ValueError:
+            return 400, "application/json", b'{"error": "bad n"}'
+        payload = {"enabled": True, **j.report(rows=n)}
         return 200, "application/json", json.dumps(payload).encode()
 
     def _route_debug(self, path: str):

@@ -24,6 +24,7 @@ from fabric_tpu.comm.rpc import RpcClient
 from fabric_tpu.discovery import DiscoveryService, layouts_for_policy
 from fabric_tpu.peer import txassembly as txa
 
+from fabric_tpu.observe import txflow as _txflow
 from fabric_tpu.peer.endorser import Endorser
 from fabric_tpu.protos import common_pb2, proposal_pb2, transaction_pb2
 
@@ -34,6 +35,21 @@ class GatewayError(Exception):
     def __init__(self, status: int, msg: str):
         super().__init__(msg)
         self.status = status
+
+
+def _envelope_tx_id(env_bytes: bytes) -> str:
+    """tx_id from a signed Envelope's channel header, for the
+    tx-flow submit/broadcast stamps — contained: an unparsable
+    envelope is the orderer's problem to reject, not the journal's."""
+    try:
+        env = protoutil.unmarshal(common_pb2.Envelope, env_bytes)
+        payload = protoutil.unmarshal(common_pb2.Payload, env.payload)
+        ch = protoutil.unmarshal(
+            common_pb2.ChannelHeader, payload.header.channel_header
+        )
+        return ch.tx_id
+    except Exception:
+        return ""
 
 
 class Gateway:
@@ -127,6 +143,22 @@ class Gateway:
         client to back off briefly and retry, a 503 to try another
         gateway peer."""
         signed, prop, ch, cc_name, chan = self._parse_proposal(req)
+        # tx-flow journal: the endorse stage opens the per-tx record
+        # (observe/txflow.py) — a failed endorsement terminates the
+        # flow, a prepared one waits for submit/inclusion
+        _txflow.endorse_begin(ch.tx_id)
+        try:
+            payload = await self._endorse_inner(
+                req, signed, prop, ch, cc_name, chan
+            )
+        except BaseException:
+            _txflow.endorse_end(ch.tx_id, ok=False)
+            raise
+        _txflow.endorse_end(ch.tx_id)
+        return payload
+
+    async def _endorse_inner(self, req, signed, prop, ch, cc_name,
+                             chan) -> bytes:
         info = chan.validator.policies.info(cc_name)
         if info is None:
             raise GatewayError(404, f"no validation info for {cc_name}")
@@ -182,6 +214,11 @@ class Gateway:
         addrs = getattr(chan, "orderer_addrs", None) or []
         if not addrs:
             raise GatewayError(503, "no orderers known for channel")
+        # tx-flow journal: the envelope parse to recover tx_id is only
+        # paid when the journal is armed (one global check disarmed)
+        tx_id = _envelope_tx_id(env_bytes) if _txflow.enabled() else ""
+        if tx_id:
+            _txflow.submit_begin(tx_id)
         from fabric_tpu.ordering.node import BroadcastClient
 
         cli = BroadcastClient(
@@ -195,11 +232,20 @@ class Gateway:
             await cli.close()
         if res.get("status") != 200:
             raise GatewayError(res.get("status", 500), res.get("info", "broadcast failed"))
+        if tx_id:
+            _txflow.broadcast_done(tx_id)
         return json.dumps({"status": 200}).encode()
 
     async def commit_status(self, req: bytes) -> bytes:
         """req: JSON{channel, tx_id, timeout?} → {code, block} once the
-        tx commits (ledger commit notification analog)."""
+        tx commits (ledger commit notification analog).
+
+        The answer lands as soon as the tx is IN a block, but under
+        the decoupled committer (ledger/committer.py) its writes may
+        not be state-visible yet — ``applied`` is the honest
+        read-your-writes bit (true iff state apply has passed the
+        tx's block), alongside the channel's ``durable_height``
+        (appends past the fsync fence) and ``applied_height``."""
         q = json.loads(req)
         chan = self.node.channels.get(q["channel"])
         if chan is None:
@@ -210,9 +256,26 @@ class Gateway:
             loc = chan.ledger.blocks.get_tx_loc(txid)
             if loc is not None:
                 num, txnum, code = loc
+                ledger = chan.ledger
+                eng = getattr(ledger, "engine", None)
+                if eng is not None:
+                    applied_height = (
+                        int(eng.stats().get("applied_num", -1)) + 1
+                    )
+                else:
+                    # serial commit: state apply completes inside
+                    # commit_block, so applied tracks block height
+                    applied_height = int(ledger.blocks.height)
+                durable_height = int(
+                    getattr(ledger.blocks, "synced_height",
+                            ledger.blocks.height)
+                )
                 return json.dumps(
                     {"tx_id": txid, "code": int(code), "block": int(num),
-                     "code_name": transaction_pb2.TxValidationCode.Name(int(code))}
+                     "code_name": transaction_pb2.TxValidationCode.Name(int(code)),
+                     "applied": applied_height > int(num),
+                     "applied_height": applied_height,
+                     "durable_height": durable_height}
                 ).encode()
             remaining = deadline - asyncio.get_event_loop().time()
             if remaining <= 0:

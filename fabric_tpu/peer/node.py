@@ -29,6 +29,7 @@ from fabric_tpu import protoutil
 from fabric_tpu.comm.rpc import RpcServer
 from fabric_tpu.ledger.kvledger import KVLedger
 from fabric_tpu.ledger.statedb import MemVersionedDB
+from fabric_tpu.observe import txflow as _txflow
 from fabric_tpu.ordering.node import DeliverClient
 from fabric_tpu.peer.chaincode import ChaincodeRuntime
 from fabric_tpu.peer.endorser import Endorser
@@ -460,6 +461,11 @@ class PeerChannel:
         if sync:
             with tracer.span("fsync", parent=root):
                 self.ledger.blocks.sync()
+            # tx-flow durable fence (idempotent, first fence wins):
+            # on the serial mem-state path this sync is the block's
+            # first durability edge; on durable paths the ledger's own
+            # fence already stamped and this is a no-op
+            _txflow.block_durable(block.header.number)
         self._post_commit(block, flt, batch, txs)
 
     def _commit_metrics(self, flt: bytes, validate_s: float,
@@ -1223,7 +1229,8 @@ class PeerNode:
                  sidecar_queue_blocks: int = 8,
                  sidecar_coalesce: int = 4,
                  async_commit: bool = True,
-                 apply_queue_blocks: int = 4):
+                 apply_queue_blocks: int = 4,
+                 tx_flow: bool = True):
         self.id = node_id
         self.dir = data_dir
         self.msp = msp_manager
@@ -1268,6 +1275,11 @@ class PeerNode:
         # colocated nodes share one ledger, the last release disarms
         self.device_ledger = bool(device_ledger)
         self.launch_ledger = None
+        # per-tx flow journal (nodeconfig ``tx_flow``, default ON):
+        # armed refcounted at start() like the launch ledger —
+        # colocated nodes share one journal, the last release disarms
+        self.tx_flow = bool(tx_flow)
+        self.txflow_journal = None
         # traffic autopilot (nodeconfig ``autopilot`` / ``autopilot_
         # tick_s`` / ``autopilot_knobs``): built and started at
         # start() — OFF by default, so tier-1/CPU hosts never even
@@ -1759,6 +1771,47 @@ class PeerNode:
             from fabric_tpu.observe import ledger as _ledgermod
 
             self.launch_ledger = _ledgermod.acquire()
+        if self.tx_flow:
+            # per-tx flow journal: endorse→sign→submit→order→durable→
+            # apply milestone attribution on one monotonic clock,
+            # /txflow, the tx_flow_* histograms and the bench
+            # extras.tx_flow payload.  Same refcounted sharing story
+            # as the launch ledger.
+            from fabric_tpu.observe import txflow as _txflowmod
+
+            self.txflow_journal = _txflowmod.acquire()
+            if self.slos:
+                # commit-path SLOs: a peer that declares SLOs AND runs
+                # the journal arms the default commit_e2e:latency /
+                # commit_valid:busy pair (unless the operator's spec
+                # already names the commit channel) and feeds them one
+                # event per COMPLETED flow — client-visible latency to
+                # state visibility, not a per-block proxy
+                from fabric_tpu.observe import slo as _slo
+
+                engine = _slo.global_engine()
+                if not any(o.channel == _slo.COMMIT_CHANNEL
+                           for o in engine.objectives):
+                    engine.set_objectives(
+                        tuple(engine.objectives) + tuple(
+                            _slo.parse_slos(_slo.DEFAULT_COMMIT_SLOS)
+                        )
+                    )
+                self.txflow_journal.slo_feed = _slo.commit_feed(engine)
+            if self.sign_batcher is not None:
+                # the lane has ONE observer slot — chain the journal's
+                # sign_wait stage feed behind whatever the SLO arming
+                # installed (both contracts: (wait_ms, busy))
+                prev = self.sign_batcher.observer
+                txobs = _txflowmod.sign_observer()
+                if prev is None:
+                    self.sign_batcher.observer = txobs
+                else:
+                    def _sign_chain(wait_ms, busy, _a=prev, _b=txobs):
+                        _a(wait_ms, busy)
+                        _b(wait_ms, busy)
+
+                    self.sign_batcher.observer = _sign_chain
         self.operations = None
         if operations_port is not None:
             from fabric_tpu.opsserver import HealthRegistry, OperationsServer
@@ -1803,6 +1856,7 @@ class PeerNode:
                 port=operations_port, health=health,
                 autopilot=self.autopilot_ctl, vitals=self.vitals,
                 blackbox=self.blackbox, launches=self.launch_ledger,
+                txflow=self.txflow_journal,
             ).start()
         return self
 
@@ -1837,6 +1891,11 @@ class PeerNode:
 
             _ledgermod.release()
             self.launch_ledger = None
+        if self.txflow_journal is not None:
+            from fabric_tpu.observe import txflow as _txflowmod
+
+            _txflowmod.release()
+            self.txflow_journal = None
         if self.autopilot_ctl is not None:
             # disable BEFORE stopping so /autopilot (and the gauge)
             # never reads a dead control loop as live, and release the

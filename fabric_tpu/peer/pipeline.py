@@ -71,6 +71,7 @@ from dataclasses import dataclass, field
 from concurrent.futures import TimeoutError as _CfTimeout
 
 from fabric_tpu import faults as _faults
+from fabric_tpu.observe import txflow as _txflow
 
 _log = logging.getLogger("fabric_tpu.pipeline")
 
@@ -233,9 +234,13 @@ class CommitPipeline:
     def __init__(self, validator, commit_fn, depth: int = 2,
                  prefetch_fn=None, pre_launch_fn=None, registry=None,
                  channel: str = "", coalesce_blocks: int = 0,
-                 tracer=None):
+                 tracer=None, replay: bool = False):
         self.validator = validator
         self.commit_fn = commit_fn
+        # replay pipelines (peer/replay.py) tag their tx-flow
+        # inclusion stamps so catch-up blocks record inclusion→apply
+        # only and never inherit a colliding live flow's endorse legs
+        self.replay = bool(replay)
         if tracer is None:
             from fabric_tpu.observe import global_tracer
 
@@ -542,6 +547,22 @@ class CommitPipeline:
         if fn is not None:
             fn(res.batch)
 
+    def _run_commit(self, res) -> None:
+        """The ONE commit body shared by all three commit sites
+        (pipelined committer thread, serial mode, barrier/tail
+        inline): stamp tx-flow inclusion + verdicts, then the ledger
+        commit and the resident-state scatter.  The inclusion stamp
+        lands BEFORE commit_fn so the ledger's durable/apply fences
+        find the block's flows already open."""
+        if _txflow.enabled():
+            num = res.block.header.number
+            txs = [(p.txid, int(res.tx_filter[p.idx]))
+                   for p in res.pend.txs if p.txid]
+            _txflow.block_included(num, txs, channel=self.channel,
+                                   replay=self.replay)
+        self.commit_fn(res)
+        self._resident_commit(res)
+
     def _commit_traced(self, res, root):
         """Committer-thread task: commit under its span, then finalize
         the block's root — ring append + slow-block watchdog run here,
@@ -549,8 +570,7 @@ class CommitPipeline:
         try:
             with self.tracer.span("commit", parent=root):
                 _faults.fire("pipeline.commit")
-                self.commit_fn(res)
-                self._resident_commit(res)
+                self._run_commit(res)
         except BaseException:
             self._note_stage_failure("commit", res.block.header.number)
             raise
@@ -738,8 +758,7 @@ class CommitPipeline:
         try:
             with tr.span("commit", parent=root):
                 _faults.fire("pipeline.commit")
-                self.commit_fn(res)
-                self._resident_commit(res)
+                self._run_commit(res)
         except BaseException:
             self._note_stage_failure("commit", block.header.number)
             raise
@@ -803,8 +822,7 @@ class CommitPipeline:
             try:
                 with self.tracer.span("commit", parent=root):
                     _faults.fire("pipeline.commit")
-                    self.commit_fn(res)
-                    self._resident_commit(res)
+                    self._run_commit(res)
             except BaseException:
                 self._note_stage_failure(
                     "commit", res.block.header.number

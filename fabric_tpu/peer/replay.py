@@ -185,6 +185,7 @@ class ReplayDriver:
             self.validator, self._commit, depth=self.depth,
             pre_launch_fn=self.pre_launch_fn, channel=self.channel,
             coalesce_blocks=self.coalesce_blocks, tracer=self.tracer,
+            replay=True,
         )
         if self._pipe_hook is not None:
             self._pipe_hook(pipe)

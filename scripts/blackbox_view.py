@@ -282,6 +282,70 @@ def render_launches(led: dict) -> list[str]:
     return lines
 
 
+def render_txflow(tf: dict) -> list[str]:
+    """The per-tx flow section: stage decomposition percentiles, e2e
+    by validation outcome, the visibility-lag window and the last
+    completed flows — "where did the p99 tx spend its second?"
+    answered inside the postmortem."""
+    lines = ["", "-- tx flows " + "-" * 58]
+    lines.append(
+        "  completed=%-6s inflight=%-6s evicted=%-6s partial=%-6s "
+        "replayed=%s" % (
+            tf.get("flows_completed", 0), tf.get("flows_inflight", 0),
+            tf.get("flows_evicted", 0), tf.get("flows_partial", 0),
+            tf.get("flows_replayed", 0),
+        )
+    )
+    stages = tf.get("stages_ms") or {}
+    if stages:
+        lines.append("  [stages]")
+        for stage, p in sorted(stages.items()):
+            if not p:
+                continue
+            lines.append(
+                "    %-10s n=%-6d p50=%-8s p99=%-8s max=%sms" % (
+                    stage, p["n"], _fmt(p["p50"]), _fmt(p["p99"]),
+                    _fmt(p["max"]),
+                )
+            )
+    e2e = tf.get("e2e_ms") or {}
+    if e2e:
+        lines.append("  [e2e by outcome]")
+        for outcome, p in sorted(e2e.items()):
+            if not p:
+                continue
+            lines.append(
+                "    %-22s n=%-6d p50=%-8s p99=%-8s max=%sms" % (
+                    outcome, p["n"], _fmt(p["p50"]), _fmt(p["p99"]),
+                    _fmt(p["max"]),
+                )
+            )
+    lag = tf.get("visibility_lag_ms")
+    if lag:
+        lines.append(
+            "  visibility_lag n=%-6d p50=%-8s p99=%-8s max=%sms" % (
+                lag["n"], _fmt(lag["p50"]), _fmt(lag["p99"]),
+                _fmt(lag["max"]),
+            )
+        )
+    recent = tf.get("recent") or []
+    if recent:
+        lines.append("  [last flows]")
+        for r in recent:
+            stages_s = ",".join(
+                f"{k}={_fmt(v)}" for k, v in
+                sorted((r.get("stages_ms") or {}).items())
+            )
+            lines.append(
+                "    %-16s %-12s blk=%-5s e2e=%-8sms %s" % (
+                    (r.get("tx_id") or "")[:16], r.get("outcome"),
+                    r.get("block", "-"), _fmt(r.get("e2e_ms")),
+                    stages_s,
+                )
+            )
+    return lines
+
+
 def render_traces(traces: dict) -> list[str]:
     import os
 
@@ -318,6 +382,8 @@ def render_bundle(b: dict, series_limit: int | None = 24,
         lines += render_scheduler(b["scheduler"])
     if "launches" in b:
         lines += render_launches(b["launches"])
+    if "tx_flow" in b:
+        lines += render_txflow(b["tx_flow"])
     if "commit_engine" in b:
         lines += render_commit_engine(b["commit_engine"],
                                       b.get("vitals"))

@@ -39,6 +39,13 @@ echo "== replay smoke =="
 timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/replay_smoke.py
 smoke_rc=$?
 
+echo "== txflow smoke =="
+# crypto-free tx-flow journal smoke (scripts/txflow_smoke.py): toy
+# chain through the REAL CommitPipeline + KVLedger, pinning the
+# milestone-order and stage-telescoping (sum == e2e) invariants
+timeout -k 10 120 env JAX_PLATFORMS=cpu python scripts/txflow_smoke.py
+tf_rc=$?
+
 echo "== tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
@@ -52,6 +59,7 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -c
 [ "$lint_rc" -ne 0 ] && echo "analyzer battery FAILED (rc=$lint_rc)"
 [ "$mc_rc" -ne 0 ] && echo "multichip dryrun FAILED (rc=$mc_rc)"
 [ "$smoke_rc" -ne 0 ] && echo "replay smoke FAILED (rc=$smoke_rc)"
+[ "$tf_rc" -ne 0 ] && echo "txflow smoke FAILED (rc=$tf_rc)"
 [ "$t1_rc" -ne 0 ] && echo "tier-1 tests FAILED (rc=$t1_rc)"
 [ "$lint_rc" -eq 0 ] && [ "$mc_rc" -eq 0 ] && [ "$smoke_rc" -eq 0 ] \
-    && [ "$t1_rc" -eq 0 ]
+    && [ "$tf_rc" -eq 0 ] && [ "$t1_rc" -eq 0 ]

@@ -1,0 +1,202 @@
+"""Transaction-flow journal smoke for CI tier-1 (crypto-free, seconds).
+
+Re-asserts the journal's acceptance geometry against the REAL commit
+stack — not unit mocks — with no ``cryptography`` and no device:
+
+1. arm the module-global journal (a private registry) and stamp
+   gateway-shaped ``endorse/submit/broadcast`` milestones for every tx
+   of a toy dependent chain;
+2. push the chain through the REAL ``CommitPipeline`` (inclusion +
+   verdict stamped in ``_run_commit``) into the REAL serial
+   ``KVLedger`` (``applied`` stamped after state apply), with a stale
+   read lane so verdicts are non-trivial;
+3. pin the invariants on every completed flow: all milestones present
+   and monotonic, stages telescope (sum(stages) == e2e to rounding),
+   outcomes split VALID / MVCC, and the ``/txflow``-shaped
+   ``report()`` carries per-stage percentiles;
+4. disarm and prove the hooks go back to structural no-ops.
+
+Exit 0 with a JSON summary on success; any violated invariant raises.
+
+Usage: python scripts/txflow_smoke.py
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fabric_tpu import protoutil as pu
+from fabric_tpu.ledger.kvledger import KVLedger
+from fabric_tpu.ledger.statedb import MemVersionedDB, UpdateBatch
+from fabric_tpu.observe import txflow
+from fabric_tpu.ops_metrics import Registry
+from fabric_tpu.peer.pipeline import CommitPipeline
+
+N_BLOCKS = 6
+N_TX = 8
+
+MILESTONE_ORDER = ["endorse_begin", "endorse_end", "submit",
+                   "broadcast", "included", "applied"]
+
+
+# -- toy validator (the replay_smoke.py wire form, reads-only lane) ---------
+
+
+class _Ptx:
+    def __init__(self, txid, idx):
+        self.txid, self.idx, self.is_config = txid, idx, False
+
+
+class _Pend:
+    def __init__(self, block, txs, raw, overlay, extra):
+        self.block, self.txs, self.raw = block, txs, raw
+        self.overlay, self.extra, self.hd_bytes = overlay, extra, None
+
+    @property
+    def txids(self):
+        return {p.txid for p in self.txs if p.txid}
+
+
+class ToyValidator:
+    VALID, MVCC = 0, 11
+
+    def __init__(self, state):
+        self.state = state
+
+    def preprocess(self, block):
+        return [json.loads(bytes(d)) for d in block.data.data]
+
+    def validate_launch(self, block, pre=None, overlay=None,
+                        extra_txids=None):
+        raw = pre if pre is not None else self.preprocess(block)
+        txs = [_Ptx(t["id"], i) for i, t in enumerate(raw)]
+        return _Pend(block, txs, raw, overlay, extra_txids)
+
+    def _version(self, pr, over):
+        if pr in over:
+            return over[pr]
+        vv = self.state.get_state(*pr)
+        return None if vv is None else tuple(vv.version)
+
+    def validate_finish(self, pend):
+        over = {}
+        if pend.overlay is not None:
+            for pr, vv in pend.overlay.updates.items():
+                over[pr] = None if vv.value is None else tuple(vv.version)
+        codes, batch = [], UpdateBatch()
+        num = pend.block.header.number
+        for ptx, t in zip(pend.txs, pend.raw):
+            ok = all(
+                self._version(("cc", k), over)
+                == (None if want is None else tuple(want))
+                for k, want in t.get("reads", {}).items()
+            )
+            if not ok:
+                codes.append(self.MVCC)
+                continue
+            codes.append(self.VALID)
+            for k, val in t.get("writes", {}).items():
+                batch.put("cc", k, val.encode(), (num, ptx.idx))
+        return bytes(codes), batch, []
+
+
+def build_chain(n_blocks=N_BLOCKS, n_tx=N_TX):
+    """Dependent stream with one stale lane per block (→ MVCC) so the
+    journal's verdict attribution is exercised, not just VALID."""
+    blocks, prev = [], b""
+    for n in range(n_blocks):
+        txs = []
+        for i in range(n_tx):
+            t = {"id": f"t{n}_{i}", "writes": {f"k{n}_{i}": f"v{n}"}}
+            if n > 0 and i == 1:
+                t["reads"] = {f"k{n-1}_1": [n - 1, 1]}
+            if n > 1 and i == 4:
+                t["reads"] = {f"k{n-2}_4": [0, 0]}  # stale → MVCC
+            txs.append(t)
+        blk = pu.new_block(n, prev)
+        for t in txs:
+            blk.data.data.append(json.dumps(t).encode())
+        blk = pu.finalize_block(blk)
+        prev = pu.block_header_hash(blk.header)
+        blocks.append(blk)
+    return blocks
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="txflowsmoke")
+    try:
+        txflow.configure(registry=Registry())
+        blocks = build_chain()
+        txids = [json.loads(bytes(d))["id"]
+                 for b in blocks for d in b.data.data]
+
+        # 1. gateway-shaped stamps for every tx
+        for tx in txids:
+            txflow.endorse_begin(tx)
+            txflow.endorse_end(tx)
+            txflow.submit_begin(tx)
+            txflow.broadcast_done(tx)
+
+        # 2. the real pipeline + serial ledger commit
+        state = MemVersionedDB()
+        lg = KVLedger(os.path.join(tmp, "ledger"), state_db=state)
+
+        def commit_fn(res):
+            lg.commit_block(res.block, res.tx_filter, res.batch,
+                            res.history, None, res.txids)
+
+        v = ToyValidator(state)
+        with CommitPipeline(v, commit_fn, depth=2,
+                            channel="smoke") as pipe:
+            for b in blocks:
+                pipe.submit(b)
+            pipe.flush()
+        lg.close()
+
+        # 3. the invariants
+        j = txflow.global_journal()
+        rows = j.rows(len(txids))
+        assert len(rows) == len(txids), (len(rows), len(txids))
+        outcomes = {}
+        for r in rows:
+            ms = r["milestones"]
+            present = [m for m in MILESTONE_ORDER if m in ms]
+            assert present == MILESTONE_ORDER, (r["tx_id"], ms)
+            assert all(ms[a] <= ms[b] for a, b in
+                       zip(present, present[1:])), ms
+            drift = abs(sum(r["stages_ms"].values()) - r["e2e_ms"])
+            assert drift < 1e-3, (r["tx_id"], drift)  # rounding only
+            outcomes[r["outcome"]] = outcomes.get(r["outcome"], 0) + 1
+        assert outcomes.get("MVCC_READ_CONFLICT", outcomes.get(
+            "code11", 0)) == N_BLOCKS - 2, outcomes
+        rep = j.report(rows=4)
+        assert rep["flows_completed"] == len(txids), rep
+        for stage in ("endorse", "submit", "order", "apply"):
+            assert rep["stages_ms"][stage]["n"] == len(txids), stage
+
+        # 4. disarm: hooks back to None-check no-ops
+        txflow.configure(enabled=False)
+        assert not txflow.enabled()
+        txflow.block_included(99, [("ghost", 0)])
+        txflow.block_applied(99)
+
+        print(json.dumps({
+            "ok": True,
+            "flows": len(rows),
+            "outcomes": outcomes,
+            "e2e_p99_ms": rep["e2e_ms"].get("VALID", {}).get("p99"),
+            "stages": {s: rep["stages_ms"][s]["p50"]
+                       for s in ("endorse", "submit", "order", "apply")},
+        }))
+        return 0
+    finally:
+        txflow.configure(enabled=False)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,6 +87,11 @@ def test_gateway_round_trip(tmp_path):
                 CHANNEL, CC, [b"put", b"city", b"zurich"]
             )
             assert status["code"] == 0 and status["code_name"] == "VALID"
+            # read-your-writes honesty: the status distinguishes the
+            # block being IN the ledger from its writes being READABLE
+            assert isinstance(status["applied"], bool)
+            assert status["applied_height"] >= 0
+            assert status["durable_height"] >= status["block"]
 
             # evaluate reads the committed state without ordering
             resp = await gw.evaluate(CHANNEL, CC, [b"get", b"city"])

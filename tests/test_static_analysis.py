@@ -2299,6 +2299,7 @@ def test_rule_battery_registered():
         "FT017": "cross-thread-state",
         "FT018": "lost-update",
         "FT019": "unruled-sharding",
+        "FT020": "clock-mixing",
     }
 
 
@@ -2847,6 +2848,101 @@ class TestUnruledSharding:
         assert [f.line for f in got] == [6, 12]
 
 
+# -- FT020 clock-mixing -----------------------------------------------------
+
+# the milestone-delta corruption shape: one end read from the wall
+# clock, the other from the monotonic clock — plausible arithmetic,
+# meaningless number (different epochs + NTP slew)
+BAD_CLOCK_MIX = """\
+import time
+from time import perf_counter as pc
+
+
+def flow_delta(entry):
+    start = time.time()
+    d1 = time.monotonic() - start
+    d2 = float(time.time()) - pc()
+    return d1, d2
+"""
+
+CLEAN_CLOCK_MIX = """\
+import time
+
+
+def stamp(row):
+    # same-domain durations, wall-clock METADATA (no subtraction
+    # against a monotonic reading), and unprovable operands all stay
+    # silent
+    t0 = time.perf_counter()
+    dur = time.perf_counter() - t0
+    row["wall_s"] = time.time()
+    age = time.time() - row.get("wall_s", 0.0)
+    mixed_unknown = time.monotonic() - row["t0"]
+    return dur, age, mixed_unknown
+"""
+
+
+class TestClockMixing:
+    def _rule(self):
+        from fabric_tpu.analysis.rules.clock_mixing import ClockMixingRule
+
+        return ClockMixingRule()
+
+    def test_flags_cross_domain_subtraction(self, tmp_path):
+        got = run_rule(
+            tmp_path, self._rule(),
+            {"fabric_tpu/observe/timing.py": BAD_CLOCK_MIX},
+        )
+        assert [(f.rule, f.line) for f in got] == [
+            ("FT020", 7),   # time.monotonic() - wall-derived local
+            ("FT020", 8),   # wrapped wall - aliased perf_counter
+        ]
+        assert "monotonic" in got[0].message
+        assert "duration" in got[0].message
+
+    def test_same_domain_and_unknown_stay_silent(self, tmp_path):
+        assert run_rule(
+            tmp_path, self._rule(),
+            {"fabric_tpu/observe/timing.py": CLEAN_CLOCK_MIX},
+        ) == []
+
+    def test_rebound_local_poisons(self, tmp_path):
+        # a start that is assigned twice is unprovable — silence
+        src = BAD_CLOCK_MIX.replace(
+            "    start = time.time()",
+            "    start = time.time()\n    start = entry",
+        ).replace("    d2 = float(time.time()) - pc()\n", "")
+        assert run_rule(
+            tmp_path, self._rule(),
+            {"fabric_tpu/observe/timing.py": src},
+        ) == []
+
+    def test_out_of_package_exempt(self, tmp_path):
+        # bench/scripts drivers may stamp wall-clock metadata freely
+        assert run_rule(
+            tmp_path, self._rule(),
+            {"scripts/driver.py": BAD_CLOCK_MIX,
+             "bench.py": BAD_CLOCK_MIX},
+        ) == []
+
+    def test_test_code_exempt(self, tmp_path):
+        assert run_rule(
+            tmp_path, self._rule(),
+            {"tests/test_timing.py": BAD_CLOCK_MIX},
+        ) == []
+
+    def test_noqa_suppresses_one_site(self, tmp_path):
+        src = BAD_CLOCK_MIX.replace(
+            "    d1 = time.monotonic() - start",
+            "    d1 = time.monotonic() - start  # fabtpu: noqa(FT020)",
+        )
+        got = run_rule(
+            tmp_path, self._rule(),
+            {"fabric_tpu/observe/timing.py": src},
+        )
+        assert [f.line for f in got] == [8]
+
+
 # -- the ported-rule differential pin ---------------------------------------
 
 
@@ -3043,6 +3139,7 @@ def _meta_fixtures():
         "FT017": {"mod.py": BAD_CROSS_THREAD},
         "FT018": {"mod.py": BAD_LOST_UPDATE},
         "FT019": {"fabric_tpu/peer/launcher.py": BAD_UNRULED},
+        "FT020": {"fabric_tpu/observe/timing.py": BAD_CLOCK_MIX},
     }
     clean = {
         "FT001": {"mod.py": _META_JIT_CLEAN},
@@ -3066,6 +3163,8 @@ def _meta_fixtures():
         "FT018": {"mod.py": CLEAN_LOST_UPDATE},
         "FT019": {"fabric_tpu/peer/launcher.py": CLEAN_UNRULED,
                   "scripts/driver.py": BAD_UNRULED},
+        "FT020": {"fabric_tpu/observe/timing.py": CLEAN_CLOCK_MIX,
+                  "scripts/driver.py": BAD_CLOCK_MIX},
     }
     return bad, clean
 
@@ -3094,7 +3193,7 @@ def test_registry_meta_battery(tmp_path):
     from fabric_tpu.analysis import all_rules
 
     rules = all_rules()
-    assert len(rules) == 19
+    assert len(rules) == 20
     bad_fixtures, clean_fixtures = _meta_fixtures()
     for rule in rules:
         assert rule.description.strip(), f"{rule.id}: empty description"
